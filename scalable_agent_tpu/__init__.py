@@ -1,0 +1,22 @@
+"""scalable_agent_tpu — TPU-native IMPALA framework (JAX/XLA/Pallas).
+
+A ground-up re-design of the capabilities of the reference IMPALA
+implementation (`RoganInglis/scalable_agent`, a fork of
+deepmind/scalable_agent, arXiv:1802.01561) for TPU:
+
+- `vtrace`            — pure-JAX V-trace (scan + associative-scan forms)
+- `models`            — agent networks (shallow CNN / deep ResNet torsos,
+                        LSTM core with done-reset, instruction encoder)
+- `losses`            — IMPALA losses (policy gradient, baseline, entropy)
+- `learner`           — jitted train step, optimizer, frame accounting
+- `envs`              — environment adapters behind a process-safe spec
+                        protocol (fake env for CI, DMLab/ALE import-guarded)
+- `runtime`           — host runtime: process-hosted envs, trajectory ring
+                        buffer, C++ dynamic batcher, actors, checkpointing
+- `parallel`          — mesh construction and sharded (pjit) training
+- `dmlab30`           — DMLab-30 task table + human-normalized scoring
+"""
+
+from scalable_agent_tpu import vtrace  # noqa: F401
+
+__version__ = '0.1.0'

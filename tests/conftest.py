@@ -1,0 +1,22 @@
+"""Test config: force an 8-device virtual CPU mesh before any backend init.
+
+All tests run on CPU (fast, deterministic); multi-chip sharding tests use
+the 8 virtual devices. The real-TPU path is exercised by bench.py and
+__graft_entry__.py, which do NOT import this file.
+
+Note: this sandbox's sitecustomize registers the `axon` TPU PJRT plugin and
+pins the platform programmatically, so the env var alone is not enough —
+we must update jax.config before the first backend query.
+"""
+
+import os
+
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+  os.environ['XLA_FLAGS'] = (
+      _flags + ' --xla_force_host_platform_device_count=8').strip()
+os.environ['JAX_PLATFORMS'] = 'cpu'
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
