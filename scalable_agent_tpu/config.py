@@ -1,0 +1,73 @@
+"""Experiment configuration.
+
+Flag *names* mirror the reference (experiment.py ≈L30–75) so an operator
+of the reference finds the same knobs; defaults are the paper's tuned
+DMLab values. A dataclass + absl-flags overlay replaces TF1 app flags
+(SURVEY §5.6).
+"""
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+  # Experiment / run control.
+  logdir: str = '/tmp/agent'
+  mode: str = 'train'                     # train | test
+  test_num_episodes: int = 10
+
+  # Distributed topology (reference: --job_name/--task over gRPC;
+  # here: jax.distributed process topology + host actor fleets).
+  task: int = -1
+  job_name: str = 'learner'
+  num_actors: int = 4
+
+  # Training.
+  total_environment_frames: int = int(1e9)
+  batch_size: int = 2
+  unroll_length: int = 100
+  num_action_repeats: int = 4
+  seed: int = 1
+
+  # Loss.
+  entropy_cost: float = 0.00025
+  baseline_cost: float = 0.5
+  discounting: float = 0.99
+  reward_clipping: str = 'abs_one'        # abs_one | soft_asymmetric | none
+
+  # Environment.
+  dataset_path: str = ''
+  level_name: str = 'explore_goal_locations_small'
+  width: int = 96
+  height: int = 72
+
+  # Optimizer (RMSProp, poly-decay to 0 over total frames).
+  learning_rate: float = 0.00048
+  decay: float = 0.99
+  momentum: float = 0.0
+  epsilon: float = 0.1
+
+  # TPU-build additions (not in the reference).
+  torso: str = 'deep'                     # deep | shallow
+  use_instruction: bool = True
+  compute_dtype: str = 'float32'          # float32 | bfloat16
+  use_associative_scan: bool = False      # parallel V-trace recursion
+  grad_clip_norm: Optional[float] = None
+  checkpoint_secs: int = 600              # reference save_checkpoint_secs
+  summary_secs: int = 30                  # reference save_summaries_secs
+  # Inference batching (reference dynamic_batching defaults, ≈2.9).
+  inference_min_batch: int = 1
+  inference_max_batch: int = 1024
+  inference_timeout_ms: int = 100
+  # Ring buffer capacity in batches (reference FIFOQueue capacity=1 +
+  # StagingArea double buffer ⇒ bounded policy lag; keep it small).
+  queue_capacity_batches: int = 1
+
+  @property
+  def frames_per_step(self):
+    return self.batch_size * self.unroll_length * self.num_action_repeats
+
+
+def apply_overrides(config: Config, **overrides) -> Config:
+  return dataclasses.replace(config, **overrides)

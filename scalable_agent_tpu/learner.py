@@ -1,0 +1,187 @@
+"""Learner: one jitted SGD step over a batch of actor unrolls.
+
+Re-expresses the reference's `build_learner` (reference: experiment.py
+≈L330–410) as a pure function over (TrainState, batch):
+
+- the whole step — agent unroll over [T+1, B], V-trace, losses, RMSProp
+  update — is ONE jit; V-trace runs on-device (the reference pins it to
+  CPU with a comment that XLA could do better; here XLA does).
+- the global step counts update steps on device; environment frames are
+  `steps * batch * unroll * num_action_repeats` (reference counts frames
+  directly, ≈L390) — same unit, computed host-side for reporting and
+  in-schedule for the polynomial LR decay.
+- the shift/overlap alignment (the 1-frame overlap between consecutive
+  unrolls, reference ≈L285 + ≈L340) is factored into `align_batch` so it
+  can be unit-tested against hand-indexed expectations.
+
+Trajectory layout reminder (time-major [T+1, B]):
+  env_outputs[i]  = o_i  (o_0 is the previous unroll's last frame)
+  agent_outputs[i].action = a_{i-1} (action *before* o_i)
+so rewards[1:] pair with values[:-1] and the bootstrap is V(o_T).
+"""
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from scalable_agent_tpu import losses as losses_lib
+from scalable_agent_tpu import vtrace
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.structs import ActorOutput
+
+
+class TrainState(NamedTuple):
+  params: Any
+  opt_state: Any
+  update_steps: Any  # i32 [] — device-side; frames derived host-side.
+
+
+class VTraceInputs(NamedTuple):
+  behaviour_logits: Any  # [T, B, A] — actor's logits at acting time
+  target_logits: Any     # [T, B, A] — learner's logits, same steps
+  actions: Any           # [T, B]    — actions actually taken
+  discounts: Any         # [T, B]
+  rewards: Any           # [T, B]    — clipped
+  values: Any            # [T, B]    — learner baseline V(o_i)
+  bootstrap_value: Any   # [B]       — V(o_T)
+
+
+def clip_rewards(rewards, mode):
+  """Reference reward clipping (experiment.py ≈L345)."""
+  if mode == 'abs_one':
+    return jnp.clip(rewards, -1.0, 1.0)
+  elif mode == 'soft_asymmetric':
+    squeezed = jnp.tanh(rewards / 5.0)
+    return jnp.where(rewards < 0, 0.3 * squeezed, squeezed) * 5.0
+  elif mode == 'none':
+    return rewards
+  raise ValueError(f'unknown reward clipping: {mode!r}')
+
+
+def align_batch(env_outputs, agent_outputs, learner_outputs, config):
+  """Shift the [T+1] trajectory into aligned [T] V-trace inputs.
+
+  Mirrors reference build_learner ≈L335–355: bootstrap from the last
+  learner baseline, actor/env tensors drop the overlap frame ([1:]),
+  learner tensors drop the last frame ([:-1])."""
+  bootstrap_value = learner_outputs.baseline[-1]
+  actor_t = jax.tree_util.tree_map(lambda t: t[1:], agent_outputs)
+  rewards = env_outputs.reward[1:]
+  done = env_outputs.done[1:]
+  learner_t = jax.tree_util.tree_map(lambda t: t[:-1], learner_outputs)
+
+  clipped_rewards = clip_rewards(rewards, config.reward_clipping)
+  discounts = (~done).astype(jnp.float32) * config.discounting
+  return VTraceInputs(
+      behaviour_logits=actor_t.policy_logits,
+      target_logits=learner_t.policy_logits,
+      actions=actor_t.action,
+      discounts=discounts,
+      rewards=clipped_rewards,
+      values=learner_t.baseline,
+      bootstrap_value=bootstrap_value)
+
+
+def loss_fn(params, agent, batch: ActorOutput, config: Config):
+  """Total IMPALA loss for one batch; returns (loss, metrics)."""
+  learner_outputs, _ = agent.apply(
+      params, batch.agent_outputs.action, batch.env_outputs,
+      batch.agent_state)
+  inputs = align_batch(batch.env_outputs, batch.agent_outputs,
+                       learner_outputs, config)
+
+  vtrace_returns = vtrace.from_logits(
+      behaviour_policy_logits=inputs.behaviour_logits,
+      target_policy_logits=inputs.target_logits,
+      actions=inputs.actions,
+      discounts=inputs.discounts,
+      rewards=inputs.rewards,
+      values=inputs.values,
+      bootstrap_value=inputs.bootstrap_value,
+      use_associative_scan=config.use_associative_scan)
+
+  pg_loss = losses_lib.compute_policy_gradient_loss(
+      inputs.target_logits, inputs.actions, vtrace_returns.pg_advantages)
+  baseline_loss = losses_lib.compute_baseline_loss(
+      vtrace_returns.vs - inputs.values)
+  entropy_loss = losses_lib.compute_entropy_loss(inputs.target_logits)
+
+  total_loss = (pg_loss + config.baseline_cost * baseline_loss +
+                config.entropy_cost * entropy_loss)
+  metrics = {
+      'total_loss': total_loss,
+      'pg_loss': pg_loss,
+      'baseline_loss': baseline_loss,
+      'entropy_loss': entropy_loss,
+  }
+  return total_loss, metrics
+
+
+def frames_per_step(config: Config):
+  """Env frames consumed per SGD step (reference ≈L390)."""
+  return config.frames_per_step
+
+
+def make_schedule(config: Config):
+  """Polynomial (linear) LR decay to 0 over total env frames, driven by
+  the update-step count × frames-per-step (reference ≈L380–390). The
+  single source of truth for the LR — used by both the optimizer and
+  the logged `learning_rate` metric."""
+  fps = float(config.frames_per_step)
+
+  def schedule(count):
+    frames = jnp.asarray(count).astype(jnp.float32) * fps
+    frac = jnp.minimum(frames / float(config.total_environment_frames),
+                       1.0)
+    return config.learning_rate * (1.0 - frac)
+
+  return schedule
+
+
+def make_optimizer(config: Config):
+  """RMSProp (+ optional global-norm clipping) with the frame-driven
+  polynomial decay schedule."""
+  opt = optax.rmsprop(
+      learning_rate=make_schedule(config), decay=config.decay,
+      eps=config.epsilon, momentum=config.momentum)
+  if config.grad_clip_norm is not None:
+    opt = optax.chain(
+        optax.clip_by_global_norm(config.grad_clip_norm), opt)
+  return opt
+
+
+def make_train_state(params, config: Config) -> TrainState:
+  optimizer = make_optimizer(config)
+  return TrainState(
+      params=params,
+      opt_state=optimizer.init(params),
+      update_steps=jnp.zeros((), jnp.int32))
+
+
+def make_train_step(agent, config: Config):
+  """Build the jitted train step: (TrainState, batch) → (state, metrics).
+
+  `batch` is an ActorOutput pytree of [T+1, B] time-major arrays (plus
+  agent_state [B, ...]). Donates the state for in-place HBM update.
+  """
+  optimizer = make_optimizer(config)
+
+  schedule = make_schedule(config)
+
+  def train_step(state: TrainState, batch: ActorOutput):
+    (total_loss, metrics), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(state.params, agent, batch, config)
+    # Pre-clip norm: explosions must stay visible even with clipping on.
+    metrics['grad_norm'] = optax.global_norm(grads)
+    updates, new_opt_state = optimizer.update(
+        grads, state.opt_state, state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    new_state = TrainState(new_params, new_opt_state,
+                           state.update_steps + 1)
+    metrics['learning_rate'] = schedule(state.update_steps)
+    return new_state, metrics
+
+  return jax.jit(train_step, donate_argnums=(0,))

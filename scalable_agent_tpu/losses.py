@@ -1,0 +1,33 @@
+"""IMPALA losses (reference: experiment.py ≈L300–330).
+
+Sum-reductions over [T, B] exactly like the reference (not means) — the
+loss scale interacts with the tuned learning rate, so this is
+load-bearing for hyperparameter parity.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def compute_baseline_loss(advantages):
+  """0.5 * sum((vs - V)^2) — reference `compute_baseline_loss`."""
+  return 0.5 * jnp.sum(jnp.square(advantages))
+
+
+def compute_entropy_loss(logits):
+  """Negative total entropy (minimizing it maximizes entropy) —
+  reference `compute_entropy_loss`."""
+  policy = jax.nn.softmax(logits, axis=-1)
+  log_policy = jax.nn.log_softmax(logits, axis=-1)
+  entropy_per_timestep = -jnp.sum(policy * log_policy, axis=-1)
+  return -jnp.sum(entropy_per_timestep)
+
+
+def compute_policy_gradient_loss(logits, actions, advantages):
+  """sum over T,B of -log pi(a|x) * advantage, advantages stopped —
+  reference `compute_policy_gradient_loss`."""
+  log_probs = jax.nn.log_softmax(logits, axis=-1)
+  cross_entropy = -jnp.take_along_axis(
+      log_probs, actions[..., None].astype(jnp.int32), axis=-1).squeeze(-1)
+  advantages = jax.lax.stop_gradient(advantages)
+  return jnp.sum(cross_entropy * advantages)

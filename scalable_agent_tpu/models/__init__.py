@@ -1,0 +1,6 @@
+from scalable_agent_tpu.models.agent import (  # noqa: F401
+    ImpalaAgent, init_params, make_step_fn)
+from scalable_agent_tpu.models.torsos import (  # noqa: F401
+    DeepResNetTorso, ShallowTorso, TORSOS)
+from scalable_agent_tpu.models.instruction import (  # noqa: F401
+    InstructionEncoder, hash_instruction, MAX_INSTRUCTION_LEN, VOCAB_SIZE)

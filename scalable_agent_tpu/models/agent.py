@@ -1,0 +1,165 @@
+"""The IMPALA agent network (flax.linen), TPU-first.
+
+Re-designs the reference's `class Agent(snt.RNNCore)` (reference:
+experiment.py ≈L85–210) for XLA:
+
+- The torso (conv net) is applied to the WHOLE [T, B] unroll at once by
+  merging time into the batch dimension — one big MXU-friendly conv batch
+  instead of per-step calls (the reference gets this via
+  `snt.BatchApply`).
+- The recurrent core is a `nn.scan` (lax.scan under jit) over time with
+  the per-step done-reset expressed as `jnp.where(done, 0, state)` on the
+  carry — the reference does this with a *Python* loop over `tf.unstack`
+  + `tf.where` (experiment.py ≈L195–205), which it comments precludes
+  fused RNN kernels; the scan form compiles to a single fused XLA loop.
+- Heads (policy logits, baseline) again run over the merged [T*B] batch.
+
+Inputs each step, matching the reference contract: `(last_action,
+StepOutput(reward, info, done, (frame, instruction_ids)))`. Rewards are
+clipped to [-1, 1] and concatenated with the one-hot last action and the
+instruction encoding before the core (reference `_torso` ≈L120).
+"""
+
+import functools
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from scalable_agent_tpu.structs import AgentOutput
+from scalable_agent_tpu.models.torsos import TORSOS
+from scalable_agent_tpu.models.instruction import InstructionEncoder
+
+
+class _ResetCore(nn.Module):
+  """LSTM core whose carry is zeroed wherever `done` is set (before the
+  step — `done[t]` marks the first observation of a new episode)."""
+  hidden_size: int
+  dtype: jnp.dtype = jnp.float32
+
+  @nn.compact
+  def __call__(self, carry, inputs):
+    x, done = inputs
+    carry = jax.tree_util.tree_map(
+        lambda s: jnp.where(done[:, None], jnp.zeros_like(s), s), carry)
+    cell = nn.OptimizedLSTMCell(self.hidden_size, dtype=self.dtype)
+    carry, out = cell(carry, x)
+    return carry, out
+
+
+class ImpalaAgent(nn.Module):
+  """IMPALA agent: torso → LSTM core → policy/baseline heads."""
+  num_actions: int
+  torso: str = 'deep'        # 'deep' (reference) | 'shallow' (paper)
+  hidden_size: int = 256
+  use_instruction: bool = True
+  dtype: jnp.dtype = jnp.float32
+
+  def initial_state(self, batch_size):
+    """Zeroed LSTM carry (c, h), each [B, hidden] (reference ≈L90)."""
+    shape = (batch_size, self.hidden_size)
+    return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+
+  @nn.compact
+  def __call__(self, prev_actions, env_outputs, core_state,
+               sample_rng=None):
+    """Unroll over a [T, B] trajectory.
+
+    Args:
+      prev_actions: i32 [T, B] — action taken *before* each timestep.
+      env_outputs: StepOutput of [T, B, ...] tensors; observation is
+        (frame uint8 [T, B, H, W, C], instruction ids i32 [T, B, L]).
+      core_state: LSTM carry (c, h) each [B, hidden] at unroll start.
+      sample_rng: PRNG key → actions are sampled from the policy
+        (actor/eval path, reference `tf.multinomial` ≈L165); None →
+        argmax (learner path, where the action output is unused).
+
+    Returns:
+      (AgentOutput([T, B, ...]), final core_state).
+    """
+    reward, _, done, (frame, instr_ids) = env_outputs
+    t, b = reward.shape[0], reward.shape[1]
+
+    # --- Torso over merged time+batch (one big MXU batch). ---
+    flat_frame = frame.reshape((t * b,) + frame.shape[2:])
+    torso_out = TORSOS[self.torso](dtype=self.dtype)(flat_frame)
+
+    clipped_reward = jnp.clip(reward, -1.0, 1.0).reshape(t * b, 1)
+    one_hot_action = jax.nn.one_hot(
+        prev_actions.reshape(t * b), self.num_actions, dtype=torso_out.dtype)
+    parts = [torso_out, clipped_reward.astype(torso_out.dtype),
+             one_hot_action]
+    if self.use_instruction:
+      flat_ids = instr_ids.reshape((t * b,) + instr_ids.shape[2:])
+      parts.append(InstructionEncoder(dtype=self.dtype)(flat_ids))
+    core_input = jnp.concatenate(parts, axis=-1).reshape(t, b, -1)
+
+    # --- Recurrent core: scan over time with done-reset on the carry. ---
+    scan = nn.scan(
+        lambda core, carry, x: core(carry, x),
+        variable_broadcast='params', split_rngs={'params': False},
+        in_axes=0, out_axes=0)
+    core = _ResetCore(self.hidden_size, dtype=self.dtype)
+    core_state = jax.tree_util.tree_map(
+        lambda s: s.astype(self.dtype), core_state)
+    new_state, core_out = scan(core, core_state, (core_input, done))
+    new_state = jax.tree_util.tree_map(
+        lambda s: s.astype(jnp.float32), new_state)
+
+    # --- Heads over merged time+batch. ---
+    flat_core = core_out.reshape(t * b, -1)
+    policy_logits = nn.Dense(self.num_actions, dtype=self.dtype,
+                             name='policy_logits')(flat_core)
+    baseline = nn.Dense(1, dtype=self.dtype, name='baseline')(flat_core)
+    policy_logits = policy_logits.astype(jnp.float32).reshape(
+        t, b, self.num_actions)
+    baseline = baseline.astype(jnp.float32).reshape(t, b)
+
+    if sample_rng is not None:
+      action = jax.random.categorical(sample_rng, policy_logits, axis=-1)
+    else:
+      action = jnp.argmax(policy_logits, axis=-1)
+    action = action.astype(jnp.int32)
+
+    return AgentOutput(action, policy_logits, baseline), new_state
+
+
+def make_step_fn(agent: ImpalaAgent):
+  """Single-step (T=1) policy for actors: batch-shaped, no time axis.
+
+  Returns f(params, rng, prev_action [B], env_output of [B, ...],
+  core_state) → (AgentOutput of [B, ...], new_state). Jit this and serve
+  it behind the dynamic batcher.
+  """
+
+  @functools.partial(jax.jit, static_argnums=())
+  def step(params, rng, prev_action, env_output, core_state):
+    env_output_t = jax.tree_util.tree_map(lambda x: x[None], env_output)
+    out, new_state = agent.apply(
+        params, prev_action[None], env_output_t, core_state,
+        sample_rng=rng)
+    return jax.tree_util.tree_map(lambda x: x[0], out), new_state
+
+  return step
+
+
+def init_params(agent: ImpalaAgent, rng, obs_spec, batch_size=1):
+  """Initialize parameters from an observation spec pytree.
+
+  obs_spec: dict with 'frame' (H, W, C) uint8 and 'instr_len' L.
+  """
+  h, w, c = obs_spec['frame']
+  l = obs_spec['instr_len']
+  t, b = 2, batch_size
+  from scalable_agent_tpu.structs import StepOutput, StepOutputInfo
+  dummy = StepOutput(
+      reward=jnp.zeros((t, b), jnp.float32),
+      info=StepOutputInfo(jnp.zeros((t, b), jnp.float32),
+                          jnp.zeros((t, b), jnp.int32)),
+      done=jnp.zeros((t, b), bool),
+      observation=(jnp.zeros((t, b, h, w, c), jnp.uint8),
+                   jnp.zeros((t, b, l), jnp.int32)))
+  prev_actions = jnp.zeros((t, b), jnp.int32)
+  return agent.init(rng, prev_actions, dummy,
+                    agent.initial_state(b))

@@ -1,0 +1,74 @@
+"""Language-instruction pathway.
+
+The reference feeds DMLab's INSTR string through `tf.string_split` →
+hash-to-1000-buckets → Embed(20) → dynamic LSTM(64), taking the last
+output (reference: experiment.py `_instruction` ≈L95). Strings cannot
+reach a TPU, so the device dtype contract here is:
+
+- **host side**: `hash_instruction(text, ...)` tokenizes on whitespace and
+  hashes each token into [1, vocab] (0 is reserved for padding), padding /
+  truncating to a fixed `max_len`. This happens in the env adapter, so the
+  trajectory pytree carries int32 ids only.
+- **device side**: `InstructionEncoder` embeds the ids, runs an LSTM over
+  the fixed-length padded sequence, and gathers the output at the last
+  non-pad position (positions beyond the length cannot influence it).
+"""
+
+import zlib
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+VOCAB_SIZE = 1000  # hash buckets, matching the reference
+MAX_INSTRUCTION_LEN = 16
+EMBED_SIZE = 20
+LSTM_SIZE = 64
+
+
+def hash_instruction(text, vocab_size=VOCAB_SIZE,
+                     max_len=MAX_INSTRUCTION_LEN):
+  """Host-side: whitespace-split + stable hash → int32 [max_len] ids.
+
+  Uses crc32 (stable across processes/runs, unlike Python's `hash`) in
+  place of the reference's FarmHash bucketing — the exact hash family is
+  not load-bearing, only its stability and range.
+  """
+  if isinstance(text, bytes):
+    text = text.decode('utf-8', errors='replace')
+  ids = np.zeros((max_len,), dtype=np.int32)
+  for i, token in enumerate(text.split()[:max_len]):
+    ids[i] = (zlib.crc32(token.encode('utf-8')) % vocab_size) + 1
+  return ids
+
+
+class InstructionEncoder(nn.Module):
+  """Device-side: ids [B, L] → f32 [B, LSTM_SIZE]."""
+  vocab_size: int = VOCAB_SIZE
+  embed_size: int = EMBED_SIZE
+  lstm_size: int = LSTM_SIZE
+  dtype: jnp.dtype = jnp.float32
+
+  @nn.compact
+  def __call__(self, ids):
+    batch = ids.shape[0]
+    # 0 is the pad id; ids are 1-based.
+    emb = nn.Embed(self.vocab_size + 1, self.embed_size,
+                   dtype=self.dtype)(ids)  # [B, L, E]
+    cell = nn.OptimizedLSTMCell(self.lstm_size, dtype=self.dtype)
+    scan = nn.scan(
+        lambda c, carry, x: c(carry, x),
+        variable_broadcast='params', split_rngs={'params': False},
+        in_axes=1, out_axes=1)
+    import jax
+    carry = cell.initialize_carry(
+        jax.random.PRNGKey(0), (batch, self.embed_size))
+    _, outputs = scan(cell, carry, emb)  # [B, L, H]
+    lengths = jnp.sum((ids != 0).astype(jnp.int32), axis=1)  # [B]
+    last = jnp.clip(lengths - 1, 0, ids.shape[1] - 1)
+    gathered = jnp.take_along_axis(
+        outputs, last[:, None, None].astype(jnp.int32), axis=1
+    ).squeeze(1)  # [B, H]
+    # Empty instruction → zeros (matches "no signal", avoids garbage state).
+    return jnp.where(lengths[:, None] > 0, gathered,
+                     jnp.zeros_like(gathered))

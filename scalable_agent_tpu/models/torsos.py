@@ -1,0 +1,79 @@
+"""Visual torsos: shallow CNN and deep ResNet (flax.linen).
+
+Re-expresses the reference's `Agent._torso` (reference: experiment.py
+≈L120): frames are uint8, scaled by 1/255 on device, run through either
+
+- **deep**: 3 sections [(16, 2), (32, 2), (32, 2)] of Conv3x3 →
+  3x3/2 max-pool → 2 residual blocks (relu-conv-relu-conv + skip),
+  then relu → flatten → Linear(256) → relu. This is the IMPALA deep
+  ResNet, the only torso the reference ships.
+- **shallow**: Conv 8x8/4 (16) → Conv 4x4/2 (32) → flatten →
+  Linear(256), relu between layers. The paper's shallow model, offered
+  as a config (BASELINE.json config 1) though absent from the reference
+  repo.
+
+TPU notes: convs are NHWC (XLA's native TPU layout); `dtype` selects the
+compute dtype (bfloat16 recommended on TPU — params stay float32).
+"""
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ResidualBlock(nn.Module):
+  channels: int
+  dtype: jnp.dtype = jnp.float32
+
+  @nn.compact
+  def __call__(self, x):
+    y = nn.relu(x)
+    y = nn.Conv(self.channels, (3, 3), padding='SAME', dtype=self.dtype)(y)
+    y = nn.relu(y)
+    y = nn.Conv(self.channels, (3, 3), padding='SAME', dtype=self.dtype)(y)
+    return x + y
+
+
+class DeepResNetTorso(nn.Module):
+  """IMPALA deep torso (reference: experiment.py ≈L120)."""
+  sections: Sequence[Tuple[int, int]] = ((16, 2), (32, 2), (32, 2))
+  output_size: int = 256
+  dtype: jnp.dtype = jnp.float32
+
+  @nn.compact
+  def __call__(self, frame):
+    x = frame.astype(self.dtype) / 255.0
+    for channels, num_blocks in self.sections:
+      x = nn.Conv(channels, (3, 3), padding='SAME', dtype=self.dtype)(x)
+      x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+      for _ in range(num_blocks):
+        x = ResidualBlock(channels, dtype=self.dtype)(x)
+    x = nn.relu(x)
+    x = x.reshape((x.shape[0], -1))
+    x = nn.Dense(self.output_size, dtype=self.dtype)(x)
+    return nn.relu(x)
+
+
+class ShallowTorso(nn.Module):
+  """Paper's shallow 2-conv torso (not in the reference repo; see module
+  docstring)."""
+  output_size: int = 256
+  dtype: jnp.dtype = jnp.float32
+
+  @nn.compact
+  def __call__(self, frame):
+    x = frame.astype(self.dtype) / 255.0
+    x = nn.relu(nn.Conv(16, (8, 8), strides=(4, 4), padding='VALID',
+                        dtype=self.dtype)(x))
+    x = nn.relu(nn.Conv(32, (4, 4), strides=(2, 2), padding='VALID',
+                        dtype=self.dtype)(x))
+    x = x.reshape((x.shape[0], -1))
+    x = nn.Dense(self.output_size, dtype=self.dtype)(x)
+    return nn.relu(x)
+
+
+TORSOS = {
+    'deep': DeepResNetTorso,
+    'shallow': ShallowTorso,
+}

@@ -1,0 +1,140 @@
+"""Host-side actor: rolls environments into learner-ready unrolls.
+
+Re-expresses the reference's `build_actor` (reference: experiment.py
+≈L215–300) outside the graph: on TPU the env loop is host Python while
+inference runs on-device (directly jitted, or via the dynamic batcher) —
+there is no in-graph `tf.scan` over env steps to port.
+
+Faithfully preserved semantics:
+- persistent cross-unroll state (env output, agent output, LSTM state) —
+  the reference's local TF variables (≈L235);
+- the 1-frame overlap: each `ActorOutput` has T+1 timesteps, timestep 0
+  being the previous unroll's last (env_output, agent_output) (≈L285);
+- `agent_state` in the output is the LSTM state at the *start* of the
+  unroll;
+- episode statistics flow *through* the trajectory as `StepOutputInfo`
+  (the reference's FlowEnvironment state machine, environments.py
+  ≈L165–190): the output at a done step carries the finished episode's
+  stats while the carried state resets to zero.
+"""
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from scalable_agent_tpu.structs import (
+    ActorOutput, AgentOutput, StepOutput, StepOutputInfo)
+
+
+def _tree_stack(items):
+  """Stack a list of identically-structured pytrees of np arrays."""
+  import jax
+  return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *items)
+
+
+class Actor:
+  """One environment + its rollout state.
+
+  Args:
+    env: an `envs.base.Environment`.
+    policy: callable `(prev_action i32[], env_output StepOutput of
+      scalars, core_state) -> (AgentOutput of scalars, new_core_state)`.
+      This is where inference plugs in — a direct jitted call for tests,
+      the dynamic-batching client in production.
+    initial_core_state: zeroed LSTM state for one env (no batch dim or
+      batch dim 1, policy-defined — the actor treats it opaquely).
+    unroll_length: T (the output carries T+1 with the overlap frame).
+    num_action_repeats: frames per env step, for episode_step accounting
+      (frames unit matches the reference's global step).
+    level_name_id: int id standing in for the reference's level-name
+      string (strings don't cross the device boundary; the mapping lives
+      in dmlab30.py / the driver).
+  """
+
+  def __init__(self, env, policy: Callable, initial_core_state,
+               unroll_length: int, num_action_repeats: int = 1,
+               level_name_id: int = 0):
+    self._env = env
+    self._policy = policy
+    self._unroll_length = unroll_length
+    self._num_action_repeats = num_action_repeats
+    self._level_name_id = np.int32(level_name_id)
+
+    observation = env.initial()
+    self._env_output = StepOutput(
+        reward=np.float32(0.0),
+        info=StepOutputInfo(np.float32(0.0), np.int32(0)),
+        done=np.bool_(True),  # first obs starts an episode, like reference
+        observation=observation)
+    self._core_state = initial_core_state
+    self._zero_core_state = initial_core_state
+    self._agent_output: Optional[AgentOutput] = None
+    self._episode_return = np.float32(0.0)
+    self._episode_step = np.int32(0)
+
+  def unroll(self) -> ActorOutput:
+    """Produce one ActorOutput of [T+1] time-major numpy arrays."""
+    env_outputs = [self._env_output]
+    if self._agent_output is None:
+      # Prime lazily so we know num_actions from the first policy call.
+      out, _ = self._policy(np.int32(0), self._env_output,
+                            self._core_state)
+      self._agent_output = AgentOutput(
+          action=np.int32(0),
+          policy_logits=np.zeros_like(np.asarray(out.policy_logits)),
+          baseline=np.float32(0.0))
+    agent_outputs = [self._agent_output]
+    initial_core_state = self._core_state
+
+    for _ in range(self._unroll_length):
+      agent_output, core_state = self._policy(
+          self._agent_output.action, self._env_output, self._core_state)
+      agent_output = AgentOutput(
+          *[np.asarray(x) for x in agent_output])
+      reward, done, observation = self._env.step(
+          int(agent_output.action))
+
+      # Flow-style episode accounting (output carries final stats at
+      # done; carried state resets).
+      self._episode_return = np.float32(self._episode_return + reward)
+      self._episode_step = np.int32(
+          self._episode_step + self._num_action_repeats)
+      info = StepOutputInfo(self._episode_return, self._episode_step)
+      if done:
+        self._episode_return = np.float32(0.0)
+        self._episode_step = np.int32(0)
+
+      env_output = StepOutput(np.float32(reward), info, np.bool_(done),
+                              observation)
+      env_outputs.append(env_output)
+      agent_outputs.append(agent_output)
+      self._env_output = env_output
+      self._agent_output = agent_output
+      self._core_state = core_state
+
+    return ActorOutput(
+        level_name=self._level_name_id,
+        agent_state=initial_core_state,
+        env_outputs=_tree_stack(env_outputs),
+        agent_outputs=_tree_stack(agent_outputs))
+
+  def close(self):
+    self._env.close()
+
+
+def batch_unrolls(unrolls):
+  """Stack B ActorOutputs into a learner batch: time-major [T+1, B] for
+  the trajectory, [B, ...] for level_name/agent_state (no time axis)."""
+  import jax
+  env_outputs = jax.tree_util.tree_map(
+      lambda *xs: np.stack(xs, axis=1), *[u.env_outputs for u in unrolls])
+  agent_outputs = jax.tree_util.tree_map(
+      lambda *xs: np.stack(xs, axis=1),
+      *[u.agent_outputs for u in unrolls])
+  level = np.stack([u.level_name for u in unrolls])
+  # Per-actor core states carry batch dim 1 ([1, hidden] leaves);
+  # concatenating gives the learner's [B, hidden].
+  agent_state = jax.tree_util.tree_map(
+      lambda *xs: np.concatenate(xs, axis=0),
+      *[u.agent_state for u in unrolls])
+  return ActorOutput(level, agent_state, env_outputs, agent_outputs)

@@ -1,0 +1,58 @@
+"""Core data structures shared across the framework.
+
+Mirrors the reference's namedtuple contracts (reference: environments.py
+≈L120 `StepOutput`/`StepOutputInfo`; experiment.py ≈L52 `ActorOutput`,
+≈L55 `AgentOutput`) so that a user of the reference finds the same shapes
+in the same places. All are plain pytrees — they cross the host/device
+boundary and jit untouched.
+"""
+
+from typing import NamedTuple, Any
+
+import jax.numpy as jnp
+
+
+class StepOutputInfo(NamedTuple):
+  """Episode statistics that flow *through* the trajectory (no side channel).
+
+  On `done`, the emitted output carries the final episode stats while the
+  carried state resets them to zero — the reference's FlowEnvironment design
+  (environments.py ≈L165–190), kept here as part of the trajectory pytree.
+  """
+  episode_return: Any  # f32 []
+  episode_step: Any    # i32 []
+
+
+class StepOutput(NamedTuple):
+  """One environment step (reference: environments.py ≈L120)."""
+  reward: Any       # f32 []
+  info: Any         # StepOutputInfo
+  done: Any         # bool []
+  observation: Any  # (frame uint8 [H, W, 3], instruction ids int32 [L])
+
+
+class AgentOutput(NamedTuple):
+  """One agent step (reference: experiment.py ≈L55)."""
+  action: Any         # i32 [] — sampled (actor) or argmax (learner unroll)
+  policy_logits: Any  # f32 [num_actions]
+  baseline: Any       # f32 []
+
+
+class ActorOutput(NamedTuple):
+  """One actor unroll as enqueued for the learner (experiment.py ≈L52).
+
+  Time-major with the 1-frame overlap: T+1 timesteps where timestep 0 is
+  the previous unroll's last frame (load-bearing for learner alignment —
+  see losses.py).
+  """
+  level_name: Any    # bytes/str or int level id
+  agent_state: Any   # LSTM state at the *start* of the unroll
+  env_outputs: Any   # StepOutput of [T+1] tensors
+  agent_outputs: Any # AgentOutput of [T+1] tensors
+
+
+def zeros_like_spec(spec):
+  """Build a zeroed pytree from a (shape, dtype) spec pytree."""
+  import jax
+  return jax.tree_util.tree_map(
+      lambda s: jnp.zeros(s.shape, s.dtype), spec)

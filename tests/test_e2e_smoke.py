@@ -1,0 +1,152 @@
+"""End-to-end smoke: actors → unrolls → jitted train step → learning.
+
+The reference has NO equivalent test (SURVEY §4 calls this out as the
+gap not to copy). Proves the minimum slice: N fake actors driving a real
+policy, trajectory batching with the overlap frame, the jitted IMPALA
+step, and that on a learnable task the policy actually improves.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from scalable_agent_tpu import learner as learner_lib
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.envs.fake import ContextualBanditEnv, FakeEnv
+from scalable_agent_tpu.models import ImpalaAgent, init_params
+from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+from scalable_agent_tpu.runtime.actor import Actor, batch_unrolls
+
+H, W, A = 24, 32, 3
+OBS_SPEC = {'frame': (H, W, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+
+
+def _make_policy(agent, params_ref, rng_seed=0):
+  """Direct jitted single-env policy (batcher comes later)."""
+  from scalable_agent_tpu.models.agent import make_step_fn
+  step = make_step_fn(agent)
+  key_holder = {'key': jax.random.PRNGKey(rng_seed)}
+
+  def policy(prev_action, env_output, core_state):
+    key_holder['key'], sub = jax.random.split(key_holder['key'])
+    batched = jax.tree_util.tree_map(
+        lambda x: np.asarray(x)[None], env_output)  # [1, ...] leaves
+    out, state = step(params_ref['params'], sub,
+                      jnp.asarray([prev_action], jnp.int32),
+                      batched, core_state)
+    # Strip the B=1 batch dim down to the actor's scalar contract.
+    return jax.tree_util.tree_map(lambda x: np.asarray(x)[0], out), state
+
+  return policy
+
+
+def test_unroll_overlap_and_batching():
+  agent = ImpalaAgent(num_actions=A, torso='shallow')
+  params = init_params(agent, jax.random.PRNGKey(0), OBS_SPEC)
+  policy = _make_policy(agent, {'params': params})
+  env = FakeEnv(height=H, width=W, num_actions=A, episode_length=7)
+  actor = Actor(env, policy, agent.initial_state(1), unroll_length=6)
+
+  u1 = actor.unroll()
+  u2 = actor.unroll()
+  # T+1 layout.
+  assert u1.env_outputs.reward.shape == (7,)
+  assert u1.agent_outputs.policy_logits.shape == (7, A)
+  # Overlap: first frame of u2 == last frame of u1.
+  np.testing.assert_array_equal(
+      u2.env_outputs.observation[0][0], u1.env_outputs.observation[0][-1])
+  np.testing.assert_array_equal(u2.env_outputs.reward[0],
+                                u1.env_outputs.reward[-1])
+  np.testing.assert_array_equal(u2.agent_outputs.action[0],
+                                u1.agent_outputs.action[-1])
+  # Batching: [T+1, B] trajectory, [B, ...] state.
+  batch = batch_unrolls([u1, u2])
+  assert batch.env_outputs.reward.shape == (7, 2)
+  assert batch.agent_state[0].shape == (2, 256)
+
+  # Episode stats flow through the trajectory: with episode_length=7 and
+  # unroll 6, the first done lands in u2; its info carries the return.
+  done = np.asarray(batch.env_outputs.done)
+  assert done.any()
+
+
+def test_episode_stats_flow_through_trajectory():
+  agent = ImpalaAgent(num_actions=A, torso='shallow')
+  params = init_params(agent, jax.random.PRNGKey(0), OBS_SPEC)
+  policy = _make_policy(agent, {'params': params})
+  env = ContextualBanditEnv(height=H, width=W, num_actions=A,
+                            episode_length=4, seed=3)
+  actor = Actor(env, policy, agent.initial_state(1), unroll_length=12)
+  u = actor.unroll()
+  done = np.asarray(u.env_outputs.done)
+  returns = np.asarray(u.env_outputs.info.episode_return)
+  steps = np.asarray(u.env_outputs.info.episode_step)
+  done_idx = np.where(done)[0]
+  done_idx = done_idx[done_idx > 0]  # skip the initial-reset flag at t=0
+  assert len(done_idx) >= 2
+  for i in done_idx:
+    # At a done step the info carries the FINISHED episode's stats.
+    assert steps[i] == 4
+    assert 0.0 <= returns[i] <= 4.0
+    # And the step after a done starts a fresh count.
+    if i + 1 < len(steps) and not done[i + 1]:
+      assert steps[i + 1] == 1
+
+
+def test_train_step_runs_and_loss_finite():
+  agent = ImpalaAgent(num_actions=A, torso='shallow')
+  params = init_params(agent, jax.random.PRNGKey(0), OBS_SPEC)
+  cfg = Config(batch_size=2, unroll_length=6, num_action_repeats=1,
+               total_environment_frames=100000)
+  policy = _make_policy(agent, {'params': params})
+  actors = [
+      Actor(FakeEnv(height=H, width=W, num_actions=A, seed=i),
+            policy, agent.initial_state(1), unroll_length=6)
+      for i in range(2)]
+  state = learner_lib.make_train_state(params, cfg)
+  train_step = learner_lib.make_train_step(agent, cfg)
+  batch = batch_unrolls([a.unroll() for a in actors])
+  state, metrics = train_step(state, batch)
+  assert np.isfinite(float(metrics['total_loss']))
+  assert int(state.update_steps) == 1
+
+
+def test_bandit_learning_improves_return():
+  """The full loop must LEARN: bandit return ≫ random baseline."""
+  agent = ImpalaAgent(num_actions=A, torso='shallow',
+                      use_instruction=False)
+  params = init_params(agent, jax.random.PRNGKey(42), OBS_SPEC)
+  cfg = Config(batch_size=4, unroll_length=20, num_action_repeats=1,
+               total_environment_frames=200000,
+               learning_rate=0.002, entropy_cost=0.003,
+               reward_clipping='abs_one', discounting=0.0)
+  params_ref = {'params': params}
+  policy = _make_policy(agent, params_ref, rng_seed=7)
+  actors = [
+      Actor(ContextualBanditEnv(height=H, width=W, num_actions=A,
+                                episode_length=5, seed=100 + i),
+            policy, agent.initial_state(1), unroll_length=20)
+      for i in range(4)]
+  state = learner_lib.make_train_state(params, cfg)
+  train_step = learner_lib.make_train_step(agent, cfg)
+
+  def mean_reward(batch):
+    return float(np.mean(np.asarray(batch.env_outputs.reward[1:])))
+
+  first_rewards = []
+  last_rewards = []
+  num_updates = 60
+  for step_i in range(num_updates):
+    batch = batch_unrolls([a.unroll() for a in actors])
+    state, metrics = train_step(state, batch)
+    params_ref['params'] = state.params  # actors act with fresh weights
+    if step_i < 10:
+      first_rewards.append(mean_reward(batch))
+    if step_i >= num_updates - 10:
+      last_rewards.append(mean_reward(batch))
+
+  early, late = np.mean(first_rewards), np.mean(last_rewards)
+  # Random play gives ~1/3; learned play approaches 1.
+  assert late > early + 0.2, (early, late)
+  assert late > 0.6, late

@@ -1,0 +1,180 @@
+"""Agent network tests: golden shapes, done-reset, instruction pathway.
+
+The done-reset test is the load-bearing one (SURVEY §7 "hard parts"):
+the LSTM carry must be zeroed exactly at timesteps where done=True,
+i.e. an episode boundary makes the post-boundary outputs independent of
+the pre-boundary inputs.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalable_agent_tpu.models import (
+    ImpalaAgent, init_params, make_step_fn, hash_instruction,
+    InstructionEncoder, MAX_INSTRUCTION_LEN)
+from scalable_agent_tpu.structs import StepOutput, StepOutputInfo
+
+OBS_SPEC = {'frame': (24, 32, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+NUM_ACTIONS = 5
+
+
+def _make_env_outputs(rng, t, b, done=None):
+  h, w, c = OBS_SPEC['frame']
+  if done is None:
+    done = np.zeros((t, b), bool)
+  return StepOutput(
+      reward=jnp.asarray(rng.randn(t, b), jnp.float32),
+      info=StepOutputInfo(jnp.zeros((t, b), jnp.float32),
+                          jnp.zeros((t, b), jnp.int32)),
+      done=jnp.asarray(done),
+      observation=(
+          jnp.asarray(rng.randint(0, 255, (t, b, h, w, c)), jnp.uint8),
+          jnp.asarray(rng.randint(0, 1000, (t, b, OBS_SPEC['instr_len'])),
+                      jnp.int32)))
+
+
+@pytest.fixture(scope='module', params=['shallow', 'deep'])
+def agent_and_params(request):
+  agent = ImpalaAgent(num_actions=NUM_ACTIONS, torso=request.param)
+  params = init_params(agent, jax.random.PRNGKey(0), OBS_SPEC)
+  return agent, params
+
+
+class TestShapes:
+
+  def test_unroll_shapes(self, agent_and_params):
+    agent, params = agent_and_params
+    t, b = 7, 3
+    rng = np.random.RandomState(0)
+    env_outputs = _make_env_outputs(rng, t, b)
+    prev_actions = jnp.zeros((t, b), jnp.int32)
+    out, state = agent.apply(params, prev_actions, env_outputs,
+                             agent.initial_state(b))
+    assert out.policy_logits.shape == (t, b, NUM_ACTIONS)
+    assert out.baseline.shape == (t, b)
+    assert out.action.shape == (t, b)
+    assert out.action.dtype == jnp.int32
+    c, h = state
+    assert c.shape == (b, 256) and h.shape == (b, 256)
+    assert np.all(np.isfinite(np.asarray(out.policy_logits)))
+
+  def test_single_step_fn(self, agent_and_params):
+    agent, params = agent_and_params
+    b = 4
+    rng = np.random.RandomState(1)
+    env_output = jax.tree_util.tree_map(
+        lambda x: x[0], _make_env_outputs(rng, 1, b))
+    step = make_step_fn(agent)
+    out, state = step(params, jax.random.PRNGKey(2),
+                      jnp.zeros((b,), jnp.int32), env_output,
+                      agent.initial_state(b))
+    assert out.action.shape == (b,)
+    assert out.policy_logits.shape == (b, NUM_ACTIONS)
+    assert int(out.action.min()) >= 0
+    assert int(out.action.max()) < NUM_ACTIONS
+
+
+class TestDoneReset:
+
+  def test_reset_makes_suffix_independent_of_prefix(self):
+    """With done at t=k, outputs from t>=k must not depend on inputs t<k."""
+    agent = ImpalaAgent(num_actions=NUM_ACTIONS, torso='shallow')
+    params = init_params(agent, jax.random.PRNGKey(0), OBS_SPEC)
+    t, b, k = 6, 2, 3
+    rng = np.random.RandomState(3)
+    done = np.zeros((t, b), bool)
+    done[k] = True
+    env_a = _make_env_outputs(rng, t, b, done)
+    # env_b: same suffix from k onward, different prefix.
+    env_b = _make_env_outputs(np.random.RandomState(99), t, b, done)
+    env_b = jax.tree_util.tree_map(
+        lambda x_b, x_a: jnp.concatenate([x_b[:k], x_a[k:]], axis=0),
+        env_b, env_a)
+    actions = jnp.asarray(
+        np.random.RandomState(5).randint(0, NUM_ACTIONS, (t, b)), jnp.int32)
+    # Same prev_action at the suffix too except position k, where the
+    # one-hot of prev action still feeds in — the reference also feeds
+    # last_action across episode boundaries; only the LSTM state resets.
+    out_a, _ = agent.apply(params, actions, env_a, agent.initial_state(b))
+    out_b, _ = agent.apply(params, actions, env_b, agent.initial_state(b))
+    np.testing.assert_allclose(
+        np.asarray(out_a.policy_logits[k:]),
+        np.asarray(out_b.policy_logits[k:]), rtol=1e-5, atol=1e-5)
+    # And the prefix DID differ (sanity that the test can fail).
+    assert np.abs(np.asarray(out_a.policy_logits[:k]) -
+                  np.asarray(out_b.policy_logits[:k])).max() > 1e-4
+
+  def test_no_done_states_flow(self):
+    """Without done, the carry must flow (outputs depend on the prefix)."""
+    agent = ImpalaAgent(num_actions=NUM_ACTIONS, torso='shallow')
+    params = init_params(agent, jax.random.PRNGKey(0), OBS_SPEC)
+    t, b = 6, 2
+    env_a = _make_env_outputs(np.random.RandomState(3), t, b)
+    env_b = _make_env_outputs(np.random.RandomState(99), t, b)
+    k = 3
+    env_b = jax.tree_util.tree_map(
+        lambda x_b, x_a: jnp.concatenate([x_b[:k], x_a[k:]], axis=0),
+        env_b, env_a)
+    actions = jnp.zeros((t, b), jnp.int32)
+    out_a, _ = agent.apply(params, actions, env_a, agent.initial_state(b))
+    out_b, _ = agent.apply(params, actions, env_b, agent.initial_state(b))
+    assert np.abs(np.asarray(out_a.policy_logits[k:]) -
+                  np.asarray(out_b.policy_logits[k:])).max() > 1e-6
+
+
+class TestInstruction:
+
+  def test_hash_stable_and_padded(self):
+    ids = hash_instruction('go to the red balloon')
+    ids2 = hash_instruction('go to the red balloon')
+    np.testing.assert_array_equal(ids, ids2)
+    assert ids.shape == (MAX_INSTRUCTION_LEN,)
+    assert (ids[:5] > 0).all() and (ids[5:] == 0).all()
+
+  def test_empty_instruction_encodes_to_zero(self):
+    enc = InstructionEncoder()
+    ids = jnp.zeros((2, MAX_INSTRUCTION_LEN), jnp.int32)
+    params = enc.init(jax.random.PRNGKey(0), ids)
+    out = enc.apply(params, ids)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+  def test_padding_does_not_change_encoding(self):
+    """Encoding of [7,8,9] padded to L=16 == encoding at L=3 exactly —
+    i.e. the module gathers at the last non-pad position rather than
+    taking the final LSTM output (params are L-independent, so the same
+    params apply to both lengths)."""
+    enc = InstructionEncoder()
+    ids_a = np.zeros((1, MAX_INSTRUCTION_LEN), np.int32)
+    ids_a[0, :3] = [7, 8, 9]
+    params = enc.init(jax.random.PRNGKey(0), jnp.asarray(ids_a))
+    out_padded = enc.apply(params, jnp.asarray(ids_a))
+    out_short = enc.apply(params, jnp.asarray(ids_a[:, :3]))
+    np.testing.assert_allclose(np.asarray(out_padded),
+                               np.asarray(out_short), rtol=1e-6)
+
+  def test_agent_without_instruction(self):
+    agent = ImpalaAgent(num_actions=NUM_ACTIONS, torso='shallow',
+                        use_instruction=False)
+    params = init_params(agent, jax.random.PRNGKey(0), OBS_SPEC)
+    env = _make_env_outputs(np.random.RandomState(0), 3, 2)
+    out, _ = agent.apply(params, jnp.zeros((3, 2), jnp.int32), env,
+                         agent.initial_state(2))
+    assert out.policy_logits.shape == (3, 2, NUM_ACTIONS)
+
+
+class TestDtype:
+
+  def test_bfloat16_compute_keeps_f32_interface(self):
+    agent = ImpalaAgent(num_actions=NUM_ACTIONS, torso='shallow',
+                        dtype=jnp.bfloat16)
+    params = init_params(agent, jax.random.PRNGKey(0), OBS_SPEC)
+    env = _make_env_outputs(np.random.RandomState(0), 3, 2)
+    out, state = agent.apply(params, jnp.zeros((3, 2), jnp.int32), env,
+                             agent.initial_state(2))
+    assert out.policy_logits.dtype == jnp.float32
+    assert out.baseline.dtype == jnp.float32
+    assert state[0].dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(out.policy_logits)))
