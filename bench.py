@@ -1,0 +1,82 @@
+"""Benchmark: learner env-frames/sec on one chip, flagship config.
+
+Measures the jitted IMPALA train step (deep ResNet, T=100, B=32,
+DMLab 72x96 frames, bfloat16 compute) and reports env-frames/sec in the
+reference's unit: batch * unroll * num_action_repeats frames per SGD
+step (reference: experiment.py ≈L390; BASELINE.md unit convention).
+
+vs_baseline: BASELINE.json's north star is >=200k env-frames/sec on a
+v5e-16 ⇒ 12,500 frames/sec/chip. vs_baseline = value / 12500.
+
+Prints ONE JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+  # BENCH_SMOKE=1: tiny shapes on CPU — validates bench mechanics in CI
+  # without the chip. The driver runs the real thing (no env var, TPU).
+  smoke = os.environ.get('BENCH_SMOKE') == '1'
+  if smoke:
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+  import jax
+  import jax.numpy as jnp
+  from scalable_agent_tpu import learner as learner_lib
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.models import ImpalaAgent, init_params
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+  from scalable_agent_tpu.testing import make_example_batch
+
+  num_actions = 9  # DMLab DEFAULT_ACTION_SET
+  cfg = Config(batch_size=32 if not smoke else 2,
+               unroll_length=100 if not smoke else 4,
+               num_action_repeats=4,
+               total_environment_frames=int(1e9),
+               torso='deep', compute_dtype='bfloat16')
+  t1, b = cfg.unroll_length + 1, cfg.batch_size
+  h, w = (72, 96) if not smoke else (24, 32)
+
+  agent = ImpalaAgent(num_actions=num_actions, torso=cfg.torso,
+                      dtype=jnp.bfloat16)
+  obs_spec = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+  params = init_params(agent, jax.random.PRNGKey(0), obs_spec)
+
+  batch = make_example_batch(t1, b, h, w, num_actions,
+                             MAX_INSTRUCTION_LEN, done_prob=0.01)
+
+  state = learner_lib.make_train_state(params, cfg)
+  train_step = learner_lib.make_train_step(agent, cfg)
+
+  # Warmup / compile.
+  state, metrics = train_step(state, batch)
+  jax.block_until_ready(metrics['total_loss'])
+
+  # Timed: steps chain on the donated state; one sync at the end.
+  n = 20 if not smoke else 3
+  t0 = time.perf_counter()
+  for _ in range(n):
+    state, metrics = train_step(state, batch)
+  jax.block_until_ready(metrics['total_loss'])
+  dt = (time.perf_counter() - t0) / n
+
+  frames_per_step = cfg.frames_per_step
+  fps = frames_per_step / dt
+  baseline_per_chip = 200_000.0 / 16.0  # north star / v5e-16 chips
+  print(json.dumps({
+      'metric': 'learner_env_frames_per_sec_per_chip',
+      'value': round(fps, 1),
+      'unit': ('env-frames/sec (deep ResNet, T=%d, B=%d, bf16, 1 chip%s)'
+               % (cfg.unroll_length, b, ', SMOKE' if smoke else '')),
+      'vs_baseline': round(fps / baseline_per_chip, 3),
+  }))
+
+
+if __name__ == '__main__':
+  main()

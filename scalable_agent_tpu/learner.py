@@ -161,14 +161,11 @@ def make_train_state(params, config: Config) -> TrainState:
       update_steps=jnp.zeros((), jnp.int32))
 
 
-def make_train_step(agent, config: Config):
-  """Build the jitted train step: (TrainState, batch) → (state, metrics).
-
-  `batch` is an ActorOutput pytree of [T+1, B] time-major arrays (plus
-  agent_state [B, ...]). Donates the state for in-place HBM update.
-  """
+def make_train_step_fn(agent, config: Config):
+  """The raw (unjitted) train step: (TrainState, batch) → (state,
+  metrics). Single source of truth — jitted plain here and with explicit
+  shardings in parallel/train_parallel.py."""
   optimizer = make_optimizer(config)
-
   schedule = make_schedule(config)
 
   def train_step(state: TrainState, batch: ActorOutput):
@@ -184,4 +181,11 @@ def make_train_step(agent, config: Config):
     metrics['learning_rate'] = schedule(state.update_steps)
     return new_state, metrics
 
-  return jax.jit(train_step, donate_argnums=(0,))
+  return train_step
+
+
+def make_train_step(agent, config: Config):
+  """Jitted single-device train step; donates the state for in-place
+  HBM update. `batch` is an ActorOutput pytree of [T+1, B] time-major
+  arrays (plus agent_state [B, ...])."""
+  return jax.jit(make_train_step_fn(agent, config), donate_argnums=(0,))

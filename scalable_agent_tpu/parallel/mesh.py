@@ -1,0 +1,114 @@
+"""Device mesh construction and sharding rules.
+
+The reference scales out with TF1 gRPC: variables pinned to the learner,
+actors enqueueing to a learner-hosted FIFOQueue (reference: experiment.py
+`train()` ≈L435–460, SURVEY §5.8). The TPU-native design replaces all of
+that with an explicit `jax.sharding.Mesh` and XLA collectives:
+
+- **data axis (DP)**: the learner batch dim is sharded across chips;
+  gradient reduction is an XLA `psum` over ICI inserted automatically by
+  `jit` — this is the BASELINE.json north star (multi-learner sync
+  without parameter servers).
+- **model axis (TP)**: wide Dense/LSTM kernels can shard their output
+  dim; at IMPALA's model sizes this is optional headroom, wired here so
+  the mechanism is real and tested (SURVEY §2.b).
+- **Pipeline / expert parallelism**: not applicable to this model family
+  (no layer pipeline depth worth cutting, no MoE — SURVEY §2.b marks
+  both "explicitly absent" in the reference too).
+- **Sequence parallelism**: the V-trace recursion is a linear scan and
+  the LSTM is sequential; long-T scaling rides the associative-scan
+  V-trace form (vtrace.py) rather than ring attention (no attention in
+  the model family — SURVEY §5.7).
+
+Multi-host: `jax.distributed.initialize()` + the same mesh spanning all
+processes; trajectory transport stays host-local per learner shard while
+gradients ride ICI/DCN via the same psum.
+"""
+
+import re
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = 'data'
+MODEL_AXIS = 'model'
+
+
+def make_mesh(devices=None, model_parallelism: int = 1) -> Mesh:
+  """Build a (data, model) mesh over the given (default: all) devices."""
+  devices = devices if devices is not None else jax.devices()
+  n = len(devices)
+  if n % model_parallelism != 0:
+    raise ValueError(
+        f'{n} devices not divisible by model_parallelism='
+        f'{model_parallelism}')
+  grid = np.asarray(devices).reshape(n // model_parallelism,
+                                     model_parallelism)
+  return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+# Parameter sharding rules: regex on the flattened param path → spec.
+# Anonymous Dense kernels (torso projections) shard their output
+# features over the model axis; the named heads (policy_logits,
+# baseline) and everything else stay replicated — heads are tiny and
+# their outputs feed cross-replica math. Rules are deliberately few and
+# auditable; at IMPALA scale TP is headroom, not a necessity.
+_PARAM_RULES = (
+    (re.compile(r'.*Dense_\d+/kernel$'), P(None, MODEL_AXIS)),
+    (re.compile(r'.*Dense_\d+/bias$'), P(MODEL_AXIS)),
+)
+
+
+def param_spec(path: str, enable_tp: bool) -> P:
+  if enable_tp:
+    for pattern, spec in _PARAM_RULES:
+      if pattern.match(path):
+        return spec
+  return P()
+
+
+def param_shardings(params, mesh: Mesh, enable_tp: bool = False):
+  """NamedShardings for a param pytree (TP on Dense kernels if asked)."""
+
+  def path_str(kp):
+    return '/'.join(str(getattr(k, 'key', getattr(k, 'idx', k)))
+                    for k in kp)
+
+  def to_sharding(kp, leaf):
+    spec = param_spec(path_str(kp), enable_tp)
+    # Drop axes that don't divide the leaf (e.g. odd feature sizes).
+    if any(s is not None for s in spec):
+      for dim, name in enumerate(spec):
+        if name is not None and (dim >= leaf.ndim or
+                                 leaf.shape[dim] %
+                                 mesh.shape[MODEL_AXIS] != 0):
+          return NamedSharding(mesh, P())
+    return NamedSharding(mesh, spec)
+
+  return jax.tree_util.tree_map_with_path(to_sharding, params)
+
+
+def batch_shardings(batch_pytree, mesh: Mesh):
+  """Shard the learner batch over the data axis.
+
+  Trajectory tensors are time-major [T+1, B, ...] → shard dim 1;
+  level_name/agent_state are [B, ...] → shard dim 0. We key on rank
+  via the structural position: ActorOutput(level_name, agent_state,
+  env_outputs, agent_outputs)."""
+  from scalable_agent_tpu.structs import ActorOutput
+
+  def traj(x):
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+  def lead(x):
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+  return ActorOutput(
+      level_name=lead(None),
+      agent_state=jax.tree_util.tree_map(
+          lambda _: lead(None), batch_pytree.agent_state),
+      env_outputs=jax.tree_util.tree_map(
+          lambda _: traj(None), batch_pytree.env_outputs),
+      agent_outputs=jax.tree_util.tree_map(
+          lambda _: traj(None), batch_pytree.agent_outputs))

@@ -1,0 +1,54 @@
+"""Sharded (multi-chip) training step.
+
+One `jit` over the mesh: batch sharded on the data axis, params
+replicated (or TP-sharded), optimizer state following params. XLA
+inserts the gradient all-reduce (psum over ICI) — no hand-written
+collectives needed for DP, which is the whole point of the design
+(SURVEY §5.8: "gradient/metric reduction = jax.lax.psum over the DP
+mesh axis" — jit's partitioner emits exactly that from these
+shardings).
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from scalable_agent_tpu import learner as learner_lib
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.parallel import mesh as mesh_lib
+
+
+def make_sharded_train_state(params, config: Config, mesh: Mesh,
+                             enable_tp: bool = False):
+  """Place params on the mesh (replicated, or TP-sharded kernels) and
+  build the TrainState there; opt state inherits param placements."""
+  p_shard = mesh_lib.param_shardings(params, mesh, enable_tp)
+  params = jax.tree_util.tree_map(jax.device_put, params, p_shard)
+  return learner_lib.make_train_state(params, config)
+
+
+def make_sharded_train_step(agent, config: Config, mesh: Mesh,
+                            example_batch):
+  """Jit the learner step with explicit in/out shardings over the mesh.
+
+  Returns (train_step, place_batch): `place_batch` device_puts a host
+  batch with the data-axis sharding — the host→device edge of the
+  trajectory transport (the reference's StagingArea role).
+  """
+  train_step = learner_lib.make_train_step_fn(agent, config)
+  batch_shard = mesh_lib.batch_shardings(example_batch, mesh)
+  replicated = NamedSharding(mesh, P())
+
+  jitted = jax.jit(
+      train_step,
+      in_shardings=(None, batch_shard),  # state keeps its placement
+      out_shardings=(None, replicated),
+      donate_argnums=(0,))
+
+  def place_batch(host_batch):
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(np.asarray(x), s),
+        host_batch, batch_shard)
+
+  return jitted, place_batch

@@ -1,0 +1,116 @@
+"""Multi-chip sharding tests on the 8-device virtual CPU mesh.
+
+The reference's distributed story is tested here the TPU way (SURVEY
+§4 "how they test distributed without a cluster" — we do better): the
+actual sharded train step runs over 8 (virtual) devices, and
+DP-sharded training must match single-device training numerically.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalable_agent_tpu import learner as learner_lib
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.models import ImpalaAgent, init_params
+from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+from scalable_agent_tpu.parallel import mesh as mesh_lib
+from scalable_agent_tpu.parallel import train_parallel
+from scalable_agent_tpu.testing import make_example_batch
+
+A = 4
+OBS = {'frame': (24, 32, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+
+
+def _fake_batch(seed, t1, b):
+  h, w, _ = OBS['frame']
+  return make_example_batch(t1, b, h, w, A, OBS['instr_len'],
+                            seed=seed, done_prob=0.1)
+
+
+def test_eight_virtual_devices_present():
+  assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize('model_parallelism', [1, 2])
+def test_mesh_shapes(model_parallelism):
+  mesh = mesh_lib.make_mesh(model_parallelism=model_parallelism)
+  assert mesh.shape[mesh_lib.DATA_AXIS] == 8 // model_parallelism
+  assert mesh.shape[mesh_lib.MODEL_AXIS] == model_parallelism
+
+
+def test_dp_sharded_step_matches_single_device():
+  agent = ImpalaAgent(num_actions=A, torso='shallow')
+  params = init_params(agent, jax.random.PRNGKey(0), OBS)
+  cfg = Config(batch_size=8, unroll_length=4, num_action_repeats=1,
+               total_environment_frames=10**6)
+  batch = _fake_batch(0, 5, 8)
+
+  # Independent param copies: the train steps donate their input state
+  # (and device_put may alias buffers), so the two states must not share.
+  params2 = init_params(agent, jax.random.PRNGKey(0), OBS)
+  state1 = learner_lib.make_train_state(params, cfg)
+  mesh = mesh_lib.make_mesh(model_parallelism=1)
+  state8 = train_parallel.make_sharded_train_state(params2, cfg, mesh)
+
+  step1 = learner_lib.make_train_step(agent, cfg)
+  state1, metrics1 = step1(state1, batch)
+
+  step8, place = train_parallel.make_sharded_train_step(
+      agent, cfg, mesh, batch)
+  state8, metrics8 = step8(state8, place(batch))
+
+  np.testing.assert_allclose(float(metrics1['total_loss']),
+                             float(metrics8['total_loss']),
+                             rtol=2e-4)
+  # Parameters after one update must agree (gradient psum correctness).
+  flat1 = jax.tree_util.tree_leaves(state1.params)
+  flat8 = jax.tree_util.tree_leaves(state8.params)
+  for a_leaf, b_leaf in zip(flat1, flat8):
+    np.testing.assert_allclose(np.asarray(a_leaf), np.asarray(b_leaf),
+                               rtol=5e-4, atol=5e-6)
+
+
+def test_tp_sharded_step_runs_and_matches():
+  """(data=4, model=2) mesh with TP on Dense kernels — same numerics."""
+  agent = ImpalaAgent(num_actions=A, torso='shallow')
+  params = init_params(agent, jax.random.PRNGKey(0), OBS)
+  cfg = Config(batch_size=4, unroll_length=4, num_action_repeats=1,
+               total_environment_frames=10**6)
+  batch = _fake_batch(1, 5, 4)
+
+  params2 = init_params(agent, jax.random.PRNGKey(0), OBS)
+  mesh = mesh_lib.make_mesh(model_parallelism=2)
+  state_tp = train_parallel.make_sharded_train_state(
+      params2, cfg, mesh, enable_tp=True)
+  state1 = learner_lib.make_train_state(params, cfg)
+  step1 = learner_lib.make_train_step(agent, cfg)
+  state1, metrics1 = step1(state1, batch)
+  step_tp, place = train_parallel.make_sharded_train_step(
+      agent, cfg, mesh, batch)
+  state_tp, metrics_tp = step_tp(state_tp, place(batch))
+  np.testing.assert_allclose(float(metrics1['total_loss']),
+                             float(metrics_tp['total_loss']), rtol=2e-4)
+  # Post-update params must also agree — catches TP backward /
+  # gradient-reduction bugs that leave the forward loss untouched.
+  for a_leaf, b_leaf in zip(jax.tree_util.tree_leaves(state1.params),
+                            jax.tree_util.tree_leaves(state_tp.params)):
+    np.testing.assert_allclose(np.asarray(a_leaf), np.asarray(b_leaf),
+                               rtol=5e-4, atol=5e-6)
+
+
+def test_param_sharding_rules():
+  agent = ImpalaAgent(num_actions=A, torso='shallow')
+  params = init_params(agent, jax.random.PRNGKey(0), OBS)
+  mesh = mesh_lib.make_mesh(model_parallelism=2)
+  shardings = mesh_lib.param_shardings(params, mesh, enable_tp=True)
+  flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+  specs = {'/'.join(str(getattr(k, 'key', k)) for k in kp):
+           s.spec for kp, s in flat}
+  # At least one Dense kernel is model-sharded; heads stay replicated.
+  assert any('model' in str(spec) for spec in specs.values()), specs
+  for path, spec in specs.items():
+    if 'policy_logits' in path or 'baseline' in path:
+      assert 'model' not in str(spec)
