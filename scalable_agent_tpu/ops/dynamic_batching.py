@@ -1,0 +1,325 @@
+"""Dynamic batching of concurrent inference calls (Python API).
+
+Reference parity: `dynamic_batching.py` (reference ≈130 LoC — `batch_fn`,
+`batch_fn_with_options(minimum_batch_size, maximum_batch_size,
+timeout_ms)` over the C++ Batcher op, loaded via
+`tf.load_op_library('batcher.so')` ≈L25). Here the native piece is a
+plain C++ shared library (`ops/batcher/batcher.cc`) driven through
+ctypes, and the batched function is any Python callable over numpy
+arrays — in production a jitted JAX policy on TPU.
+
+Threading model (same as the reference): N caller threads block in
+`compute`; ONE computation thread (spawned lazily per decorated fn)
+loops get_batch → f(concatenated inputs) → set_outputs. The reference's
+documented caveat applies unchanged: with dynamic batching, actions
+within one unroll may be computed with different weight versions
+(reference: experiment.py ≈L472 comment).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+_BATCHER_DIR = os.path.join(_THIS_DIR, 'batcher')
+_LIB_PATH = os.path.join(_BATCHER_DIR, 'libbatcher.so')
+
+# Return codes mirroring batcher.cc's enum Rc.
+RC_OK, RC_ERROR, RC_CANCELLED, RC_SHAPE, RC_TOO_BIG, RC_CLOSED, \
+    RC_BAD_ID, RC_SIZE = range(8)
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class BatcherError(RuntimeError):
+  """Computation error propagated from the batched function."""
+
+
+class BatcherCancelled(RuntimeError):
+  """The batcher was closed while this call was in flight."""
+
+
+def _ensure_lib():
+  """Load (building if necessary) libbatcher.so."""
+  global _lib
+  with _lib_lock:
+    if _lib is not None:
+      return _lib
+    # Always invoke make: its batcher.cc dependency makes a fresh build
+    # a no-op and a stale .so (edited source) gets rebuilt.
+    subprocess.run(['make', '-C', _BATCHER_DIR], check=True,
+                   capture_output=True)
+    lib = ctypes.CDLL(_LIB_PATH)
+    i64 = ctypes.c_longlong
+    p = ctypes.c_void_p
+    lib.batcher_create.restype = p
+    lib.batcher_create.argtypes = [i64, i64, i64, i64]
+    lib.batcher_compute_begin.restype = i64
+    lib.batcher_compute_begin.argtypes = [
+        p, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(i64), i64,
+        ctypes.POINTER(i64)]
+    lib.batcher_compute_wait.restype = i64
+    lib.batcher_compute_wait.argtypes = [p, i64, ctypes.c_char_p, i64]
+    lib.batcher_result_count.restype = i64
+    lib.batcher_result_count.argtypes = [p, i64]
+    lib.batcher_result_size.restype = i64
+    lib.batcher_result_size.argtypes = [p, i64, i64]
+    lib.batcher_result_copy.restype = i64
+    lib.batcher_result_copy.argtypes = [p, i64, i64, ctypes.c_void_p]
+    lib.batcher_request_free.restype = None
+    lib.batcher_request_free.argtypes = [p, i64]
+    lib.batcher_get_batch.restype = i64
+    lib.batcher_get_batch.argtypes = [p, ctypes.POINTER(i64),
+                                      ctypes.POINTER(i64)]
+    lib.batcher_batch_input_copy.restype = i64
+    lib.batcher_batch_input_copy.argtypes = [p, i64, i64,
+                                             ctypes.c_void_p]
+    lib.batcher_set_outputs.restype = i64
+    lib.batcher_set_outputs.argtypes = [
+        p, i64, i64, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(i64), i64]
+    lib.batcher_set_error.restype = i64
+    lib.batcher_set_error.argtypes = [p, i64, ctypes.c_char_p]
+    lib.batcher_close.restype = None
+    lib.batcher_close.argtypes = [p]
+    lib.batcher_destroy.restype = None
+    lib.batcher_destroy.argtypes = [p]
+    _lib = lib
+    return lib
+
+
+def _as_contiguous(arrays) -> List[np.ndarray]:
+  out = []
+  for a in arrays:
+    a = np.asarray(a)
+    # Check BEFORE ascontiguousarray, which silently promotes 0-d to 1-d.
+    if a.ndim < 1:
+      raise ValueError('batched tensors need a leading batch dim; got '
+                       f'scalar of dtype {a.dtype}')
+    out.append(np.ascontiguousarray(a))
+  return out
+
+
+class Batcher:
+  """Low-level handle over the C++ batcher (one input-tensor family).
+
+  Most users want `batch_fn` / `batch_fn_with_options`; this class is
+  the substrate (and what tests drive for out-of-order completion)."""
+
+  def __init__(self, num_tensors: int, minimum_batch_size: int = 1,
+               maximum_batch_size: int = 1024, timeout_ms: int = 100):
+    self._lib = _ensure_lib()
+    self._h = self._lib.batcher_create(
+        minimum_batch_size, maximum_batch_size, timeout_ms, num_tensors)
+    self._num_tensors = num_tensors
+    self._meta_lock = threading.Lock()
+    # dtype/trailing-shape per input tensor, fixed by the first call
+    # (published under the lock before compute_begin; the computation
+    # thread reads after get_batch — the C++ mutex orders the two).
+    self._in_meta: Optional[List] = None
+    self._out_meta: Optional[List] = None
+    self._closed = False
+
+  # -- caller side --
+
+  def compute(self, arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Submit rows, block until the computation thread answers."""
+    arrays = _as_contiguous(arrays)
+    if len(arrays) != self._num_tensors:
+      raise ValueError(
+          f'expected {self._num_tensors} tensors, got {len(arrays)}')
+    rows = arrays[0].shape[0]
+    for a in arrays:
+      if a.shape[0] != rows:
+        raise ValueError('inconsistent leading (batch) dims: '
+                         f'{[x.shape for x in arrays]}')
+    with self._meta_lock:
+      if self._in_meta is None:
+        self._in_meta = [(a.dtype, a.shape[1:]) for a in arrays]
+      else:
+        for a, (dtype, trail) in zip(arrays, self._in_meta):
+          if a.dtype != dtype or a.shape[1:] != trail:
+            raise ValueError(
+                f'tensor mismatch: got {a.dtype}{a.shape[1:]}, '
+                f'expected {dtype}{trail}')
+
+    i64 = ctypes.c_longlong
+    n = self._num_tensors
+    data = (ctypes.c_void_p * n)(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays])
+    row_bytes = (i64 * n)(
+        *[int(np.prod(a.shape[1:], dtype=np.int64)) * a.itemsize
+          for a in arrays])
+    req_id = i64(0)
+    rc = self._lib.batcher_compute_begin(
+        self._h, data, row_bytes, rows, ctypes.byref(req_id))
+    if rc == RC_CLOSED:
+      raise BatcherCancelled('batcher is closed')
+    if rc == RC_TOO_BIG:
+      raise ValueError(f'rows={rows} exceeds maximum_batch_size')
+    if rc == RC_SHAPE:
+      raise ValueError('row byte-size mismatch vs. earlier calls')
+    assert rc == RC_OK, rc
+
+    err = ctypes.create_string_buffer(4096)
+    rc = self._lib.batcher_compute_wait(self._h, req_id, err, 4096)
+    try:
+      if rc == RC_ERROR:
+        raise BatcherError(err.value.decode('utf-8', errors='replace'))
+      if rc == RC_CANCELLED:
+        raise BatcherCancelled('batcher closed while waiting')
+      assert rc == RC_OK, rc
+      with self._meta_lock:
+        out_meta = list(self._out_meta)
+      outs = []
+      for i, (dtype, trail) in enumerate(out_meta):
+        nbytes = self._lib.batcher_result_size(self._h, req_id, i)
+        row_nb = int(np.prod(trail, dtype=np.int64)) * dtype.itemsize
+        out_rows = nbytes // row_nb if row_nb else 0
+        buf = np.empty((out_rows,) + tuple(trail), dtype)
+        if nbytes:
+          self._lib.batcher_result_copy(
+              self._h, req_id, i, buf.ctypes.data_as(ctypes.c_void_p))
+        outs.append(buf)
+      return outs
+    finally:
+      self._lib.batcher_request_free(self._h, req_id)
+
+  # -- computation-thread side --
+
+  def get_batch(self):
+    """Block for the next merged batch → (batch_id, [np arrays]) or
+    None when the batcher is closed and drained."""
+    i64 = ctypes.c_longlong
+    batch_id, total_rows = i64(0), i64(0)
+    rc = self._lib.batcher_get_batch(
+        self._h, ctypes.byref(batch_id), ctypes.byref(total_rows))
+    if rc == RC_CLOSED:
+      return None
+    assert rc == RC_OK, rc
+    with self._meta_lock:
+      in_meta = list(self._in_meta)
+    arrays = []
+    for i, (dtype, trail) in enumerate(in_meta):
+      buf = np.empty((total_rows.value,) + tuple(trail), dtype)
+      rc = self._lib.batcher_batch_input_copy(
+          self._h, batch_id, i, buf.ctypes.data_as(ctypes.c_void_p))
+      if rc != RC_OK:
+        # close() raced us and erased the batch — don't hand the
+        # caller uninitialized memory; treat as shutdown.
+        return None
+      arrays.append(buf)
+    return batch_id.value, arrays
+
+  def set_outputs(self, batch_id: int, arrays: Sequence[np.ndarray]):
+    arrays = _as_contiguous([np.asarray(a) for a in arrays])
+    rows = arrays[0].shape[0]
+    for a in arrays:
+      if a.shape[0] != rows:
+        raise ValueError('inconsistent output batch dims: '
+                         f'{[x.shape for x in arrays]}')
+    with self._meta_lock:
+      self._out_meta = [(a.dtype, a.shape[1:]) for a in arrays]
+    i64 = ctypes.c_longlong
+    n = len(arrays)
+    data = (ctypes.c_void_p * n)(
+        *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays])
+    row_bytes = (i64 * n)(
+        *[int(np.prod(a.shape[1:], dtype=np.int64)) * a.itemsize
+          for a in arrays])
+    rc = self._lib.batcher_set_outputs(
+        self._h, batch_id, n, data, row_bytes, rows)
+    if rc == RC_SIZE:
+      raise ValueError('output rows do not match the batch rows')
+    if rc not in (RC_OK, RC_BAD_ID):  # BAD_ID: batch cancelled by close
+      raise RuntimeError(f'set_outputs rc={rc}')
+
+  def set_error(self, batch_id: int, message: str):
+    self._lib.batcher_set_error(self._h, batch_id,
+                                message.encode('utf-8'))
+
+  def close(self):
+    if not self._closed:
+      self._closed = True
+      self._lib.batcher_close(self._h)
+
+  def __del__(self):
+    try:
+      if getattr(self, '_h', None):
+        self.close()
+        self._lib.batcher_destroy(self._h)
+        self._h = None
+    except Exception:
+      pass
+
+
+class _BatchedFunction:
+  """A callable wrapping `f` behind a Batcher + computation thread."""
+
+  def __init__(self, f, minimum_batch_size, maximum_batch_size,
+               timeout_ms):
+    self._f = f
+    self._opts = (minimum_batch_size, maximum_batch_size, timeout_ms)
+    self._batcher: Optional[Batcher] = None
+    self._thread: Optional[threading.Thread] = None
+    self._start_lock = threading.Lock()
+    self.__name__ = getattr(f, '__name__', 'batched_fn')
+
+  def _loop(self):
+    while True:
+      item = self._batcher.get_batch()
+      if item is None:
+        return
+      batch_id, arrays = item
+      try:
+        outs = self._f(*arrays)
+        if isinstance(outs, np.ndarray):
+          outs = (outs,)
+        self._batcher.set_outputs(
+            batch_id, [np.asarray(o) for o in outs])
+      except Exception as e:  # propagate to the blocked callers
+        self._batcher.set_error(batch_id, f'{type(e).__name__}: {e}')
+
+  def _ensure_started(self, num_tensors):
+    with self._start_lock:
+      if self._batcher is None:
+        mn, mx, to = self._opts
+        self._batcher = Batcher(num_tensors, mn, mx, to)
+        self._thread = threading.Thread(
+            target=self._loop, name=f'batcher-{self.__name__}',
+            daemon=True)
+        self._thread.start()
+
+  def __call__(self, *arrays):
+    self._ensure_started(len(arrays))
+    outs = self._batcher.compute([np.asarray(a) for a in arrays])
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+  def close(self):
+    with self._start_lock:
+      if self._batcher is not None:
+        self._batcher.close()
+        self._thread.join(timeout=5)
+
+
+def batch_fn_with_options(minimum_batch_size: int = 1,
+                          maximum_batch_size: int = 1024,
+                          timeout_ms: int = 100):
+  """Decorator: merge concurrent calls to `f` into batched calls
+  (reference: dynamic_batching.batch_fn_with_options)."""
+
+  def decorator(f):
+    return _BatchedFunction(f, minimum_batch_size, maximum_batch_size,
+                            timeout_ms)
+
+  return decorator
+
+
+def batch_fn(f):
+  """Decorator with default options (reference: dynamic_batching.batch_fn)."""
+  return _BatchedFunction(f, 1, 1024, 100)

@@ -122,6 +122,33 @@ class Actor:
     self._env.close()
 
 
+def run_actor_loop(actor: Actor, buffer, stop_event) -> None:
+  """Produce unrolls into `buffer` until stopped (thread target).
+
+  Clean-shutdown contract: a closed buffer or a cancelled inference
+  call (batcher closed) while stopping is normal termination, mirroring
+  the reference's closed-pipe → StopIteration convention
+  (py_process.py ≈L72). The same exceptions while NOT stopping are
+  real failures and propagate."""
+  from scalable_agent_tpu.ops.dynamic_batching import BatcherCancelled
+  from scalable_agent_tpu.runtime import ring_buffer
+  try:
+    while not stop_event.is_set():
+      buffer.put(actor.unroll())
+  except (ring_buffer.Closed, BatcherCancelled):
+    if not stop_event.is_set():
+      buffer.close()  # signal the learner instead of stalling silently
+      raise
+  except BaseException:
+    # A real actor failure (bad policy output, env crash): poison the
+    # buffer so the learner's next get raises instead of hanging, then
+    # let the exception surface on this thread.
+    buffer.close()
+    raise
+  finally:
+    actor.close()
+
+
 def batch_unrolls(unrolls):
   """Stack B ActorOutputs into a learner batch: time-major [T+1, B] for
   the trajectory, [B, ...] for level_name/agent_state (no time axis)."""

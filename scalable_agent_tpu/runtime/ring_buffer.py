@@ -1,0 +1,165 @@
+"""Trajectory transport: bounded unroll buffer + device prefetch.
+
+Replaces the reference's learner-hosted `tf.FIFOQueue(capacity=1)` +
+`StagingArea` double-buffer (reference: experiment.py ≈L470, ≈L540–560;
+SURVEY §2.b "async pipeline"):
+
+- `TrajectoryBuffer`: a bounded ring of completed unrolls. Producers
+  (actor threads) block when full — capacity IS the backpressure that
+  bounds policy lag, exactly the reference's capacity-1 queue semantics
+  (lag ≤ capacity + in-flight unroll + staged batch).
+- `BatchPrefetcher`: one thread that assembles [T+1, B] batches and
+  stages the NEXT device batch while the learner trains on the current
+  one (the StagingArea role). `place_fn` is where `jax.device_put` with
+  data-axis shardings happens, so staging overlaps host→HBM transfer
+  with TPU compute.
+
+Episode stats ride inside the trajectories (StepOutputInfo), so there
+is no side channel to drain — consume them from the dequeued batch
+like the reference's learner loop does (≈L590–620).
+"""
+
+import collections
+import threading
+import time
+from typing import Callable, List, Optional
+
+from scalable_agent_tpu.runtime.actor import batch_unrolls
+from scalable_agent_tpu.structs import ActorOutput
+
+
+class Closed(Exception):
+  """The buffer was closed while blocking."""
+
+
+class TrajectoryBuffer:
+  """Bounded FIFO of unrolls with blocking put/get and backpressure."""
+
+  def __init__(self, capacity_unrolls: int):
+    if capacity_unrolls < 1:
+      raise ValueError('capacity must be >= 1')
+    self._capacity = capacity_unrolls
+    self._deque = collections.deque()
+    self._lock = threading.Lock()
+    self._not_full = threading.Condition(self._lock)
+    self._not_empty = threading.Condition(self._lock)
+    self._closed = False
+
+  def put(self, unroll: ActorOutput, timeout: Optional[float] = None):
+    """Block while full (backpressure). Raises Closed after close().
+
+    The timeout bounds TOTAL blocking time (deadline-based — spurious
+    wakeups under contention don't restart the clock)."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    with self._not_full:
+      while len(self._deque) >= self._capacity and not self._closed:
+        remaining = (None if deadline is None
+                     else deadline - time.monotonic())
+        if remaining is not None and remaining <= 0:
+          raise TimeoutError('TrajectoryBuffer.put timed out')
+        self._not_full.wait(remaining)
+      if self._closed:
+        raise Closed()
+      self._deque.append(unroll)
+      self._not_empty.notify()
+
+  def get(self, timeout: Optional[float] = None) -> ActorOutput:
+    """Block while empty. Raises Closed after close() drains. Timeout
+    bounds total blocking time (deadline-based)."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    with self._not_empty:
+      while not self._deque and not self._closed:
+        remaining = (None if deadline is None
+                     else deadline - time.monotonic())
+        if remaining is not None and remaining <= 0:
+          raise TimeoutError('TrajectoryBuffer.get timed out')
+        self._not_empty.wait(remaining)
+      if not self._deque:
+        raise Closed()
+      item = self._deque.popleft()
+      self._not_full.notify()
+      return item
+
+  def get_batch(self, batch_size: int,
+                timeout: Optional[float] = None) -> ActorOutput:
+    """Dequeue `batch_size` unrolls and stack to a [T+1, B] batch
+    (the reference's `dequeue_many` + time-major transpose)."""
+    return batch_unrolls([self.get(timeout) for _ in range(batch_size)])
+
+  def close(self):
+    with self._lock:
+      self._closed = True
+      self._not_full.notify_all()
+      self._not_empty.notify_all()
+
+  def __len__(self):
+    with self._lock:
+      return len(self._deque)
+
+
+class BatchPrefetcher:
+  """Stages the next device batch while the learner consumes the
+  current one (double-buffered HBM prefetch)."""
+
+  def __init__(self, buffer: TrajectoryBuffer, batch_size: int,
+               place_fn: Callable = lambda x: x, depth: int = 1):
+    self._buffer = buffer
+    self._batch_size = batch_size
+    self._place_fn = place_fn
+    self._out = collections.deque()
+    self._lock = threading.Lock()
+    self._ready = threading.Condition(self._lock)
+    self._space = threading.Condition(self._lock)
+    self._depth = depth
+    self._closed = False
+    self._error: Optional[BaseException] = None
+    self._thread = threading.Thread(target=self._loop,
+                                    name='batch-prefetcher', daemon=True)
+    self._thread.start()
+
+  def _loop(self):
+    try:
+      while True:
+        batch = self._buffer.get_batch(self._batch_size)
+        staged = self._place_fn(batch)  # async device_put: overlaps
+        with self._space:
+          while len(self._out) >= self._depth and not self._closed:
+            self._space.wait()
+          if self._closed:
+            return
+          self._out.append(staged)
+          self._ready.notify()
+    except Closed:
+      with self._lock:
+        self._closed = True
+        self._ready.notify_all()
+    except BaseException as e:  # surfaced to the consumer
+      with self._lock:
+        self._error = e
+        self._closed = True
+        self._ready.notify_all()
+
+  def get(self, timeout: Optional[float] = None):
+    deadline = None if timeout is None else time.monotonic() + timeout
+    with self._ready:
+      while not self._out and not self._closed:
+        remaining = (None if deadline is None
+                     else deadline - time.monotonic())
+        if remaining is not None and remaining <= 0:
+          raise TimeoutError('BatchPrefetcher.get timed out')
+        self._ready.wait(remaining)
+      if self._error is not None:
+        raise self._error
+      if not self._out:
+        raise Closed()
+      item = self._out.popleft()
+      self._space.notify()
+      return item
+
+  def close(self):
+    with self._lock:
+      self._closed = True
+      self._ready.notify_all()
+      self._space.notify_all()
+    self._buffer.close()
+    self._thread.join(timeout=5)

@@ -140,7 +140,9 @@ def test_bandit_learning_improves_return():
   for step_i in range(num_updates):
     batch = batch_unrolls([a.unroll() for a in actors])
     state, metrics = train_step(state, batch)
-    params_ref['params'] = state.params  # actors act with fresh weights
+    # Copy: the next train_step donates `state`, which would invalidate
+    # a zero-copy published snapshot (see InferenceServer.update_params).
+    params_ref['params'] = jax.tree_util.tree_map(jnp.copy, state.params)
     if step_i < 10:
       first_rewards.append(mean_reward(batch))
     if step_i >= num_updates - 10:
