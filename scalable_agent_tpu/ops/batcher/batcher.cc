@@ -22,6 +22,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <deque>
 #include <map>
@@ -185,14 +186,20 @@ i64 batcher_result_size(void* h, i64 req_id, i64 tensor_idx) {
   return static_cast<i64>(outs[tensor_idx].size());
 }
 
-i64 batcher_result_copy(void* h, i64 req_id, i64 tensor_idx, void* dst) {
+// Copies at most `capacity` bytes — the caller sizes dst from its own
+// metadata, which can lag the stored output if the batched function's
+// trailing shape varies across batches; never overrun the caller.
+i64 batcher_result_copy(void* h, i64 req_id, i64 tensor_idx, void* dst,
+                        i64 capacity) {
   Batcher* b = H(h);
   std::unique_lock<std::mutex> lock(b->mu);
   auto it = b->requests.find(req_id);
   if (it == b->requests.end()) return RC_BAD_ID;
   auto& outs = it->second.outputs;
   if (tensor_idx < 0 || tensor_idx >= (i64)outs.size()) return RC_BAD_ID;
-  std::memcpy(dst, outs[tensor_idx].data(), outs[tensor_idx].size());
+  i64 size = static_cast<i64>(outs[tensor_idx].size());
+  if (capacity < size) return RC_SIZE;
+  std::memcpy(dst, outs[tensor_idx].data(), size);
   return RC_OK;
 }
 

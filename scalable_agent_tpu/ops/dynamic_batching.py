@@ -70,7 +70,7 @@ def _ensure_lib():
     lib.batcher_result_size.restype = i64
     lib.batcher_result_size.argtypes = [p, i64, i64]
     lib.batcher_result_copy.restype = i64
-    lib.batcher_result_copy.argtypes = [p, i64, i64, ctypes.c_void_p]
+    lib.batcher_result_copy.argtypes = [p, i64, i64, ctypes.c_void_p, i64]
     lib.batcher_request_free.restype = None
     lib.batcher_request_free.argtypes = [p, i64]
     lib.batcher_get_batch.restype = i64
@@ -180,11 +180,21 @@ class Batcher:
       for i, (dtype, trail) in enumerate(out_meta):
         nbytes = self._lib.batcher_result_size(self._h, req_id, i)
         row_nb = int(np.prod(trail, dtype=np.int64)) * dtype.itemsize
+        # out_meta can lag the stored output if the batched function's
+        # trailing shape varies across batches; a partial row means the
+        # snapshot is stale — fail loudly rather than mis-slice.
+        if nbytes and (row_nb == 0 or nbytes % row_nb):
+          raise BatcherError(
+              f'output {i}: stored {nbytes} bytes is not a whole number '
+              f'of rows of shape {tuple(trail)} dtype {dtype} '
+              f'({row_nb} bytes/row) — batched fn output shape varied')
         out_rows = nbytes // row_nb if row_nb else 0
         buf = np.empty((out_rows,) + tuple(trail), dtype)
         if nbytes:
-          self._lib.batcher_result_copy(
-              self._h, req_id, i, buf.ctypes.data_as(ctypes.c_void_p))
+          rc = self._lib.batcher_result_copy(
+              self._h, req_id, i, buf.ctypes.data_as(ctypes.c_void_p),
+              buf.nbytes)
+          assert rc == RC_OK, rc
         outs.append(buf)
       return outs
     finally:

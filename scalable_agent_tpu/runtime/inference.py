@@ -24,7 +24,6 @@ versions" caveat applies — reference ≈L472 comment).
 """
 
 import threading
-from typing import Optional
 
 import numpy as np
 

@@ -1,0 +1,319 @@
+"""Process-hosted Python objects (the reference's py_process, TPU-build).
+
+Runs an arbitrary Python class (typically an environment) in a separate
+OS process — the GIL escape that lets dozens of envs step concurrently —
+and exposes its methods to the host runtime as blocking calls over a
+pipe. Re-expresses the reference's `py_process.py` (reference:
+py_process.py ≈L50–230) without the TF graph: there is no `tf.py_func`
+to wrap because on the TPU build env stepping is host Python already
+(runtime/actor.py); what survives is the process-hosting contract:
+
+- `PyProcess(type_, constructor_kwargs)` + `.proxy.<method>(*args)` —
+  the call is sent over a `multiprocessing.Pipe`, the caller blocks on
+  the reply (reference `_TFProxy.__getattr__` ≈L50).
+- `_tensor_specs(method_name, kwargs, constructor_kwargs)` protocol —
+  classes declare the dtypes/shapes of method results; the parent
+  validates replies against the declaration (the reference needed this
+  to build graph ops; here it is a runtime contract check that keeps
+  fixed-shape numerics the only thing crossing the boundary).
+- Exceptions raised in the constructor or in a method are serialized
+  back (with the remote traceback) and re-raised at the call site
+  (reference ≈L60–80); the worker keeps serving after a method error.
+- A broken/closed pipe raises `ProcessClosed` — the clean-shutdown
+  signal, the reference's `IOError → StopIteration` convention (≈L72).
+- `start_all` / `close_all` start/stop fleets via a thread pool — the
+  reference's `PyProcessHook.begin/end` (≈L190–230) without the session.
+
+Start method: `fork` by default (the reference's multiprocessing
+default on Linux) — workers are numpy-only, so they never touch the
+parent's JAX/TPU state; `spawn` is available for classes that need a
+pristine interpreter.
+"""
+
+import multiprocessing
+import threading
+import traceback
+from multiprocessing.pool import ThreadPool
+
+import numpy as np
+
+
+class ProcessClosed(Exception):
+  """The hosted process's pipe is closed (clean shutdown or death)."""
+
+
+class RemoteError(Exception):
+  """An exception raised inside the hosted process.
+
+  Carries the remote traceback text; the original exception (when
+  picklable) is chained as `__cause__`."""
+
+
+class SpecMismatchError(Exception):
+  """A method reply did not match the class's `_tensor_specs`."""
+
+
+_CLOSE = '__process_close__'
+
+
+def _worker(conn, type_, constructor_kwargs):
+  """Worker loop: construct, then serve (method, args, kwargs) requests."""
+  try:
+    obj = type_(**constructor_kwargs)
+  except Exception as e:  # ctor failure → reported on first proxy call
+    conn.send(('exception', _serialize_error(e)))
+    conn.close()
+    return
+  while True:
+    try:
+      request = conn.recv()
+    except (EOFError, OSError):
+      break  # parent died/closed: fall through to close the object
+    method, args, kwargs = request
+    if method == _CLOSE:
+      try:
+        if hasattr(obj, 'close'):
+          obj.close()
+        conn.send(('ok', None))
+      except Exception as e:
+        conn.send(('exception', _serialize_error(e)))
+      break
+    try:
+      result = getattr(obj, method)(*args, **kwargs)
+      conn.send(('ok', result))
+    except Exception as e:  # keep serving — reference semantics
+      conn.send(('exception', _serialize_error(e)))
+  try:
+    conn.close()
+  except OSError:
+    pass
+
+
+def _serialize_error(e):
+  tb = ''.join(traceback.format_exception(type(e), e, e.__traceback__))
+  try:
+    import pickle
+    pickle.dumps(e)
+    payload = e
+  except Exception:
+    payload = None  # unpicklable exception: text only
+  return (payload, tb)
+
+
+def _validate_specs(result, specs, method):
+  """Recursively check a reply against an ArraySpec pytree (None=skip)."""
+  if specs is None:
+    return
+  if hasattr(specs, 'shape') and hasattr(specs, 'dtype'):
+    arr = np.asarray(result)
+    if tuple(arr.shape) != tuple(specs.shape) or arr.dtype != specs.dtype:
+      raise SpecMismatchError(
+          f'{method}: got shape={arr.shape} dtype={arr.dtype}, '
+          f'spec requires shape={tuple(specs.shape)} dtype={specs.dtype}')
+    return
+  if isinstance(specs, (tuple, list)):
+    if not isinstance(result, (tuple, list)) or len(result) != len(specs):
+      raise SpecMismatchError(
+          f'{method}: reply structure {type(result).__name__}'
+          f'/{len(result) if hasattr(result, "__len__") else "?"} does '
+          f'not match spec structure of length {len(specs)}')
+    for r, s in zip(result, specs):
+      _validate_specs(r, s, method)
+    return
+  raise SpecMismatchError(f'{method}: unsupported spec node {specs!r}')
+
+
+class _Proxy:
+  """Attribute access builds blocking remote calls (reference _TFProxy)."""
+
+  def __init__(self, process):
+    self._process = process
+
+  def __getattr__(self, name):
+    if name.startswith('_'):
+      raise AttributeError(name)
+
+    def call(*args, **kwargs):
+      return self._process._call(name, args, kwargs)
+
+    call.__name__ = name
+    return call
+
+
+class PyProcess:
+  """Hosts an instance of `type_` in a child OS process.
+
+  Args:
+    type_: class to instantiate in the child. If it defines
+      `_tensor_specs(method_name, kwargs, constructor_kwargs)` (static),
+      replies are validated against the returned spec pytree.
+    constructor_kwargs: kwargs for the child-side constructor.
+    context: multiprocessing start method ('fork' default, or 'spawn').
+    validate_specs: disable to skip reply validation (hot-path opt-out).
+  """
+
+  def __init__(self, type_, constructor_kwargs=None, context='fork',
+               validate_specs=True):
+    self._type = type_
+    self._constructor_kwargs = dict(constructor_kwargs or {})
+    self._ctx = multiprocessing.get_context(context)
+    self._validate = validate_specs and hasattr(type_, '_tensor_specs')
+    self._conn = None
+    self._process = None
+    self._lock = threading.Lock()  # pipes are not thread-safe
+    self._closed = False
+
+  @property
+  def proxy(self):
+    return _Proxy(self)
+
+  def start(self):
+    if self._process is not None:
+      raise RuntimeError('already started')
+    self._conn, child_conn = self._ctx.Pipe(duplex=True)
+    self._process = self._ctx.Process(
+        target=_worker,
+        args=(child_conn, self._type, self._constructor_kwargs),
+        daemon=True)
+    self._process.start()
+    child_conn.close()  # parent keeps one end only
+    return self
+
+  def _call(self, method, args, kwargs):
+    with self._lock:
+      if self._closed or self._conn is None:
+        raise ProcessClosed(f'{self._type.__name__} process not running')
+      try:
+        self._conn.send((method, args, kwargs))
+        status, payload = self._conn.recv()
+      except (EOFError, OSError, BrokenPipeError) as e:
+        # A child whose ctor failed sends ('exception', ...) and closes
+        # its end; if it closed before our send, the send raises and the
+        # buffered ctor error would be lost. Drain it so the documented
+        # "ctor failure reported on first proxy call" contract holds
+        # regardless of timing.
+        buffered = self._drain_buffered_reply()
+        if buffered is not None:
+          status, payload = buffered
+        else:
+          raise ProcessClosed(
+              f'{self._type.__name__} process pipe closed') from e
+    if status == 'exception':
+      exc, tb = payload
+      err = RemoteError(
+          f'in hosted {self._type.__name__}.{method}:\n{tb}')
+      if exc is not None:
+        raise err from exc
+      raise err
+    if self._validate:
+      specs = self._type._tensor_specs(method, kwargs,
+                                       self._constructor_kwargs)
+      _validate_specs(payload, specs, f'{self._type.__name__}.{method}')
+    return payload
+
+  def _drain_buffered_reply(self):
+    """Return a reply the child pipelined before dying, if any."""
+    try:
+      if self._conn is not None and self._conn.poll(0):
+        return self._conn.recv()
+    except (EOFError, OSError, BrokenPipeError):
+      pass
+    return None
+
+  def close(self, timeout=5.0):
+    """Ask the child to close() its object and exit; reap the process.
+
+    Idempotent; safe on a child that already died. If a proxy call is
+    blocked on a hung child (it holds the call lock across recv), the
+    graceful path is unreachable — terminate the child instead, which
+    breaks the blocked recv with EOF."""
+    if not self._lock.acquire(timeout=timeout):
+      # A call is in flight against an unresponsive child: kill it.
+      self._closed = True
+      if self._process is not None:
+        self._process.terminate()
+        self._process.join(timeout)
+      return
+    try:
+      if self._closed:
+        return
+      self._closed = True
+      conn, process = self._conn, self._process
+      self._conn = None
+    finally:
+      self._lock.release()
+    if conn is not None:
+      try:
+        conn.send((_CLOSE, (), {}))
+        if conn.poll(timeout):
+          conn.recv()
+      except (EOFError, OSError, BrokenPipeError):
+        pass
+      try:
+        conn.close()
+      except OSError:
+        pass
+    if process is not None:
+      process.join(timeout)
+      if process.is_alive():
+        process.terminate()
+        process.join(timeout)
+
+  @property
+  def running(self):
+    return (self._process is not None and self._process.is_alive()
+            and not self._closed)
+
+
+def start_all(processes):
+  """Start a fleet of PyProcesses (reference PyProcessHook.begin ≈L200).
+
+  Sequential on purpose: `start()` is non-blocking (the child constructs
+  asynchronously), and forking from pool threads is what Python 3.12's
+  multi-threaded-fork warning is about."""
+  processes = list(processes)
+  for p in processes:
+    p.start()
+  return processes
+
+
+def close_all(processes, timeout=5.0, pool_size=None):
+  """Close a fleet concurrently (reference PyProcessHook.end ≈L220)."""
+  processes = list(processes)
+  if not processes:
+    return
+  with ThreadPool(pool_size or len(processes)) as pool:
+    pool.map(lambda p: p.close(timeout), processes)
+
+
+class hosted(object):
+  """Context manager: `with hosted([PyProcess(...), ...]) as procs:` —
+  starts the fleet on enter, closes it on exit (error or not)."""
+
+  def __init__(self, processes):
+    self._processes = list(processes)
+
+  def __enter__(self):
+    return start_all(self._processes)
+
+  def __exit__(self, *exc):
+    close_all(self._processes)
+    return False
+
+
+class ProxyEnv:
+  """Adapts a hosted env's proxy to the `envs.base.Environment` surface
+  so `runtime.actor.Actor` can drive an out-of-process env unchanged."""
+
+  def __init__(self, process: PyProcess):
+    self._process = process
+    self._proxy = process.proxy
+
+  def initial(self):
+    return self._proxy.initial()
+
+  def step(self, action):
+    return self._proxy.step(action)
+
+  def close(self):
+    self._process.close()

@@ -82,9 +82,29 @@ class TrajectoryBuffer:
 
   def get_batch(self, batch_size: int,
                 timeout: Optional[float] = None) -> ActorOutput:
-    """Dequeue `batch_size` unrolls and stack to a [T+1, B] batch
-    (the reference's `dequeue_many` + time-major transpose)."""
-    return batch_unrolls([self.get(timeout) for _ in range(batch_size)])
+    """Dequeue `batch_size` unrolls atomically and stack to a [T+1, B]
+    batch (the reference's `dequeue_many` + time-major transpose).
+
+    Waits until the whole batch is available — a timeout or close
+    mid-wait dequeues NOTHING, so no trajectories are ever dropped.
+    The timeout bounds total blocking (deadline-based)."""
+    if batch_size > self._capacity:
+      raise ValueError(
+          f'batch_size {batch_size} exceeds capacity {self._capacity}: '
+          'get_batch would deadlock (producers block when full)')
+    deadline = None if timeout is None else time.monotonic() + timeout
+    with self._not_empty:
+      while len(self._deque) < batch_size and not self._closed:
+        remaining = (None if deadline is None
+                     else deadline - time.monotonic())
+        if remaining is not None and remaining <= 0:
+          raise TimeoutError('TrajectoryBuffer.get_batch timed out')
+        self._not_empty.wait(remaining)
+      if len(self._deque) < batch_size:  # closed with a partial batch
+        raise Closed()
+      items = [self._deque.popleft() for _ in range(batch_size)]
+      self._not_full.notify_all()
+    return batch_unrolls(items)
 
   def close(self):
     with self._lock:

@@ -1,0 +1,162 @@
+"""Process-hosting contract tests (the reference's py_process_test.py
+coverage, re-specified for the TPU build's runtime/py_process.py):
+arg passing, the `_tensor_specs` protocol, exception propagation from
+constructor and methods, close semantics on clean and error paths,
+fleet lifecycle, and dead-pipe → ProcessClosed."""
+
+import os
+
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.envs import base
+from scalable_agent_tpu.envs.fake import FakeEnv
+from scalable_agent_tpu.runtime import py_process
+from scalable_agent_tpu.runtime.py_process import (
+    ProcessClosed, ProxyEnv, PyProcess, RemoteError, SpecMismatchError)
+
+
+class Calculator:
+  """Arg-passing fixture: returns arrays computed from inputs."""
+
+  def __init__(self, bias=0):
+    self._bias = bias
+
+  def add(self, x, y):
+    return np.asarray(x + y + self._bias, np.int64)
+
+  def pair(self, n):
+    return (np.zeros((n,), np.float32), np.ones((n,), np.int32))
+
+
+class SpeccedZeros:
+  """Declares specs; can be told to violate them."""
+
+  def __init__(self, violate=False):
+    self._violate = violate
+
+  def zeros(self):
+    if self._violate:
+      return np.zeros((3,), np.float64)  # wrong dtype and shape
+    return np.zeros((2,), np.float32)
+
+  @staticmethod
+  def _tensor_specs(method_name, unused_kwargs, unused_ctor_kwargs):
+    if method_name == 'zeros':
+      return base.ArraySpec((2,), np.dtype(np.float32))
+    return None
+
+
+class FailsInCtor:
+
+  def __init__(self):
+    raise ValueError('ctor boom')
+
+
+class FailsInMethod:
+
+  def __init__(self, marker_path=None):
+    self._marker_path = marker_path
+
+  def ok(self):
+    return np.int32(7)
+
+  def boom(self):
+    raise KeyError('method boom')
+
+  def die(self):
+    os._exit(1)  # simulate a crashed env process
+
+  def close(self):
+    if self._marker_path:
+      with open(self._marker_path, 'w') as f:
+        f.write('closed')
+
+
+def test_proxy_arg_passing():
+  p = PyProcess(Calculator, dict(bias=10)).start()
+  try:
+    assert p.proxy.add(1, y=2) == 13
+    zeros, ones = p.proxy.pair(4)
+    np.testing.assert_array_equal(zeros, np.zeros(4, np.float32))
+    np.testing.assert_array_equal(ones, np.ones(4, np.int32))
+  finally:
+    p.close()
+
+
+def test_specs_validated_ok_and_mismatch():
+  ok = PyProcess(SpeccedZeros).start()
+  bad = PyProcess(SpeccedZeros, dict(violate=True)).start()
+  try:
+    np.testing.assert_array_equal(ok.proxy.zeros(),
+                                  np.zeros((2,), np.float32))
+    with pytest.raises(SpecMismatchError):
+      bad.proxy.zeros()
+  finally:
+    ok.close()
+    bad.close()
+
+
+def test_constructor_exception_propagates():
+  p = PyProcess(FailsInCtor).start()
+  try:
+    with pytest.raises(RemoteError, match='ctor boom'):
+      p.proxy.anything()
+  finally:
+    p.close()
+
+
+def test_method_exception_propagates_and_worker_survives():
+  p = PyProcess(FailsInMethod).start()
+  try:
+    with pytest.raises(RemoteError, match='method boom'):
+      p.proxy.boom()
+    # The worker keeps serving after a method error (reference semantics).
+    assert p.proxy.ok() == 7
+  finally:
+    p.close()
+
+
+def test_close_reaches_hosted_object(tmp_path):
+  marker = str(tmp_path / 'closed.txt')
+  p = PyProcess(FailsInMethod, dict(marker_path=marker)).start()
+  assert p.proxy.ok() == 7
+  p.close()
+  assert open(marker).read() == 'closed'
+  p.close()  # idempotent
+
+
+def test_dead_process_raises_process_closed():
+  p = PyProcess(FailsInMethod).start()
+  try:
+    with pytest.raises(ProcessClosed):
+      p.proxy.die()
+    with pytest.raises(ProcessClosed):
+      p.proxy.ok()
+  finally:
+    p.close()
+
+
+def test_fleet_lifecycle():
+  procs = [PyProcess(Calculator, dict(bias=i)) for i in range(4)]
+  with py_process.hosted(procs) as started:
+    assert all(p.running for p in started)
+    assert [int(p.proxy.add(0, 0)) for p in started] == [0, 1, 2, 3]
+  assert not any(p.running for p in procs)
+
+
+def test_proxy_env_runs_fake_env_out_of_process():
+  """A hosted FakeEnv behind ProxyEnv speaks the Environment contract
+  (spec-validated), end to end across the process boundary."""
+  p = PyProcess(FakeEnv, dict(height=8, width=8, episode_length=3)).start()
+  env = ProxyEnv(p)
+  try:
+    frame, instr = env.initial()
+    assert frame.shape == (8, 8, 3) and frame.dtype == np.uint8
+    dones = []
+    for i in range(6):
+      reward, done, obs = env.step(i % 2)
+      dones.append(bool(done))
+    assert dones == [False, False, True, False, False, True]
+  finally:
+    env.close()
