@@ -18,7 +18,7 @@ void* batcher_create(i64, i64, i64, i64);
 i64 batcher_compute_begin(void*, const void**, const i64*, i64, i64*);
 i64 batcher_compute_wait(void*, i64, char*, i64);
 i64 batcher_result_size(void*, i64, i64);
-i64 batcher_result_copy(void*, i64, i64, void*);
+i64 batcher_result_copy(void*, i64, i64, void*, i64);
 void batcher_request_free(void*, i64);
 i64 batcher_get_batch(void*, i64*, i64*);
 i64 batcher_batch_input_copy(void*, i64, i64, void*);
@@ -53,7 +53,7 @@ void caller(void* h, int tid) {
     if (rc == 0) {
       double out = 0;
       assert(batcher_result_size(h, req, 0) == (i64)sizeof(double));
-      batcher_result_copy(h, req, 0, &out);
+      assert(batcher_result_copy(h, req, 0, &out, sizeof(double)) == 0);
       assert(out == v * 2);
       ok_count++;
     } else if (rc == 1) {

@@ -193,11 +193,19 @@ class PyProcess:
         # "ctor failure reported on first proxy call" contract holds
         # regardless of timing.
         buffered = self._drain_buffered_reply()
-        if buffered is not None:
-          status, payload = buffered
-        else:
+        if buffered is None:
           raise ProcessClosed(
               f'{self._type.__name__} process pipe closed') from e
+        status, payload = buffered
+      except Exception as e:
+        # The reply arrived but failed to unpickle (e.g. an exception
+        # class whose __reduce__ pickles but can't reconstruct). The
+        # message was fully consumed, so the pipe is still in sync —
+        # report it as a remote failure instead of leaking a bare
+        # unpickling error with no context.
+        raise RemoteError(
+            f'in hosted {self._type.__name__}.{method}: reply could not '
+            f'be deserialized ({e!r})') from e
     if status == 'exception':
       exc, tb = payload
       err = RemoteError(

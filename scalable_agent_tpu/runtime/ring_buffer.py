@@ -32,6 +32,17 @@ class Closed(Exception):
   """The buffer was closed while blocking."""
 
 
+def _wait_until(cond: threading.Condition, predicate: Callable[[], bool],
+                deadline: Optional[float], what: str):
+  """Wait on `cond` (held) until predicate() or deadline; deadline-based
+  so spurious wakeups under contention don't restart the clock."""
+  while not predicate():
+    remaining = None if deadline is None else deadline - time.monotonic()
+    if remaining is not None and remaining <= 0:
+      raise TimeoutError(f'{what} timed out')
+    cond.wait(remaining)
+
+
 class TrajectoryBuffer:
   """Bounded FIFO of unrolls with blocking put/get and backpressure."""
 
@@ -52,12 +63,9 @@ class TrajectoryBuffer:
     wakeups under contention don't restart the clock)."""
     deadline = None if timeout is None else time.monotonic() + timeout
     with self._not_full:
-      while len(self._deque) >= self._capacity and not self._closed:
-        remaining = (None if deadline is None
-                     else deadline - time.monotonic())
-        if remaining is not None and remaining <= 0:
-          raise TimeoutError('TrajectoryBuffer.put timed out')
-        self._not_full.wait(remaining)
+      _wait_until(self._not_full,
+                  lambda: len(self._deque) < self._capacity or self._closed,
+                  deadline, 'TrajectoryBuffer.put')
       if self._closed:
         raise Closed()
       self._deque.append(unroll)
@@ -68,12 +76,9 @@ class TrajectoryBuffer:
     bounds total blocking time (deadline-based)."""
     deadline = None if timeout is None else time.monotonic() + timeout
     with self._not_empty:
-      while not self._deque and not self._closed:
-        remaining = (None if deadline is None
-                     else deadline - time.monotonic())
-        if remaining is not None and remaining <= 0:
-          raise TimeoutError('TrajectoryBuffer.get timed out')
-        self._not_empty.wait(remaining)
+      _wait_until(self._not_empty,
+                  lambda: self._deque or self._closed,
+                  deadline, 'TrajectoryBuffer.get')
       if not self._deque:
         raise Closed()
       item = self._deque.popleft()
@@ -82,28 +87,32 @@ class TrajectoryBuffer:
 
   def get_batch(self, batch_size: int,
                 timeout: Optional[float] = None) -> ActorOutput:
-    """Dequeue `batch_size` unrolls atomically and stack to a [T+1, B]
-    batch (the reference's `dequeue_many` + time-major transpose).
+    """Dequeue `batch_size` unrolls and stack to a [T+1, B] batch (the
+    reference's `dequeue_many` + time-major transpose).
 
-    Waits until the whole batch is available — a timeout or close
-    mid-wait dequeues NOTHING, so no trajectories are ever dropped.
+    Accumulates incrementally — dequeued unrolls free producer slots
+    immediately, so `batch_size > capacity` works exactly like the
+    reference's capacity-1 FIFOQueue feeding `dequeue_many(batch)`.
+    On timeout or close with a partial batch, the accumulated unrolls
+    are pushed back to the FRONT of the queue (FIFO order preserved),
+    so no trajectories are ever dropped.
     The timeout bounds total blocking (deadline-based)."""
-    if batch_size > self._capacity:
-      raise ValueError(
-          f'batch_size {batch_size} exceeds capacity {self._capacity}: '
-          'get_batch would deadlock (producers block when full)')
     deadline = None if timeout is None else time.monotonic() + timeout
+    items: List[ActorOutput] = []
     with self._not_empty:
-      while len(self._deque) < batch_size and not self._closed:
-        remaining = (None if deadline is None
-                     else deadline - time.monotonic())
-        if remaining is not None and remaining <= 0:
-          raise TimeoutError('TrajectoryBuffer.get_batch timed out')
-        self._not_empty.wait(remaining)
-      if len(self._deque) < batch_size:  # closed with a partial batch
-        raise Closed()
-      items = [self._deque.popleft() for _ in range(batch_size)]
-      self._not_full.notify_all()
+      try:
+        while len(items) < batch_size:
+          _wait_until(self._not_empty,
+                      lambda: self._deque or self._closed,
+                      deadline, 'TrajectoryBuffer.get_batch')
+          if not self._deque:  # closed and drained: partial batch
+            raise Closed()
+          while self._deque and len(items) < batch_size:
+            items.append(self._deque.popleft())
+          self._not_full.notify_all()
+      except (TimeoutError, Closed):
+        self._deque.extendleft(reversed(items))
+        raise
     return batch_unrolls(items)
 
   def close(self):

@@ -1,0 +1,73 @@
+"""Tests for DMLab-30 metadata + human-normalized scoring (SURVEY §2.13)."""
+
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.envs import dmlab30
+
+
+def test_level_table_shape():
+  assert len(dmlab30.LEVEL_MAPPING) == 30
+  assert len(dmlab30.ALL_LEVELS) == 30
+  # Only the two rooms_*_train levels map to distinct test variants.
+  diffs = [k for k, v in dmlab30.LEVEL_MAPPING.items() if k != v]
+  assert diffs == ['rooms_collect_good_objects_train',
+                   'rooms_exploit_deferred_effects_train']
+  # Every test level has both anchor scores.
+  for test_level in dmlab30.LEVEL_MAPPING.values():
+    assert test_level in dmlab30.HUMAN_SCORES
+    assert test_level in dmlab30.RANDOM_SCORES
+    assert (dmlab30.HUMAN_SCORES[test_level]
+            > dmlab30.RANDOM_SCORES[test_level])
+
+
+def test_score_at_anchors():
+  # Returns exactly at the random anchor -> 0; at the human anchor -> 100.
+  random_returns = {
+      l: [dmlab30.RANDOM_SCORES[dmlab30.LEVEL_MAPPING[l]]]
+      for l in dmlab30.ALL_LEVELS}
+  human_returns = {
+      l: [dmlab30.HUMAN_SCORES[dmlab30.LEVEL_MAPPING[l]]]
+      for l in dmlab30.ALL_LEVELS}
+  assert dmlab30.compute_human_normalized_score(random_returns) == (
+      pytest.approx(0.0, abs=1e-9))
+  assert dmlab30.compute_human_normalized_score(human_returns) == (
+      pytest.approx(100.0, abs=1e-9))
+
+
+def test_per_level_cap():
+  # One superhuman level; cap=100 clips it, no-cap exceeds it.
+  returns = {
+      l: [dmlab30.HUMAN_SCORES[dmlab30.LEVEL_MAPPING[l]]]
+      for l in dmlab30.ALL_LEVELS}
+  lvl = dmlab30.ALL_LEVELS[0]
+  test_lvl = dmlab30.LEVEL_MAPPING[lvl]
+  human, random = dmlab30.HUMAN_SCORES[test_lvl], dmlab30.RANDOM_SCORES[test_lvl]
+  returns[lvl] = [random + 2.0 * (human - random)]  # 200% on this level
+  uncapped = dmlab30.compute_human_normalized_score(returns)
+  capped = dmlab30.compute_human_normalized_score(returns, per_level_cap=100)
+  assert uncapped == pytest.approx(100.0 + 100.0 / 30.0)
+  assert capped == pytest.approx(100.0)
+
+
+def test_mean_of_multiple_episodes():
+  returns = {
+      l: [dmlab30.RANDOM_SCORES[dmlab30.LEVEL_MAPPING[l]]]
+      for l in dmlab30.ALL_LEVELS}
+  lvl = dmlab30.ALL_LEVELS[3]
+  test_lvl = dmlab30.LEVEL_MAPPING[lvl]
+  human, random = dmlab30.HUMAN_SCORES[test_lvl], dmlab30.RANDOM_SCORES[test_lvl]
+  # Two episodes averaging to the human anchor -> that level scores 100.
+  returns[lvl] = [random, 2.0 * human - random]
+  score = dmlab30.compute_human_normalized_score(returns)
+  assert score == pytest.approx(100.0 / 30.0)
+
+
+def test_missing_level_raises():
+  returns = {
+      l: [1.0] for l in dmlab30.ALL_LEVELS[:-1]}
+  with pytest.raises(ValueError, match='Missing returns'):
+    dmlab30.compute_human_normalized_score(returns)
+  returns[dmlab30.ALL_LEVELS[-1]] = []
+  with pytest.raises(ValueError, match='Missing returns'):
+    dmlab30.compute_human_normalized_score(returns)

@@ -73,6 +73,46 @@ class TestTrajectoryBuffer:
     with pytest.raises(Closed):
       buf.get()
 
+  def test_get_batch_larger_than_capacity_streams(self):
+    # The reference's capacity-1 FIFOQueue feeds dequeue_many(batch):
+    # dequeues free producer slots incrementally, so batch > capacity
+    # must work (no atomic-residency requirement).
+    from scalable_agent_tpu.structs import ActorOutput
+    buf = TrajectoryBuffer(capacity_unrolls=1)
+    T, B = 4, 3
+
+    def mk(i):
+      return ActorOutput(
+          level_name=np.int32(0),
+          agent_state=np.full((1, 2), i, np.float32),
+          env_outputs=np.full((T,), i, np.float32),
+          agent_outputs=np.full((T,), i, np.float32))
+
+    def producer():
+      for i in range(B):
+        buf.put(mk(i))
+
+    tp = threading.Thread(target=producer)
+    tp.start()
+    batch = buf.get_batch(B, timeout=10)
+    tp.join(timeout=5)
+    assert batch.env_outputs.shape == (T, B)
+    np.testing.assert_array_equal(batch.env_outputs[0], [0, 1, 2])
+    assert batch.agent_state.shape == (B, 2)
+
+  def test_get_batch_timeout_drops_nothing(self):
+    from scalable_agent_tpu.structs import ActorOutput
+    buf = TrajectoryBuffer(capacity_unrolls=4)
+    item = ActorOutput(np.int32(7), np.zeros((1, 2), np.float32),
+                       np.zeros((4,), np.float32),
+                       np.zeros((4,), np.float32))
+    buf.put(item)
+    with pytest.raises(TimeoutError):
+      buf.get_batch(2, timeout=0.05)  # partial: pushed back, not lost
+    assert len(buf) == 1
+    got = buf.get()
+    assert got.level_name == 7
+
   def test_close_wakes_blocked_consumer(self):
     buf = TrajectoryBuffer(capacity_unrolls=1)
     states = []
