@@ -1,0 +1,103 @@
+"""Checkpoint / resume (Orbax-backed).
+
+Reference semantics (reference: experiment.py ≈L570
+`MonitoredTrainingSession(checkpoint_dir=logdir, save_checkpoint_secs=600)`;
+SURVEY §5.4): periodically save ALL global state — network params,
+optimizer slots, and the environment-frame counter — and restore the
+latest on startup. Actor-local state (LSTM carries, env state) is
+intentionally NOT checkpointed: unrolls straddling a restart are lost,
+exactly as upstream.
+
+The TPU build checkpoints the whole `learner.TrainState` pytree
+(params, opt_state, update_steps) via Orbax. `update_steps` × frames
+per step reproduces the reference's `num_environment_frames` global
+step. Sharded (multi-chip) states round-trip: Orbax records shardings
+and restores to the same placements when given the live state as the
+abstract target.
+"""
+
+import os
+import time
+from typing import Optional
+
+import jax
+
+import orbax.checkpoint as ocp
+
+from scalable_agent_tpu.learner import TrainState
+
+
+class Checkpointer:
+  """Thin lifecycle wrapper over an Orbax CheckpointManager.
+
+  Args:
+    directory: checkpoint root (the reference's --logdir).
+    max_to_keep: retained checkpoints (oldest pruned).
+    save_interval_secs: wall-clock throttle — `maybe_save` is a no-op
+      until this many seconds passed since the last save (reference
+      save_checkpoint_secs=600).
+  """
+
+  def __init__(self, directory: str, max_to_keep: int = 3,
+               save_interval_secs: float = 600.0):
+    self._directory = os.path.abspath(directory)
+    os.makedirs(self._directory, exist_ok=True)
+    self._manager = ocp.CheckpointManager(
+        self._directory,
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True))
+    self._save_interval_secs = save_interval_secs
+    self._last_save_time: Optional[float] = None
+
+  def save(self, state: TrainState, step: Optional[int] = None,
+           force: bool = False) -> bool:
+    """Save now. `step` defaults to the state's own update counter.
+
+    Returns whether a checkpoint was actually written — Orbax silently
+    skips a step it already saved; the throttle clock only resets on a
+    real write so `maybe_save` stays truthful."""
+    if step is None:
+      step = int(jax.device_get(state.update_steps))
+    saved = bool(self._manager.save(
+        step, args=ocp.args.StandardSave(state), force=force))
+    if saved:
+      self._last_save_time = time.monotonic()
+    return saved
+
+  def maybe_save(self, state: TrainState,
+                 step: Optional[int] = None) -> bool:
+    """Save iff the save interval elapsed (call freely from the learner
+    loop). The first call after construction starts the clock rather
+    than saving, matching the reference's every-N-seconds hook."""
+    now = time.monotonic()
+    if self._last_save_time is None:
+      self._last_save_time = now
+      return False
+    if now - self._last_save_time < self._save_interval_secs:
+      return False
+    return self.save(state, step)
+
+  def latest_step(self) -> Optional[int]:
+    return self._manager.latest_step()
+
+  def restore_latest(self, target: TrainState) -> Optional[TrainState]:
+    """Restore the most recent checkpoint, or None if none exists.
+
+    `target` is a concrete (or abstract shape/dtype/sharding) TrainState
+    matching the saved structure — build it with `make_train_state` on
+    the right mesh first; restored arrays land on the same placements.
+    """
+    step = self._manager.latest_step()
+    if step is None:
+      return None
+    abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                      target)
+    return self._manager.restore(
+        step, args=ocp.args.StandardRestore(abstract))
+
+  def wait_until_finished(self):
+    self._manager.wait_until_finished()
+
+  def close(self):
+    self._manager.wait_until_finished()
+    self._manager.close()

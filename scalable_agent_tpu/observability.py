@@ -1,0 +1,172 @@
+"""Observability: throughput meter, episode stats, summaries.
+
+The reference has three channels (SURVEY §5.5): tf.summary scalars from
+build_learner, manual per-episode tf.Summary protos from the learner
+Python loop, and tf.logging text. Episode statistics travel THROUGH the
+graph as `StepOutputInfo` — no side channel (reference: environments.py
+≈L165–190; experiment.py ≈L590–620). This module keeps that design: the
+learner loop hands each dequeued batch to `EpisodeStats.extract`, which
+reads finished episodes straight out of the trajectory pytree.
+
+What the reference lacks and BASELINE demands is a first-class
+frames/sec meter (SURVEY §5.1) — `FpsMeter` here is the north-star
+metric source.
+
+Summaries are JSONL events (one object per line: wall_time, step, tag,
+value) — greppable, plotter-friendly, no TensorBoard dependency.
+"""
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from scalable_agent_tpu.envs import dmlab30
+
+
+class SummaryWriter:
+  """Append-only JSONL scalar writer (thread-safe)."""
+
+  def __init__(self, logdir: str, filename: str = 'summaries.jsonl'):
+    os.makedirs(logdir, exist_ok=True)
+    self._path = os.path.join(logdir, filename)
+    self._file = open(self._path, 'a', buffering=1)
+    self._lock = threading.Lock()
+
+  @property
+  def path(self):
+    return self._path
+
+  def scalar(self, tag: str, value, step: int):
+    event = {'wall_time': round(time.time(), 3), 'step': int(step),
+             'tag': tag, 'value': float(value)}
+    with self._lock:
+      self._file.write(json.dumps(event) + '\n')
+
+  def scalars(self, values: Dict[str, float], step: int):
+    for tag, value in values.items():
+      self.scalar(tag, value, step)
+
+  def close(self):
+    with self._lock:
+      self._file.close()
+
+
+class FpsMeter:
+  """Environment-frames/sec over a sliding window of learner steps.
+
+  Frames unit matches the reference's global step: env frames AFTER
+  action repeat (experiment.py ≈L390; SURVEY §6 measurement definition).
+  """
+
+  def __init__(self, window_secs: float = 30.0):
+    self._window_secs = window_secs
+    self._events = collections.deque()  # (t, frame_delta)
+    self._total_frames = 0
+    self._start = time.monotonic()
+
+  def update(self, frames: int):
+    now = time.monotonic()
+    self._total_frames += frames
+    self._events.append((now, frames))
+    self._prune(now)
+
+  def _prune(self, now: float):
+    cutoff = now - self._window_secs
+    while self._events and self._events[0][0] < cutoff:
+      self._events.popleft()
+
+  @property
+  def total_frames(self) -> int:
+    return self._total_frames
+
+  def fps(self) -> float:
+    """Rate over the trailing window, anchored at NOW — a stalled
+    learner reads as decaying-to-zero fps, not the last healthy rate."""
+    now = time.monotonic()
+    self._prune(now)
+    span = min(now - self._start, self._window_secs)
+    if span <= 0:
+      return 0.0
+    return sum(delta for _, delta in self._events) / span
+
+
+def extract_episodes(batch) -> List[Tuple[int, float, int]]:
+  """Finished episodes in a dequeued [T+1, B] batch.
+
+  Returns [(level_id, episode_return, episode_frames)]. A done at
+  timestep t>0 marks an episode end whose final stats ride in the
+  OUTPUT info at that step (the FlowEnvironment contract). Timestep 0
+  is the overlap frame — already counted in the previous batch, so
+  skipped exactly like the reference's `done[1:]` (test() ≈L399 and
+  the train loop ≈L590).
+  """
+  done = np.asarray(batch.env_outputs.done)[1:]          # [T, B]
+  returns = np.asarray(batch.env_outputs.info.episode_return)[1:]
+  steps = np.asarray(batch.env_outputs.info.episode_step)[1:]
+  levels = np.asarray(batch.level_name)                  # [B]
+  t_idx, b_idx = np.nonzero(done)
+  return [(int(levels[b]), float(returns[t, b]), int(steps[t, b]))
+          for t, b in zip(t_idx, b_idx)]
+
+
+class EpisodeStats:
+  """Accumulates per-level episode returns and periodic DMLab-30 scores.
+
+  Mirrors the reference learner loop (experiment.py ≈L590–620): every
+  finished episode logs `<level>/episode_return` and
+  `<level>/episode_frames`; in multi-task mode, once EVERY level has at
+  least one finished episode, emit `dmlab30/training_no_cap` and
+  `dmlab30/training_cap_100` human-normalized scores over the per-level
+  means, then reset the accumulator.
+
+  Args:
+    level_names: id → name mapping (actors carry int level ids;
+      strings never enter trajectories).
+    multi_task: enable the dmlab30 scoring path (level_names must then
+      be the 30 training levels).
+  """
+
+  def __init__(self, level_names: List[str], multi_task: bool = False,
+               writer: Optional[SummaryWriter] = None):
+    self._level_names = list(level_names)
+    self._multi_task = multi_task
+    self._writer = writer
+    self._level_returns: Dict[str, List[float]] = {
+        name: [] for name in self._level_names}
+    self.last_scores: Optional[Dict[str, float]] = None
+
+  def record_batch(self, batch, step: int) -> List[Tuple[str, float, int]]:
+    """Extract finished episodes, write summaries, maybe score.
+
+    Returns [(level_name, episode_return, episode_frames)] for logging.
+    """
+    episodes = []
+    for level_id, ep_return, ep_frames in extract_episodes(batch):
+      name = self._level_names[level_id]
+      episodes.append((name, ep_return, ep_frames))
+      self._level_returns.setdefault(name, []).append(ep_return)
+      if self._writer is not None:
+        self._writer.scalar(f'{name}/episode_return', ep_return, step)
+        self._writer.scalar(f'{name}/episode_frames', ep_frames, step)
+    if self._multi_task:
+      self._maybe_score(step)
+    return episodes
+
+  def _maybe_score(self, step: int):
+    if not all(self._level_returns.get(name)
+               for name in self._level_names):
+      return
+    no_cap = dmlab30.compute_human_normalized_score(
+        self._level_returns, per_level_cap=None)
+    cap_100 = dmlab30.compute_human_normalized_score(
+        self._level_returns, per_level_cap=100)
+    self.last_scores = {'dmlab30/training_no_cap': no_cap,
+                        'dmlab30/training_cap_100': cap_100}
+    if self._writer is not None:
+      self._writer.scalars(self.last_scores, step)
+    self._level_returns = {name: [] for name in self._level_names}

@@ -183,29 +183,44 @@ class PyProcess:
     with self._lock:
       if self._closed or self._conn is None:
         raise ProcessClosed(f'{self._type.__name__} process not running')
-      try:
-        self._conn.send((method, args, kwargs))
-        status, payload = self._conn.recv()
-      except (EOFError, OSError, BrokenPipeError) as e:
+      def handle_closed_pipe(e):
         # A child whose ctor failed sends ('exception', ...) and closes
-        # its end; if it closed before our send, the send raises and the
-        # buffered ctor error would be lost. Drain it so the documented
-        # "ctor failure reported on first proxy call" contract holds
-        # regardless of timing.
+        # its end; if it closed before our send/recv, the buffered ctor
+        # error would be lost. Drain it so the documented "ctor failure
+        # reported on first proxy call" contract holds regardless of
+        # timing.
         buffered = self._drain_buffered_reply()
         if buffered is None:
           raise ProcessClosed(
               f'{self._type.__name__} process pipe closed') from e
-        status, payload = buffered
+        return buffered
+
+      reply = None
+      try:
+        self._conn.send((method, args, kwargs))
+      except (EOFError, OSError, BrokenPipeError) as e:
+        reply = handle_closed_pipe(e)
       except Exception as e:
-        # The reply arrived but failed to unpickle (e.g. an exception
-        # class whose __reduce__ pickles but can't reconstruct). The
-        # message was fully consumed, so the pipe is still in sync —
-        # report it as a remote failure instead of leaking a bare
-        # unpickling error with no context.
-        raise RemoteError(
-            f'in hosted {self._type.__name__}.{method}: reply could not '
-            f'be deserialized ({e!r})') from e
+        # send() failed locally (e.g. unpicklable argument) — nothing
+        # reached the child; blame the caller, not the remote side.
+        raise TypeError(
+            f'could not serialize request for '
+            f'{self._type.__name__}.{method}: {e!r}') from e
+      if reply is None:
+        try:
+          reply = self._conn.recv()
+        except (EOFError, OSError, BrokenPipeError) as e:
+          reply = handle_closed_pipe(e)
+        except Exception as e:
+          # The reply arrived but failed to unpickle (e.g. an exception
+          # class whose __reduce__ pickles but can't reconstruct). The
+          # message was fully consumed, so the pipe is still in sync —
+          # report it as a remote failure instead of leaking a bare
+          # unpickling error with no context.
+          raise RemoteError(
+              f'in hosted {self._type.__name__}.{method}: reply could '
+              f'not be deserialized ({e!r})') from e
+      status, payload = reply
     if status == 'exception':
       exc, tb = payload
       err = RemoteError(

@@ -111,7 +111,14 @@ class TrajectoryBuffer:
             items.append(self._deque.popleft())
           self._not_full.notify_all()
       except (TimeoutError, Closed):
+        # Push-back may transiently exceed capacity (up to capacity +
+        # batch_size - 1): keeping trajectories beats the strict lag
+        # bound on this error path; producers stay blocked until the
+        # excess drains. Wake other consumers — the restored items are
+        # consumable (lost-wakeup otherwise).
         self._deque.extendleft(reversed(items))
+        if items:
+          self._not_empty.notify_all()
         raise
     return batch_unrolls(items)
 

@@ -1,0 +1,122 @@
+"""Checkpoint round-trip: save → restore-latest reproduces the full
+TrainState (params, optimizer slots, step counter) — SURVEY §5.4.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalable_agent_tpu import learner as learner_lib
+from scalable_agent_tpu.checkpoint import Checkpointer
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.models import ImpalaAgent, init_params
+from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+from scalable_agent_tpu.testing import make_example_batch
+
+
+@pytest.fixture(scope='module')
+def setup():
+  cfg = Config(batch_size=2, unroll_length=3, torso='shallow',
+               total_environment_frames=10**6)
+  agent = ImpalaAgent(num_actions=4, torso='shallow')
+  params = init_params(agent, jax.random.PRNGKey(0),
+                       {'frame': (24, 32, 3),
+                        'instr_len': MAX_INSTRUCTION_LEN})
+  batch = make_example_batch(cfg.unroll_length + 1, cfg.batch_size,
+                             24, 32, 4, MAX_INSTRUCTION_LEN)
+  return cfg, agent, params, batch
+
+
+def _tree_equal(a, b):
+  flat_a = jax.tree_util.tree_leaves(a)
+  flat_b = jax.tree_util.tree_leaves(b)
+  assert len(flat_a) == len(flat_b)
+  for x, y in zip(flat_a, flat_b):
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_save_restore_roundtrip(setup, tmp_path):
+  cfg, agent, params, batch = setup
+  # Copy: the jitted step donates its state, which aliases the fixture's
+  # params — other tests in this module still need them.
+  params = jax.tree_util.tree_map(jnp.copy, params)
+  train_step = learner_lib.make_train_step(agent, cfg)
+  state = learner_lib.make_train_state(params, cfg)
+  state, _ = train_step(state, batch)
+  state, _ = train_step(state, batch)
+
+  ckpt = Checkpointer(str(tmp_path / 'ckpt'), save_interval_secs=0)
+  ckpt.save(state)
+  ckpt.wait_until_finished()
+  assert ckpt.latest_step() == 2
+
+  # Fresh target state (different values) → restore must overwrite all.
+  params2 = init_params(agent, jax.random.PRNGKey(1),
+                        {'frame': (24, 32, 3),
+                         'instr_len': MAX_INSTRUCTION_LEN})
+  target = learner_lib.make_train_state(params2, cfg)
+  restored = ckpt.restore_latest(target)
+  assert restored is not None
+  _tree_equal(restored, state)
+  assert int(restored.update_steps) == 2
+  ckpt.close()
+
+  # Resume: training continues from the restored state identically.
+  resumed, _ = train_step(restored, batch)
+  again, _ = train_step(state, batch)
+  _tree_equal(resumed.params, again.params)
+
+
+def test_restore_latest_none_when_empty(setup, tmp_path):
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(params, cfg)
+  ckpt = Checkpointer(str(tmp_path / 'empty'))
+  assert ckpt.restore_latest(state) is None
+  assert ckpt.latest_step() is None
+  ckpt.close()
+
+
+def test_maybe_save_throttles(setup, tmp_path):
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(params, cfg)
+  ckpt = Checkpointer(str(tmp_path / 'throttle'),
+                      save_interval_secs=3600)
+  # First call starts the clock, doesn't save.
+  assert not ckpt.maybe_save(state)
+  assert not ckpt.maybe_save(state)
+  assert ckpt.latest_step() is None
+  ckpt.close()
+
+  fast = Checkpointer(str(tmp_path / 'fast'), save_interval_secs=0)
+  assert not fast.maybe_save(state)   # starts clock
+  assert fast.maybe_save(state)       # interval (0s) elapsed
+  fast.wait_until_finished()
+  assert fast.latest_step() == 0
+  fast.close()
+
+
+def test_max_to_keep_prunes(setup, tmp_path):
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(params, cfg)
+  ckpt = Checkpointer(str(tmp_path / 'keep'), max_to_keep=2)
+  for step in (1, 2, 3):
+    ckpt.save(state, step=step, force=True)
+  ckpt.wait_until_finished()
+  assert ckpt.latest_step() == 3
+  restored = ckpt.restore_latest(state)
+  assert restored is not None
+  ckpt.close()
+
+
+def test_save_same_step_twice_reports_skip(setup, tmp_path):
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(params, cfg)
+  ckpt = Checkpointer(str(tmp_path / 'dup'))
+  assert ckpt.save(state, step=5)
+  ckpt.wait_until_finished()
+  assert not ckpt.save(state, step=5)  # orbax skips silently → False
+  ckpt.close()

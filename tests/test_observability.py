@@ -1,0 +1,116 @@
+"""Observability tests: episode extraction from trajectory pytrees
+(the no-side-channel contract, SURVEY §5.5), fps meter, JSONL writer,
+multi-task human-normalized scoring cadence.
+"""
+
+import json
+
+import numpy as np
+
+from scalable_agent_tpu import observability as obs
+from scalable_agent_tpu.envs import dmlab30
+from scalable_agent_tpu.structs import (
+    ActorOutput, AgentOutput, StepOutput, StepOutputInfo)
+
+
+def _batch_with_episodes(t1=5, b=2):
+  """done/info laid out by hand:
+  - column 0: done at timestep 2 with return 3.5, 40 frames;
+  - column 1: done at timestep 0 (overlap frame — must be IGNORED)
+    and at timestep 4 with return -1.0, 8 frames.
+  """
+  done = np.zeros((t1, b), bool)
+  ep_return = np.zeros((t1, b), np.float32)
+  ep_step = np.zeros((t1, b), np.int32)
+  done[2, 0] = True
+  ep_return[2, 0] = 3.5
+  ep_step[2, 0] = 40
+  done[0, 1] = True
+  ep_return[0, 1] = 99.0   # stale stats on the overlap frame
+  done[4, 1] = True
+  ep_return[4, 1] = -1.0
+  ep_step[4, 1] = 8
+  return ActorOutput(
+      level_name=np.array([0, 1], np.int32),
+      agent_state=None,
+      env_outputs=StepOutput(
+          reward=np.zeros((t1, b), np.float32),
+          info=StepOutputInfo(ep_return, ep_step),
+          done=done,
+          observation=None),
+      agent_outputs=AgentOutput(
+          action=np.zeros((t1, b), np.int32),
+          policy_logits=np.zeros((t1, b, 3), np.float32),
+          baseline=np.zeros((t1, b), np.float32)))
+
+
+def test_extract_episodes_skips_overlap_frame():
+  episodes = obs.extract_episodes(_batch_with_episodes())
+  assert (0, 3.5, 40) in episodes
+  assert (1, -1.0, 8) in episodes
+  assert len(episodes) == 2  # the t=0 done was NOT counted
+
+
+def test_episode_stats_writes_summaries(tmp_path):
+  writer = obs.SummaryWriter(str(tmp_path))
+  stats = obs.EpisodeStats(['level_a', 'level_b'], writer=writer)
+  episodes = stats.record_batch(_batch_with_episodes(), step=7)
+  writer.close()
+  assert ('level_a', 3.5, 40) in episodes
+  events = [json.loads(line) for line in open(writer.path)]
+  tags = {e['tag'] for e in events}
+  assert 'level_a/episode_return' in tags
+  assert 'level_b/episode_frames' in tags
+  ret = next(e for e in events if e['tag'] == 'level_a/episode_return')
+  assert ret['value'] == 3.5 and ret['step'] == 7
+
+
+def test_multi_task_scores_emitted_once_all_levels_report(tmp_path):
+  levels = list(dmlab30.ALL_LEVELS)
+  writer = obs.SummaryWriter(str(tmp_path))
+  stats = obs.EpisodeStats(levels, multi_task=True, writer=writer)
+
+  def batch_for(level_id, ep_return):
+    done = np.zeros((2, 1), bool)
+    done[1, 0] = True
+    rets = np.full((2, 1), ep_return, np.float32)
+    return ActorOutput(
+        level_name=np.array([level_id], np.int32),
+        agent_state=None,
+        env_outputs=StepOutput(
+            reward=np.zeros((2, 1), np.float32),
+            info=StepOutputInfo(rets, np.ones((2, 1), np.int32)),
+            done=done,
+            observation=None),
+        agent_outputs=None)
+
+  for i in range(len(levels) - 1):
+    stats.record_batch(batch_for(i, 10.0), step=i)
+    assert stats.last_scores is None  # not all levels reported yet
+  stats.record_batch(batch_for(len(levels) - 1, 10.0), step=99)
+  assert stats.last_scores is not None
+  expected = dmlab30.compute_human_normalized_score(
+      {name: [10.0] for name in levels}, per_level_cap=None)
+  assert np.isclose(stats.last_scores['dmlab30/training_no_cap'],
+                    expected)
+  # Accumulator reset: next single-level episode doesn't re-emit.
+  stats.last_scores = None
+  stats.record_batch(batch_for(0, 10.0), step=100)
+  assert stats.last_scores is None
+  writer.close()
+
+
+def test_fps_meter_counts_and_rates():
+  meter = obs.FpsMeter(window_secs=60)
+  for _ in range(5):
+    meter.update(800)
+  assert meter.total_frames == 4000
+  assert meter.fps() > 0
+
+
+def test_fps_meter_decays_to_zero_on_stall():
+  import time as _time
+  meter = obs.FpsMeter(window_secs=0.05)
+  meter.update(1000)
+  _time.sleep(0.12)
+  assert meter.fps() == 0.0  # stalled: window empty, not last-rate
