@@ -1,0 +1,129 @@
+"""CLI entry point — flag-compatible with the reference's experiment.py.
+
+Flag names mirror the reference (reference: experiment.py ≈L30–75,
+tf.app.flags definitions) so an operator of the reference finds the
+same knobs:
+
+  python experiment.py --mode=train --level_name=explore_goal_locations_small \
+      --num_actors=48 --batch_size=32 --total_environment_frames=1000000000
+  python experiment.py --mode=test --level_name=dmlab30 --test_num_episodes=10
+
+TPU-build additions are grouped at the bottom (env backend selection,
+mesh width, dtype). The reference's --job_name/--task multi-process
+topology is replaced by jax.distributed (see
+scalable_agent_tpu/parallel/distributed.py): every host runs the same
+command and the mesh spans them.
+"""
+
+import dataclasses
+import logging
+
+from absl import app, flags
+
+from scalable_agent_tpu.config import Config
+
+_DEFAULTS = Config()
+
+flags.DEFINE_string('logdir', _DEFAULTS.logdir, 'Experiment directory.')
+flags.DEFINE_enum('mode', 'train', ['train', 'test'], 'Run mode.')
+flags.DEFINE_integer('test_num_episodes', _DEFAULTS.test_num_episodes,
+                     'Episodes per level in test mode.')
+flags.DEFINE_integer('task', _DEFAULTS.task,
+                     'Process index in multi-host mode (-1: single).')
+flags.DEFINE_string('job_name', _DEFAULTS.job_name,
+                    'Kept for reference familiarity; multi-host roles '
+                    'are derived from jax.distributed, not this flag.')
+flags.DEFINE_integer('num_actors', _DEFAULTS.num_actors,
+                     'Actor (environment) count.')
+flags.DEFINE_integer('total_environment_frames',
+                     _DEFAULTS.total_environment_frames,
+                     'Training length in env frames (after action '
+                     'repeat).')
+flags.DEFINE_integer('batch_size', _DEFAULTS.batch_size,
+                     'Learner batch size (unrolls per SGD step).')
+flags.DEFINE_integer('unroll_length', _DEFAULTS.unroll_length,
+                     'Trajectory unroll length T (learner sees T+1).')
+flags.DEFINE_integer('num_action_repeats', _DEFAULTS.num_action_repeats,
+                     'Env frames per agent action.')
+flags.DEFINE_integer('seed', _DEFAULTS.seed, 'Random seed.')
+flags.DEFINE_float('entropy_cost', _DEFAULTS.entropy_cost,
+                   'Entropy cost/multiplier.')
+flags.DEFINE_float('baseline_cost', _DEFAULTS.baseline_cost,
+                   'Baseline cost/multiplier.')
+flags.DEFINE_float('discounting', _DEFAULTS.discounting,
+                   'Discounting factor.')
+flags.DEFINE_enum('reward_clipping', _DEFAULTS.reward_clipping,
+                  ['abs_one', 'soft_asymmetric', 'none'],
+                  'Reward clipping.')
+flags.DEFINE_string('dataset_path', _DEFAULTS.dataset_path,
+                    'Path to dataset needed for psychlab_*, see '
+                    'DMLab docs.')
+flags.DEFINE_string('level_name', _DEFAULTS.level_name,
+                    "Level name, or 'dmlab30' for the full benchmark.")
+flags.DEFINE_integer('width', _DEFAULTS.width, 'Frame width.')
+flags.DEFINE_integer('height', _DEFAULTS.height, 'Frame height.')
+flags.DEFINE_float('learning_rate', _DEFAULTS.learning_rate,
+                   'Learning rate.')
+flags.DEFINE_float('decay', _DEFAULTS.decay, 'RMSProp decay.')
+flags.DEFINE_float('momentum', _DEFAULTS.momentum, 'RMSProp momentum.')
+flags.DEFINE_float('epsilon', _DEFAULTS.epsilon, 'RMSProp epsilon.')
+
+# --- TPU-build additions (not in the reference). ---
+flags.DEFINE_enum('env_backend', _DEFAULTS.env_backend,
+                  ['dmlab', 'atari', 'fake', 'bandit'],
+                  'Environment backend.')
+flags.DEFINE_enum('torso', _DEFAULTS.torso, ['deep', 'shallow'],
+                  'Agent torso: deep ResNet (reference) or the '
+                  "paper's shallow CNN.")
+flags.DEFINE_enum('compute_dtype', _DEFAULTS.compute_dtype,
+                  ['float32', 'bfloat16'], 'On-device compute dtype.')
+flags.DEFINE_integer('model_parallelism', _DEFAULTS.model_parallelism,
+                     'TP width of the device mesh.')
+flags.DEFINE_bool('use_py_process', _DEFAULTS.use_py_process,
+                  'Host each env in its own OS process.')
+flags.DEFINE_bool('use_instruction', _DEFAULTS.use_instruction,
+                  'Enable the language/instruction channel.')
+flags.DEFINE_integer('episode_length', _DEFAULTS.episode_length,
+                     'Episode length of the fake/bandit backends.')
+flags.DEFINE_integer('publish_params_every',
+                     _DEFAULTS.publish_params_every,
+                     'Learner steps between actor weight snapshots.')
+flags.DEFINE_string('coordinator_address', '',
+                    'jax.distributed coordinator (host:port); empty '
+                    'for single-host.')
+flags.DEFINE_integer('num_processes', 1,
+                     'Total process count for jax.distributed.')
+
+FLAGS = flags.FLAGS
+
+
+def config_from_flags() -> Config:
+  cfg = Config()
+  overrides = {}
+  for field in dataclasses.fields(Config):
+    if field.name in FLAGS:
+      overrides[field.name] = getattr(FLAGS, field.name)
+  return dataclasses.replace(cfg, **overrides)
+
+
+def main(argv):
+  del argv
+  logging.basicConfig(
+      level=logging.INFO,
+      format='%(asctime)s %(name)s %(levelname)s %(message)s')
+  if FLAGS.coordinator_address:
+    from scalable_agent_tpu.parallel import distributed
+    distributed.initialize(FLAGS.coordinator_address,
+                           num_processes=FLAGS.num_processes,
+                           process_id=max(FLAGS.task, 0))
+  from scalable_agent_tpu import driver
+  cfg = config_from_flags()
+  if cfg.mode == 'train':
+    run = driver.train(cfg)
+    logging.info('training done at %d frames', run.frames)
+  else:
+    driver.evaluate(cfg)
+
+
+if __name__ == '__main__':
+  app.run(main)

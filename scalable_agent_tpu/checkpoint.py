@@ -53,11 +53,15 @@ class Checkpointer:
            force: bool = False) -> bool:
     """Save now. `step` defaults to the state's own update counter.
 
-    Returns whether a checkpoint was actually written — Orbax silently
-    skips a step it already saved; the throttle clock only resets on a
-    real write so `maybe_save` stays truthful."""
+    Returns whether a checkpoint was actually written. A step that
+    already exists is skipped (returns False, even with force=True —
+    Orbax raises StepAlreadyExistsError rather than overwriting); the
+    throttle clock only resets on a real write so `maybe_save` stays
+    truthful."""
     if step is None:
       step = int(jax.device_get(state.update_steps))
+    if step in self._manager.all_steps():
+      return False  # force=True raises StepAlreadyExistsError otherwise
     saved = bool(self._manager.save(
         step, args=ocp.args.StandardSave(state), force=force))
     if saved:

@@ -49,6 +49,12 @@ class Config:
   epsilon: float = 0.1
 
   # TPU-build additions (not in the reference).
+  env_backend: str = 'dmlab'              # dmlab | atari | fake | bandit
+  num_actions: Optional[int] = None       # backend default when None
+  episode_length: int = 100               # fake/bandit backends only
+  use_py_process: bool = True             # host each env in its own process
+  publish_params_every: int = 1           # actor weight-snapshot cadence
+  model_parallelism: int = 1              # TP width of the mesh
   torso: str = 'deep'                     # deep | shallow
   use_instruction: bool = True
   compute_dtype: str = 'float32'          # float32 | bfloat16

@@ -1,0 +1,309 @@
+"""Experiment driver: wires the whole framework into train/test runs.
+
+TPU-native counterpart of the reference's `train()` / `test()`
+orchestration (reference: experiment.py ≈L430–630). The TF1 machinery
+maps as:
+
+  FIFOQueue + QueueRunner threads      → TrajectoryBuffer + ActorFleet
+  StagingArea GPU prefetch             → BatchPrefetcher (device_put
+                                         with data-axis shardings)
+  dynamic_batching monkey-patch        → InferenceServer (C++ batcher
+                                         in front of a jitted step)
+  MonitoredTrainingSession checkpoints → Checkpointer (Orbax)
+  tf.summary + manual Summary protos   → SummaryWriter (JSONL) +
+                                         EpisodeStats
+  gRPC weight fetch by actors          → host param snapshot publish
+  PyProcessHook env lifecycle          → factory.build_environment +
+                                         fleet-owned processes
+
+`train()` runs until `total_environment_frames` (reference while-loop
+≈L585); `evaluate()` restores the latest checkpoint and plays
+`test_num_episodes` per level, with DMLab-30 human-normalized scoring
+in multi-task mode (reference test() ≈L595–630).
+"""
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from scalable_agent_tpu import checkpoint as checkpoint_lib
+from scalable_agent_tpu import learner as learner_lib
+from scalable_agent_tpu import observability
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.envs import dmlab30, factory
+from scalable_agent_tpu.models import ImpalaAgent, init_params
+from scalable_agent_tpu.parallel import mesh as mesh_lib
+from scalable_agent_tpu.parallel import train_parallel
+from scalable_agent_tpu.runtime import ring_buffer
+from scalable_agent_tpu.runtime.actor import Actor, batch_unrolls
+from scalable_agent_tpu.runtime.fleet import ActorFleet
+from scalable_agent_tpu.runtime.inference import InferenceServer
+
+log = logging.getLogger('scalable_agent_tpu')
+
+
+def build_agent(config: Config, num_actions: int) -> ImpalaAgent:
+  dtype = (jnp.bfloat16 if config.compute_dtype == 'bfloat16'
+           else jnp.float32)
+  return ImpalaAgent(num_actions=num_actions, torso=config.torso,
+                     use_instruction=config.use_instruction, dtype=dtype)
+
+
+def _choose_mesh(config: Config):
+  """Mesh over all local devices when the batch can shard; None means
+  plain single-device jit (the reference's single-machine mode)."""
+  devices = jax.devices()
+  mp = config.model_parallelism
+  if len(devices) == 1 and mp == 1:
+    return None
+  dp = len(devices) // mp
+  if config.batch_size % dp != 0:
+    log.warning('batch_size %d not divisible by data-parallel width %d;'
+                ' falling back to single-device training',
+                config.batch_size, dp)
+    return None
+  return mesh_lib.make_mesh(devices, model_parallelism=mp)
+
+
+class TrainRun:
+  """All live objects of a training run (for inspection/tests)."""
+
+  def __init__(self, config, agent, state, fleet, prefetcher, server,
+               checkpointer, writer, stats, fps_meter):
+    self.config = config
+    self.agent = agent
+    self.state = state
+    self.fleet = fleet
+    self.prefetcher = prefetcher
+    self.server = server
+    self.checkpointer = checkpointer
+    self.writer = writer
+    self.stats = stats
+    self.fps_meter = fps_meter
+
+  @property
+  def frames(self) -> int:
+    return int(jax.device_get(self.state.update_steps)) * \
+        self.config.frames_per_step
+
+
+def train(config: Config, max_steps: Optional[int] = None,
+          stall_timeout_secs: Optional[float] = None) -> TrainRun:
+  """Run IMPALA training until total_environment_frames (or max_steps).
+
+  Returns the TrainRun with the final state (all machinery shut down).
+  """
+  levels = factory.level_names(config)
+  spec0 = factory.make_env_spec(config, levels[0], seed=1)
+  num_actions = spec0.num_actions
+  agent = build_agent(config, num_actions)
+  params = init_params(agent, jax.random.PRNGKey(config.seed),
+                       spec0.obs_spec)
+
+  mesh = _choose_mesh(config)
+  example_batch = None
+  if mesh is not None:
+    from scalable_agent_tpu.testing import make_example_batch
+    from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+    h, w, _ = spec0.frame_shape
+    example_batch = make_example_batch(
+        config.unroll_length + 1, config.batch_size, h, w, num_actions,
+        MAX_INSTRUCTION_LEN)
+    state = train_parallel.make_sharded_train_state(
+        params, config, mesh, enable_tp=config.model_parallelism > 1)
+    train_step, place_fn = train_parallel.make_sharded_train_step(
+        agent, config, mesh, example_batch)
+  else:
+    state = learner_lib.make_train_state(params, config)
+    train_step = learner_lib.make_train_step(agent, config)
+    place_fn = lambda b: jax.tree_util.tree_map(  # noqa: E731
+        lambda x: jax.device_put(np.asarray(x)), b)
+
+  # --- Checkpoint restore (reference: MonitoredTrainingSession auto-
+  # restore from --logdir, ≈L570). ---
+  checkpointer = checkpoint_lib.Checkpointer(
+      config.logdir + '/checkpoints',
+      save_interval_secs=config.checkpoint_secs)
+  restored = checkpointer.restore_latest(state)
+  if restored is not None:
+    state = restored
+    log.info('restored checkpoint at step %d',
+             int(jax.device_get(state.update_steps)))
+
+  # --- Inference server (weights served host-side to actor threads). ---
+  server = InferenceServer(agent, state.params, config,
+                           seed=config.seed + 1000)
+  server.update_params(state.params)
+
+  # --- Actor fleet over the trajectory buffer. ---
+  capacity = max(config.queue_capacity_batches * config.batch_size,
+                 config.batch_size)
+  buffer = ring_buffer.TrajectoryBuffer(capacity)
+
+  def make_actor(i):
+    level = levels[i % len(levels)]
+    spec = factory.make_env_spec(config, level, seed=i + 1)
+    env, process = factory.build_environment(
+        spec, use_py_process=config.use_py_process)
+    actor = Actor(env, server.policy, agent.initial_state(1),
+                  unroll_length=config.unroll_length,
+                  num_action_repeats=config.num_action_repeats,
+                  level_name_id=i % len(levels))
+    return env, process, actor
+
+  fleet = ActorFleet(make_actor, buffer, config.num_actors)
+  prefetcher = ring_buffer.BatchPrefetcher(
+      buffer, config.batch_size, place_fn=place_fn)
+
+  writer = observability.SummaryWriter(config.logdir)
+  stats = observability.EpisodeStats(
+      levels, multi_task=(config.level_name == 'dmlab30'), writer=writer)
+  fps_meter = observability.FpsMeter()
+  run = TrainRun(config, agent, state, fleet, prefetcher, server,
+                 checkpointer, writer, stats, fps_meter)
+
+  fleet.start()
+  steps_done = 0
+  last_summary = time.monotonic()
+  try:
+    while True:
+      frames = run.frames
+      if frames >= config.total_environment_frames:
+        break
+      if max_steps is not None and steps_done >= max_steps:
+        break
+      try:
+        batch_device = prefetcher.get(timeout=stall_timeout_secs)
+      except (ring_buffer.Closed, TimeoutError):
+        errors = fleet.errors()
+        if errors:
+          raise errors[0]
+        raise
+      state, metrics = train_step(run.state, batch_device)
+      run.state = state
+      steps_done += 1
+      fps_meter.update(config.frames_per_step)
+
+      # Episode stats ride in the trajectory (host copy of the batch).
+      host_batch = jax.tree_util.tree_map(
+          lambda x: np.asarray(jax.device_get(x)), batch_device)
+      step_now = int(jax.device_get(state.update_steps))
+      for name, ep_return, ep_frames in stats.record_batch(
+          host_batch, step_now):
+        log.info('episode %s return=%.2f frames=%d', name, ep_return,
+                 ep_frames)
+
+      if steps_done % config.publish_params_every == 0:
+        server.update_params(state.params)
+
+      now = time.monotonic()
+      if now - last_summary >= config.summary_secs:
+        last_summary = now
+        writer.scalars(
+            {k: float(jax.device_get(v)) for k, v in metrics.items()},
+            step_now)
+        writer.scalar('env_frames_per_sec', fps_meter.fps(), step_now)
+        fleet_stats = fleet.stats()
+        writer.scalar('actors_alive', fleet_stats['alive'], step_now)
+        writer.scalar('actor_respawns', fleet_stats['respawns'],
+                      step_now)
+      checkpointer.maybe_save(state)
+      fleet.check_health(stall_timeout_secs=stall_timeout_secs)
+  finally:
+    fleet.stop()
+    prefetcher.close()
+    server.close()
+    try:
+      checkpointer.save(run.state, force=True)
+    finally:
+      checkpointer.close()
+      writer.close()
+  return run
+
+
+def _direct_policy(agent, params, seed):
+  """Jitted batch-1 policy for eval (no batcher — reference test() uses
+  the plain actor graph, ≈L595)."""
+  from scalable_agent_tpu.models.agent import make_step_fn
+  step = make_step_fn(agent)
+  holder = {'key': jax.random.PRNGKey(seed)}
+
+  def policy(prev_action, env_output, core_state):
+    holder['key'], sub = jax.random.split(holder['key'])
+    batched = jax.tree_util.tree_map(lambda x: np.asarray(x)[None],
+                                     env_output)
+    out, new_state = step(params, sub,
+                          jnp.asarray([prev_action], jnp.int32),
+                          batched, core_state)
+    return (jax.tree_util.tree_map(lambda x: np.asarray(x)[0], out),
+            new_state)
+
+  return policy
+
+
+def evaluate(config: Config) -> Dict[str, List[float]]:
+  """Play test_num_episodes per level from the latest checkpoint.
+
+  Returns {train_level_name: [episode returns]}; logs DMLab-30
+  human-normalized scores in multi-task mode (reference test()
+  ≈L595–630: SingularMonitoredSession restore + done[1:] extraction).
+  """
+  train_levels = factory.level_names(config)
+  test_levels = factory.test_level_names(config)
+  spec0 = factory.make_env_spec(config, test_levels[0], seed=1,
+                                is_test=True)
+  agent = build_agent(config, spec0.num_actions)
+  params = init_params(agent, jax.random.PRNGKey(config.seed),
+                       spec0.obs_spec)
+
+  checkpointer = checkpoint_lib.Checkpointer(
+      config.logdir + '/checkpoints')
+  state = learner_lib.make_train_state(params, config)
+  restored = checkpointer.restore_latest(state)
+  if restored is None:
+    raise FileNotFoundError(
+        f'no checkpoint under {config.logdir}/checkpoints')
+  params = restored.params
+  checkpointer.close()
+
+  level_returns: Dict[str, List[float]] = {}
+  for train_name, test_name in zip(train_levels, test_levels):
+    spec = factory.make_env_spec(config, test_name, seed=config.seed,
+                                 is_test=True)
+    env, process = factory.build_environment(
+        spec, use_py_process=config.use_py_process)
+    policy = _direct_policy(agent, params, config.seed)
+    actor = Actor(env, policy, agent.initial_state(1),
+                  unroll_length=config.unroll_length,
+                  num_action_repeats=config.num_action_repeats)
+    returns: List[float] = []
+    try:
+      while len(returns) < config.test_num_episodes:
+        unroll = actor.unroll()
+        done = np.asarray(unroll.env_outputs.done)[1:]
+        ep_returns = np.asarray(
+            unroll.env_outputs.info.episode_return)[1:]
+        returns.extend(float(r) for r in ep_returns[done])
+    finally:
+      actor.close()
+      if process is not None:
+        process.close()
+    returns = returns[:config.test_num_episodes]
+    level_returns[train_name] = returns
+    log.info('level %s: mean return %.2f over %d episodes', test_name,
+             float(np.mean(returns)) if returns else float('nan'),
+             len(returns))
+
+  if config.level_name == 'dmlab30':
+    no_cap = dmlab30.compute_human_normalized_score(
+        level_returns, per_level_cap=None)
+    cap_100 = dmlab30.compute_human_normalized_score(
+        level_returns, per_level_cap=100)
+    log.info('dmlab30 human-normalized: no_cap=%.1f cap_100=%.1f',
+             no_cap, cap_100)
+  return level_returns

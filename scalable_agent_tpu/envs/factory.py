@@ -1,0 +1,102 @@
+"""Environment factory: config → host environment instances.
+
+The reference builds envs in `create_environment` (reference:
+experiment.py ≈L395–410: PyProcess(PyProcessDmLab, ...) wrapped in
+FlowEnvironment, test mode setting allowHoldOutLevels + fixed
+mixerSeed). Here the factory is backend-dispatched so the same driver
+runs the CI fake envs, DMLab, or Atari — real simulators are
+import-guarded (not present in this sandbox; SURVEY §7 "hard parts").
+
+Envs are host-side numpy objects (envs/base.py protocol). With
+`config.use_py_process` the driver hosts each one in its own OS process
+via runtime/py_process.py — the reference's PyProcess GIL-escape.
+"""
+
+from typing import List, Optional, Tuple
+
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.envs import dmlab30
+from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+
+
+class EnvSpec(object):
+  """What the driver needs to know about a backend before building it."""
+
+  def __init__(self, env_class, constructor_kwargs, num_actions,
+               frame_shape):
+    self.env_class = env_class
+    self.constructor_kwargs = dict(constructor_kwargs)
+    self.num_actions = num_actions
+    self.frame_shape = tuple(frame_shape)
+
+  @property
+  def obs_spec(self):
+    return {'frame': self.frame_shape, 'instr_len': MAX_INSTRUCTION_LEN}
+
+  def build(self):
+    return self.env_class(**self.constructor_kwargs)
+
+
+def level_names(config: Config) -> List[str]:
+  """Training level list; 'dmlab30' expands to the 30-level benchmark
+  (reference: experiment.py main ≈L630)."""
+  if config.level_name == 'dmlab30':
+    return list(dmlab30.ALL_LEVELS)
+  return [config.level_name]
+
+
+def test_level_names(config: Config) -> List[str]:
+  """Held-out eval variants (reference: dmlab30.LEVEL_MAPPING)."""
+  if config.level_name == 'dmlab30':
+    return list(dmlab30.LEVEL_MAPPING.values())
+  return [config.level_name]
+
+
+def make_env_spec(config: Config, level_name: str, seed: int,
+                  is_test: bool = False) -> EnvSpec:
+  """One environment spec for (backend, level, seed)."""
+  backend = config.env_backend
+  if backend in ('fake', 'bandit'):
+    from scalable_agent_tpu.envs import fake
+    env_class = (fake.ContextualBanditEnv if backend == 'bandit'
+                 else fake.FakeEnv)
+    num_actions = config.num_actions or (3 if backend == 'bandit' else 5)
+    kwargs = dict(height=config.height, width=config.width,
+                  num_actions=num_actions,
+                  episode_length=config.episode_length,
+                  seed=seed, level_name=level_name,
+                  num_action_repeats=config.num_action_repeats)
+    frame_shape = (config.height, config.width, 3)
+  elif backend == 'dmlab':
+    from scalable_agent_tpu.envs import dmlab
+    env_class = dmlab.DmLabEnv
+    num_actions = len(dmlab.DEFAULT_ACTION_SET)
+    kwargs = dmlab.constructor_kwargs(
+        level_name=level_name, seed=seed, is_test=is_test, config=config)
+    frame_shape = (config.height, config.width, 3)
+  elif backend == 'atari':
+    from scalable_agent_tpu.envs import atari
+    env_class = atari.AtariEnv
+    num_actions = config.num_actions or atari.DEFAULT_NUM_ACTIONS
+    kwargs = dict(game=level_name, seed=seed,
+                  height=config.height, width=config.width,
+                  num_action_repeats=config.num_action_repeats,
+                  is_test=is_test)
+    frame_shape = (config.height, config.width, 3)
+  else:
+    raise ValueError(f'unknown env backend: {backend!r}')
+  return EnvSpec(env_class, kwargs, num_actions, frame_shape)
+
+
+def build_environment(spec: EnvSpec, use_py_process: bool = False
+                      ) -> Tuple[object, Optional[object]]:
+  """Instantiate (env, process): in-process, or hosted in its own OS
+  process behind the py_process proxy (returns the PyProcess so the
+  caller controls its lifecycle)."""
+  if not use_py_process:
+    return spec.build(), None
+  from scalable_agent_tpu.runtime import py_process
+  process = py_process.PyProcess(spec.env_class,
+                                 constructor_kwargs=spec.constructor_kwargs)
+  process.start()
+  return py_process.ProxyEnv(process), process

@@ -149,7 +149,8 @@ class EpisodeStats:
     for level_id, ep_return, ep_frames in extract_episodes(batch):
       name = self._level_names[level_id]
       episodes.append((name, ep_return, ep_frames))
-      self._level_returns.setdefault(name, []).append(ep_return)
+      if self._multi_task:  # accumulator is only read by _maybe_score
+        self._level_returns.setdefault(name, []).append(ep_return)
       if self._writer is not None:
         self._writer.scalar(f'{name}/episode_return', ep_return, step)
         self._writer.scalar(f'{name}/episode_frames', ep_frames, step)

@@ -1,0 +1,61 @@
+"""Multi-host initialization (the reference's ClusterSpec/gRPC role).
+
+The reference scales across machines with the TF1 distributed runtime:
+`tf.train.ClusterSpec` + `tf.train.Server`, learner-hosted queue,
+variables served over gRPC (reference: experiment.py ≈L435–460; SURVEY
+§5.8). The TPU-native story has no parameter server and no remote queue:
+
+- every host runs the SAME program; `jax.distributed.initialize` wires
+  the processes into one runtime;
+- the device mesh (parallel/mesh.py) spans all hosts' chips; gradient
+  psum rides ICI within a slice and DCN across slices — XLA picks the
+  transport from the mesh topology;
+- trajectory transport stays host-local: each host's actor fleet feeds
+  the learner shard(s) on that host (data-parallel inputs are per-host
+  shards of the global batch via
+  `jax.make_array_from_process_local_data`);
+- weight snapshots for actors are host-local device_gets — no gRPC.
+
+On a single host this module is a no-op; the driver works unchanged.
+"""
+
+import logging
+from typing import Optional
+
+import jax
+
+log = logging.getLogger('scalable_agent_tpu')
+
+
+def initialize(coordinator_address: str, num_processes: int,
+               process_id: int,
+               local_device_ids: Optional[list] = None) -> None:
+  """Join the multi-host runtime (call before any device op).
+
+  Args:
+    coordinator_address: 'host:port' of process 0 (the reference's
+      learner address role, minus the parameter server).
+    num_processes: total host process count.
+    process_id: this process's index (the reference's --task).
+    local_device_ids: optionally restrict this process's devices.
+  """
+  jax.distributed.initialize(
+      coordinator_address=coordinator_address,
+      num_processes=num_processes,
+      process_id=process_id,
+      local_device_ids=local_device_ids)
+  log.info('jax.distributed: process %d/%d, %d local / %d global devices',
+           process_id, num_processes, jax.local_device_count(),
+           jax.device_count())
+
+
+def global_batch_from_local(mesh, spec, local_batch):
+  """Assemble a globally-sharded array from this host's local batch.
+
+  Each host contributes its fleet's unrolls as the process-local part
+  of the data-axis-sharded global batch (the reference's remote
+  enqueue [NET] becomes: no transport at all — data stays where it
+  was produced)."""
+  return jax.tree_util.tree_map(
+      lambda x, s: jax.make_array_from_process_local_data(s, x),
+      local_batch, spec)

@@ -1,0 +1,163 @@
+"""Actor fleet: owns env processes, actor threads, and their health.
+
+The reference's actor fleet is implicit — QueueRunner threads plus
+PyProcessHook-started env processes, with NO failure detection: a dead
+actor silently stops contributing (SURVEY §5.3). This module makes the
+fleet explicit and adds what upstream lacks:
+
+- per-actor heartbeats (last unroll completion time),
+- dead/stalled-actor detection,
+- respawn of the env (process) + actor thread without disturbing the
+  rest of the fleet or the learner.
+
+Trajectories from a respawned actor restart from a fresh episode —
+consistent with the reference's crash story (unrolls straddling a
+restart are lost, SURVEY §5.4).
+"""
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from scalable_agent_tpu.runtime import ring_buffer
+from scalable_agent_tpu.runtime.actor import Actor
+
+
+class _Slot:
+  """One actor's mutable runtime state (env, thread, health)."""
+
+  def __init__(self, index):
+    self.index = index
+    self.env = None
+    self.process = None          # PyProcess when process-hosted
+    self.actor: Optional[Actor] = None
+    self.thread: Optional[threading.Thread] = None
+    self.last_heartbeat: float = time.monotonic()
+    self.unrolls_done: int = 0
+    self.respawns: int = 0
+    self.error: Optional[BaseException] = None
+
+
+class ActorFleet:
+  """N actors producing unrolls into a shared TrajectoryBuffer.
+
+  Args:
+    make_actor: (slot_index) → (env, process_or_None, Actor). Called at
+      start and again on every respawn; must build a FRESH env.
+    buffer: the shared TrajectoryBuffer.
+    num_actors: fleet size.
+  """
+
+  def __init__(self, make_actor: Callable, buffer, num_actors: int):
+    self._make_actor = make_actor
+    self._buffer = buffer
+    self._stop = threading.Event()
+    self._lock = threading.Lock()
+    self._slots: List[_Slot] = [_Slot(i) for i in range(num_actors)]
+
+  @property
+  def stop_event(self):
+    return self._stop
+
+  def start(self):
+    for slot in self._slots:
+      self._spawn(slot)
+
+  def _spawn(self, slot: _Slot):
+    env, process, actor = self._make_actor(slot.index)
+    slot.env, slot.process, slot.actor = env, process, actor
+    slot.error = None
+    slot.last_heartbeat = time.monotonic()
+    slot.thread = threading.Thread(
+        target=self._run, args=(slot, actor),
+        name=f'actor-{slot.index}', daemon=True)
+    slot.thread.start()
+
+  def _run(self, slot: _Slot, actor: Actor):
+    from scalable_agent_tpu.ops.dynamic_batching import BatcherCancelled
+    try:
+      while not self._stop.is_set():
+        unroll = actor.unroll()
+        self._buffer.put(unroll)
+        with self._lock:
+          slot.last_heartbeat = time.monotonic()
+          slot.unrolls_done += 1
+    except (ring_buffer.Closed, BatcherCancelled):
+      # Normal during shutdown (closed buffer/batcher = the reference's
+      # closed-pipe → StopIteration convention); a failure otherwise.
+      if not self._stop.is_set():
+        with self._lock:
+          slot.error = ring_buffer.Closed('buffer closed under actor')
+    except BaseException as e:
+      with self._lock:
+        slot.error = e
+    finally:
+      try:
+        actor.close()
+      except Exception:
+        pass
+      if slot.process is not None:
+        try:
+          slot.process.close(timeout=2.0)
+        except Exception:
+          pass
+
+  def check_health(self, stall_timeout_secs: Optional[float] = None,
+                   respawn: bool = True) -> List[int]:
+    """Detect failed/stalled actors; respawn them. Returns the indices
+    acted upon. Call periodically from the learner loop (the reference
+    has no equivalent — SURVEY §5.3 greenfield)."""
+    if self._stop.is_set():
+      return []
+    now = time.monotonic()
+    bad: List[_Slot] = []
+    with self._lock:
+      for slot in self._slots:
+        dead = slot.error is not None or (
+            slot.thread is not None and not slot.thread.is_alive())
+        stalled = (stall_timeout_secs is not None and
+                   now - slot.last_heartbeat > stall_timeout_secs)
+        if dead or stalled:
+          bad.append(slot)
+    for slot in bad:
+      if respawn:
+        self._respawn(slot)
+    return [s.index for s in bad]
+
+  def _respawn(self, slot: _Slot):
+    old_thread = slot.thread
+    if slot.process is not None:
+      try:
+        slot.process.close(timeout=1.0)
+      except Exception:
+        pass
+    if old_thread is not None and old_thread.is_alive():
+      # A stalled thread blocked in env.step can't be killed; it is
+      # orphaned (daemon) and a fresh actor takes over the slot. Its
+      # buffer.put may still land one stale unroll — harmless, same
+      # policy-lag bound as any in-flight unroll.
+      pass
+    with self._lock:
+      slot.respawns += 1
+    self._spawn(slot)
+
+  def errors(self) -> List[BaseException]:
+    with self._lock:
+      return [s.error for s in self._slots if s.error is not None]
+
+  def stats(self):
+    with self._lock:
+      return {
+          'unrolls': sum(s.unrolls_done for s in self._slots),
+          'respawns': sum(s.respawns for s in self._slots),
+          'alive': sum(1 for s in self._slots
+                       if s.thread is not None and s.thread.is_alive()),
+      }
+
+  def stop(self, timeout: float = 10.0):
+    self._stop.set()
+    self._buffer.close()
+    deadline = time.monotonic() + timeout
+    for slot in self._slots:
+      if slot.thread is not None:
+        slot.thread.join(max(0.0, deadline - time.monotonic()))

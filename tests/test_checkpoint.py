@@ -118,5 +118,5 @@ def test_save_same_step_twice_reports_skip(setup, tmp_path):
   ckpt = Checkpointer(str(tmp_path / 'dup'))
   assert ckpt.save(state, step=5)
   ckpt.wait_until_finished()
-  assert not ckpt.save(state, step=5)  # orbax skips silently → False
+  assert not ckpt.save(state, step=5)  # existing step skipped → False
   ckpt.close()

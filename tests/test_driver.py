@@ -1,0 +1,89 @@
+"""Driver integration tests: the full train/test wiring on fake envs.
+
+The reference has NO test of experiment.py (SURVEY §4 — a gap not to
+copy). These run the real driver end to end on CPU: actor fleet +
+inference batcher + prefetcher + (sharded) train step + checkpointing +
+episode stats, then test-mode eval restoring the checkpoint.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from scalable_agent_tpu import driver
+from scalable_agent_tpu.config import Config
+
+
+def _config(tmp_path, **kw):
+  base = dict(
+      logdir=str(tmp_path),
+      env_backend='bandit',
+      num_actors=2,
+      batch_size=2,
+      unroll_length=5,
+      num_action_repeats=1,
+      episode_length=4,
+      height=24, width=32,
+      torso='shallow',
+      use_py_process=False,          # in-process: fast, no fork noise
+      use_instruction=False,
+      total_environment_frames=10**6,
+      inference_timeout_ms=5,
+      checkpoint_secs=0,             # save on every maybe_save window
+      summary_secs=0,
+      seed=3)
+  base.update(kw)
+  return Config(**base)
+
+
+def test_train_smoke_and_checkpoint_roundtrip(tmp_path):
+  cfg = _config(tmp_path)
+  run = driver.train(cfg, max_steps=3, stall_timeout_secs=60)
+  assert int(run.state.update_steps) == 3
+  assert run.frames == 3 * cfg.frames_per_step
+
+  # Checkpoint written; resume continues the step count.
+  run2 = driver.train(cfg, max_steps=2, stall_timeout_secs=60)
+  assert int(run2.state.update_steps) == 5
+
+  # Summaries exist and are valid JSONL.
+  files = glob.glob(os.path.join(str(tmp_path), 'summaries.jsonl'))
+  assert files
+  with open(files[0]) as f:
+    events = [json.loads(line) for line in f]
+  assert any(e['tag'] == 'env_frames_per_sec' for e in events)
+
+
+def test_train_total_frames_termination(tmp_path):
+  cfg = _config(tmp_path,
+                total_environment_frames=2 * 2 * 5)  # exactly 2 steps
+  run = driver.train(cfg, stall_timeout_secs=60)
+  assert int(run.state.update_steps) == 2
+
+
+def test_evaluate_from_checkpoint(tmp_path):
+  cfg = _config(tmp_path)
+  driver.train(cfg, max_steps=2, stall_timeout_secs=60)
+  returns = driver.evaluate(cfg)
+  assert set(returns) == {cfg.level_name}
+  assert len(returns[cfg.level_name]) == cfg.test_num_episodes
+  for r in returns[cfg.level_name]:
+    assert 0.0 <= r <= cfg.episode_length
+
+
+def test_sharded_train_path(tmp_path):
+  """batch 8 over the 8 virtual CPU devices → the pjit path."""
+  import jax
+  assert len(jax.devices()) == 8
+  cfg = _config(tmp_path, batch_size=8, num_actors=4)
+  run = driver.train(cfg, max_steps=2, stall_timeout_secs=120)
+  assert int(run.state.update_steps) == 2
+
+
+def test_evaluate_without_checkpoint_raises(tmp_path):
+  cfg = _config(tmp_path)
+  with pytest.raises(FileNotFoundError):
+    driver.evaluate(cfg)

@@ -1,0 +1,118 @@
+"""ActorFleet failure detection and respawn (SURVEY §5.3 greenfield —
+the reference has no equivalent: a dead actor silently stops feeding)."""
+
+import threading
+import time
+
+import numpy as np
+
+from scalable_agent_tpu.envs.fake import FakeEnv
+from scalable_agent_tpu.runtime import ring_buffer
+from scalable_agent_tpu.runtime.actor import Actor
+from scalable_agent_tpu.runtime.fleet import ActorFleet
+
+H, W, A = 8, 8, 3
+
+
+class CrashingEnv(FakeEnv):
+  """Env that dies after `crash_after` steps (first life only)."""
+  crashes = 0
+
+  def __init__(self, crash_after=3, **kw):
+    super().__init__(**kw)
+    self._steps = 0
+    self._crash_after = crash_after
+
+  def step(self, action):
+    self._steps += 1
+    if self._crash_after and self._steps >= self._crash_after:
+      type(self).crashes += 1
+      raise RuntimeError('env crashed')
+    return super().step(action)
+
+
+def _dummy_policy(prev_action, env_output, core_state):
+  from scalable_agent_tpu.structs import AgentOutput
+  out = AgentOutput(action=np.int32(0),
+                    policy_logits=np.zeros(A, np.float32),
+                    baseline=np.float32(0.0))
+  return out, core_state
+
+
+def _make_actor_factory(env_factory, unroll_length=4):
+  def make_actor(i):
+    env = env_factory(i)
+    actor = Actor(env, _dummy_policy, (np.zeros((1, 4), np.float32),) * 2,
+                  unroll_length=unroll_length)
+    return env, None, actor
+  return make_actor
+
+
+def test_fleet_produces_and_stops():
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  fleet = ActorFleet(
+      _make_actor_factory(lambda i: FakeEnv(height=H, width=W,
+                                            num_actions=A, seed=i)),
+      buffer, num_actors=2)
+  fleet.start()
+  got = [buffer.get(timeout=10) for _ in range(3)]
+  assert len(got) == 3
+  fleet.stop()
+  assert fleet.stats()['unrolls'] >= 3
+  assert not fleet.errors()
+
+
+def test_fleet_detects_and_respawns_crashed_actor():
+  CrashingEnv.crashes = 0
+  buffer = ring_buffer.TrajectoryBuffer(8)
+
+  def env_factory(i):
+    # First spawn crashes; respawned envs run clean.
+    crash_after = 3 if CrashingEnv.crashes < 2 else 0
+    return CrashingEnv(crash_after=crash_after, height=H, width=W,
+                       num_actions=A, seed=i)
+
+  fleet = ActorFleet(_make_actor_factory(env_factory), buffer,
+                     num_actors=2)
+  fleet.start()
+  deadline = time.monotonic() + 15
+  respawned = []
+  while time.monotonic() < deadline and not respawned:
+    respawned = fleet.check_health()
+    time.sleep(0.05)
+  assert respawned, 'crash never detected'
+  # After respawn the fleet produces again.
+  unroll = buffer.get(timeout=10)
+  assert unroll.env_outputs.reward.shape[0] == 5
+  fleet.stop()
+  assert fleet.stats()['respawns'] >= 1
+
+
+def test_fleet_detects_stalled_actor():
+  buffer = ring_buffer.TrajectoryBuffer(2)
+
+  stall = threading.Event()
+
+  class StallingEnv(FakeEnv):
+    def step(self, action):
+      if stall.is_set():
+        time.sleep(30)
+      return super().step(action)
+
+  made = []
+
+  def env_factory(i):
+    env = StallingEnv(height=H, width=W, num_actions=A, seed=i)
+    made.append(env)
+    return env
+
+  fleet = ActorFleet(_make_actor_factory(env_factory), buffer,
+                     num_actors=1)
+  fleet.start()
+  buffer.get(timeout=10)  # healthy first unroll
+  stall.set()
+  time.sleep(0.3)
+  bad = fleet.check_health(stall_timeout_secs=0.2, respawn=False)
+  assert bad == [0]
+  stall.clear()
+  fleet.stop(timeout=2)
