@@ -61,6 +61,10 @@ def _choose_mesh(config: Config):
   mp = config.model_parallelism
   if len(devices) == 1 and mp == 1:
     return None
+  if mp > len(devices) or len(devices) % mp != 0:
+    raise ValueError(
+        f'model_parallelism={mp} does not divide the device count '
+        f'{len(devices)}')
   dp = len(devices) // mp
   if config.batch_size % dp != 0:
     log.warning('batch_size %d not divisible by data-parallel width %d;'
@@ -105,8 +109,16 @@ def train(config: Config, max_steps: Optional[int] = None,
   params = init_params(agent, jax.random.PRNGKey(config.seed),
                        spec0.obs_spec)
 
+  # Multi-host: config.batch_size is GLOBAL; each host's fleet feeds
+  # its process-local shard (SURVEY §5.8 — trajectory transport stays
+  # host-local; only gradients ride ICI/DCN).
+  num_processes = jax.process_count()
+  if config.batch_size % num_processes != 0:
+    raise ValueError(f'batch_size={config.batch_size} must divide by '
+                     f'process count {num_processes}')
+  local_batch_size = config.batch_size // num_processes
+
   mesh = _choose_mesh(config)
-  example_batch = None
   if mesh is not None:
     from scalable_agent_tpu.testing import make_example_batch
     from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
@@ -134,6 +146,10 @@ def train(config: Config, max_steps: Optional[int] = None,
     state = restored
     log.info('restored checkpoint at step %d',
              int(jax.device_get(state.update_steps)))
+  # Host-side step/frame mirror: the loop must not device_get the
+  # on-device counter every iteration (that would sync the async
+  # dispatch pipeline each step).
+  _initial_steps = int(jax.device_get(state.update_steps))
 
   # --- Inference server (weights served host-side to actor threads). ---
   server = InferenceServer(agent, state.params, config,
@@ -157,8 +173,27 @@ def train(config: Config, max_steps: Optional[int] = None,
     return env, process, actor
 
   fleet = ActorFleet(make_actor, buffer, config.num_actors)
+
+  def stage(host_batch):
+    """Prefetcher stage: peel off a tiny host-side stats view (done /
+    info / level ids — the batch is host numpy right here) BEFORE the
+    device transfer, so the train loop never device_gets frames just to
+    read episode stats."""
+    from scalable_agent_tpu.structs import ActorOutput, StepOutput
+    stats_view = ActorOutput(
+        level_name=np.asarray(host_batch.level_name),
+        agent_state=None,
+        env_outputs=StepOutput(
+            reward=None,
+            info=jax.tree_util.tree_map(
+                np.asarray, host_batch.env_outputs.info),
+            done=np.asarray(host_batch.env_outputs.done),
+            observation=None),
+        agent_outputs=None)
+    return stats_view, place_fn(host_batch)
+
   prefetcher = ring_buffer.BatchPrefetcher(
-      buffer, config.batch_size, place_fn=place_fn)
+      buffer, local_batch_size, place_fn=stage)
 
   writer = observability.SummaryWriter(config.logdir)
   stats = observability.EpisodeStats(
@@ -170,31 +205,47 @@ def train(config: Config, max_steps: Optional[int] = None,
   fleet.start()
   steps_done = 0
   last_summary = time.monotonic()
+  last_batch_time = time.monotonic()
+  poll_secs = 10.0 if stall_timeout_secs is None else min(
+      10.0, stall_timeout_secs)
   try:
     while True:
-      frames = run.frames
+      frames = (_initial_steps + steps_done) * config.frames_per_step
       if frames >= config.total_environment_frames:
         break
       if max_steps is not None and steps_done >= max_steps:
         break
       try:
-        batch_device = prefetcher.get(timeout=stall_timeout_secs)
-      except (ring_buffer.Closed, TimeoutError):
+        stats_view, batch_device = prefetcher.get(timeout=poll_secs)
+      except TimeoutError:
+        # No data yet: surface actor failures instead of hanging (the
+        # reference hangs silently here — SURVEY §5.3). check_health
+        # respawns failed actors; a respawn whose env construction
+        # fails raises out of train().
+        fleet.check_health(stall_timeout_secs=stall_timeout_secs)
+        if (stall_timeout_secs is not None and
+            time.monotonic() - last_batch_time >
+            max(3 * stall_timeout_secs, 30.0)):
+          errors = fleet.errors()
+          raise errors[0] if errors else TimeoutError(
+              'no trajectory batch despite healthy actors')
+        continue
+      except ring_buffer.Closed:
         errors = fleet.errors()
         if errors:
           raise errors[0]
         raise
+      last_batch_time = time.monotonic()
       state, metrics = train_step(run.state, batch_device)
       run.state = state
       steps_done += 1
       fps_meter.update(config.frames_per_step)
 
-      # Episode stats ride in the trajectory (host copy of the batch).
-      host_batch = jax.tree_util.tree_map(
-          lambda x: np.asarray(jax.device_get(x)), batch_device)
-      step_now = int(jax.device_get(state.update_steps))
+      # Episode stats ride in the trajectory; the prefetcher peeled a
+      # host-side view before the device transfer — no device_get here.
+      step_now = steps_done + _initial_steps
       for name, ep_return, ep_frames in stats.record_batch(
-          host_batch, step_now):
+          stats_view, step_now):
         log.info('episode %s return=%.2f frames=%d', name, ep_return,
                  ep_frames)
 

@@ -1,0 +1,189 @@
+"""Atari (ALE) environment adapter — the reference's "swap-in env"
+workload (SURVEY §0: Atari-style via swap-in env; BASELINE.json config
+ladder). Import-guarded: no ALE ROMs ship in this sandbox.
+
+The adapter keeps the SAME observation contract as the DMLab path
+(frame uint8 [H, W, 3] + instruction ids, here empty) so every other
+layer — actor, batcher, learner, models — is env-agnostic. Standard
+DQN/IMPALA-style preprocessing is done host-side in pure numpy
+(testable without ALE):
+
+- action repeat with max-pool over the last two raw frames (flicker
+  removal),
+- nearest-neighbor resize to (height, width) in uint8,
+- random no-op starts (≤30) at episode begin,
+- auto-reset on game over (done=True returns the next episode's first
+  frame, matching envs/base.py).
+
+Backends tried in order: `ale_py` (canonical), then gymnasium's
+ALE registration.
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from scalable_agent_tpu.envs import base
+from scalable_agent_tpu.models.instruction import (
+    empty_instruction, MAX_INSTRUCTION_LEN)
+
+DEFAULT_NUM_ACTIONS = 18  # full ALE action set
+DEFAULT_NOOP_MAX = 30
+
+
+def resize_uint8(frame: np.ndarray, height: int, width: int
+                 ) -> np.ndarray:
+  """Nearest-neighbor resize of an [H, W, C] uint8 frame (pure numpy —
+  no cv2/PIL dependency on the actor hot path)."""
+  in_h, in_w = frame.shape[:2]
+  rows = (np.arange(height) * in_h // height).astype(np.intp)
+  cols = (np.arange(width) * in_w // width).astype(np.intp)
+  return frame[rows[:, None], cols[None, :]]
+
+
+def pooled_frame(last_two: Tuple[np.ndarray, np.ndarray]
+                 ) -> np.ndarray:
+  """Pixel-wise max over the last two raw frames (flicker removal)."""
+  a, b = last_two
+  return np.maximum(a, b)
+
+
+class AtariEnv(base.Environment):
+  """One ALE game behind the host env protocol."""
+
+  def __init__(self, game: str, seed: int, height: int = 72,
+               width: int = 96, num_action_repeats: int = 4,
+               noop_max: int = DEFAULT_NOOP_MAX,
+               full_action_set: bool = True, is_test: bool = False,
+               ale: Optional[object] = None):
+    """`ale` injects a backend (testing); otherwise ale_py/gymnasium."""
+    self._h, self._w = height, width
+    self._num_action_repeats = num_action_repeats
+    self._noop_max = 0 if is_test else noop_max
+    self._rng = np.random.RandomState(seed)
+    self._instr = empty_instruction()
+    self._ale = ale if ale is not None else _make_ale(
+        game, self._rng.randint(0, 2 ** 31 - 1), full_action_set)
+    self._actions = self._ale.action_set()
+    self._reset()
+
+  def _reset(self):
+    self._ale.reset()
+    for _ in range(self._rng.randint(self._noop_max + 1)
+                   if self._noop_max else 0):
+      self._ale.act(0)  # NOOP
+      if self._ale.game_over():
+        self._ale.reset()
+    self._raw = self._ale.screen_rgb()
+    self._prev_raw = self._raw
+
+  def _observation(self):
+    frame = resize_uint8(pooled_frame((self._prev_raw, self._raw)),
+                         self._h, self._w)
+    return (frame, self._instr.copy())
+
+  def initial(self):
+    return self._observation()
+
+  def step(self, action):
+    raw_action = self._actions[int(action) % len(self._actions)]
+    reward = 0.0
+    for _ in range(self._num_action_repeats):
+      reward += self._ale.act(raw_action)
+      self._prev_raw = self._raw
+      self._raw = self._ale.screen_rgb()
+      if self._ale.game_over():
+        break
+    done = self._ale.game_over()
+    if done:
+      self._reset()
+    return (np.float32(reward), np.bool_(done), self._observation())
+
+  def close(self):
+    pass
+
+  @staticmethod
+  def _tensor_specs(method_name, unused_kwargs, constructor_kwargs):
+    h = constructor_kwargs.get('height', 72)
+    w = constructor_kwargs.get('width', 96)
+    if method_name == 'initial':
+      return base.observation_specs(h, w, MAX_INSTRUCTION_LEN)
+    if method_name == 'step':
+      return base.step_output_specs(h, w, MAX_INSTRUCTION_LEN)
+    return None
+
+
+class _AlePyBackend:
+  """Thin uniform wrapper over ale_py.ALEInterface."""
+
+  def __init__(self, game, seed, full_action_set):
+    import ale_py
+    self._ale = ale_py.ALEInterface()
+    self._ale.setInt('random_seed', int(seed))
+    self._ale.setFloat('repeat_action_probability', 0.0)
+    self._ale.loadROM(ale_py.roms.get_rom_path(game))
+    self._action_set = (self._ale.getLegalActionSet() if full_action_set
+                        else self._ale.getMinimalActionSet())
+
+  def action_set(self):
+    return list(self._action_set)
+
+  def reset(self):
+    self._ale.reset_game()
+
+  def act(self, action):
+    return float(self._ale.act(action))
+
+  def game_over(self):
+    return bool(self._ale.game_over())
+
+  def screen_rgb(self):
+    return np.asarray(self._ale.getScreenRGB(), np.uint8)
+
+
+class _GymnasiumBackend:
+  """Fallback over gymnasium's ALE envs (frameskip disabled — the
+  adapter owns action repeat and pooling)."""
+
+  def __init__(self, game, seed, full_action_set):
+    import gymnasium
+    self._env = gymnasium.make(
+        f'ALE/{game}-v5', frameskip=1, repeat_action_probability=0.0,
+        full_action_space=full_action_set, render_mode='rgb_array')
+    self._seed = int(seed)
+    self._frame = None
+    self._over = True
+
+  def action_set(self):
+    return list(range(self._env.action_space.n))
+
+  def reset(self):
+    self._frame, _ = self._env.reset(seed=self._seed)
+    self._seed = None  # seed only the first reset
+    self._over = False
+
+  def act(self, action):
+    self._frame, reward, terminated, truncated, _ = self._env.step(
+        action)
+    self._over = bool(terminated or truncated)
+    return float(reward)
+
+  def game_over(self):
+    return self._over
+
+  def screen_rgb(self):
+    return np.asarray(self._frame, np.uint8)
+
+
+def _make_ale(game, seed, full_action_set):
+  try:
+    return _AlePyBackend(game, seed, full_action_set)
+  except ImportError:
+    pass
+  try:
+    return _GymnasiumBackend(game, seed, full_action_set)
+  except Exception as e:  # gymnasium missing, or present without ROMs
+    raise ImportError(
+        f'no Atari backend available (ale_py missing, gymnasium ALE '
+        f'failed: {e}); use --env_backend=fake/bandit in this sandbox'
+    ) from e

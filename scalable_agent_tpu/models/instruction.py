@@ -42,6 +42,11 @@ def hash_instruction(text, vocab_size=VOCAB_SIZE,
   return ids
 
 
+def empty_instruction(max_len=MAX_INSTRUCTION_LEN):
+  """All-pad ids for env families with no language channel (Atari)."""
+  return np.zeros((max_len,), dtype=np.int32)
+
+
 class InstructionEncoder(nn.Module):
   """Device-side: ids [B, L] → f32 [B, LSTM_SIZE]."""
   vocab_size: int = VOCAB_SIZE

@@ -47,8 +47,14 @@ def make_sharded_train_step(agent, config: Config, mesh: Mesh,
       donate_argnums=(0,))
 
   def place_batch(host_batch):
+    """Host numpy → globally-sharded device arrays. Each process passes
+    its LOCAL shard of the data axis (on a single host, local == global
+    and this is an ordinary sharded device_put); across hosts this is
+    the whole trajectory transport — data never leaves the host that
+    produced it (SURVEY §5.8)."""
     return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(np.asarray(x), s),
+        lambda x, s: jax.make_array_from_process_local_data(
+            s, np.asarray(x)),
         host_batch, batch_shard)
 
   return jitted, place_batch

@@ -32,6 +32,7 @@ class _Slot:
     self.process = None          # PyProcess when process-hosted
     self.actor: Optional[Actor] = None
     self.thread: Optional[threading.Thread] = None
+    self.generation: int = 0     # bumped on every (re)spawn
     self.last_heartbeat: float = time.monotonic()
     self.unrolls_done: int = 0
     self.respawns: int = 0
@@ -65,21 +66,34 @@ class ActorFleet:
 
   def _spawn(self, slot: _Slot):
     env, process, actor = self._make_actor(slot.index)
-    slot.env, slot.process, slot.actor = env, process, actor
-    slot.error = None
-    slot.last_heartbeat = time.monotonic()
+    with self._lock:
+      slot.generation += 1
+      generation = slot.generation
+      slot.env, slot.process, slot.actor = env, process, actor
+      slot.error = None
+      slot.last_heartbeat = time.monotonic()
     slot.thread = threading.Thread(
-        target=self._run, args=(slot, actor),
+        target=self._run, args=(slot, generation, actor, process),
         name=f'actor-{slot.index}', daemon=True)
     slot.thread.start()
 
-  def _run(self, slot: _Slot, actor: Actor):
+  def _run(self, slot: _Slot, generation: int, actor: Actor, process):
+    """Thread body. Touches only ITS OWN actor/process objects and
+    writes slot state only while it is still the slot's current
+    generation — an orphaned thread (replaced after a stall) must not
+    mark the healthy replacement dead or close its process."""
     from scalable_agent_tpu.ops.dynamic_batching import BatcherCancelled
+
+    def still_current():
+      return slot.generation == generation
+
     try:
       while not self._stop.is_set():
         unroll = actor.unroll()
         self._buffer.put(unroll)
         with self._lock:
+          if not still_current():
+            return  # orphaned: a replacement owns the slot now
           slot.last_heartbeat = time.monotonic()
           slot.unrolls_done += 1
     except (ring_buffer.Closed, BatcherCancelled):
@@ -87,18 +101,20 @@ class ActorFleet:
       # closed-pipe → StopIteration convention); a failure otherwise.
       if not self._stop.is_set():
         with self._lock:
-          slot.error = ring_buffer.Closed('buffer closed under actor')
+          if still_current():
+            slot.error = ring_buffer.Closed('buffer closed under actor')
     except BaseException as e:
       with self._lock:
-        slot.error = e
+        if still_current():
+          slot.error = e
     finally:
       try:
         actor.close()
       except Exception:
         pass
-      if slot.process is not None:
+      if process is not None:
         try:
-          slot.process.close(timeout=2.0)
+          process.close(timeout=2.0)
         except Exception:
           pass
 

@@ -1,0 +1,167 @@
+"""Env adapter tests: DMLab contract pieces (testable without
+deepmind_lab) and the Atari adapter against a scripted fake ALE.
+
+The real simulators are absent here (SURVEY §7 "no DMLab/ALE in this
+sandbox"); what IS testable: action-set shape, level cache, constructor
+kwargs (test-mode mixer seed / holdout flags), spec protocol, and the
+full Atari step/pool/resize/auto-reset behavior via an injected fake
+backend.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.envs import atari, base, dmlab, factory
+from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+
+
+# --- DMLab ---
+
+def test_default_action_set_shape():
+  arr = np.array(dmlab.DEFAULT_ACTION_SET)
+  assert arr.shape == (9, 7)  # 9 discrete composite actions, 7 axes
+  # One pure-fire action; look actions use +-20 pixel deltas.
+  assert any(row[4] == 1 and not row[:4].any() for row in arr)
+  assert {-20, 20} <= set(arr[:, 0])
+
+
+def test_local_level_cache_roundtrip(tmp_path):
+  cache = dmlab.LocalLevelCache(str(tmp_path / 'cache'))
+  src = tmp_path / 'level.pk3'
+  src.write_bytes(b'compiled-map')
+  dst = tmp_path / 'fetched.pk3'
+  assert not cache.fetch('key1', str(dst))
+  cache.write('key1', str(src))
+  assert cache.fetch('key1', str(dst))
+  assert dst.read_bytes() == b'compiled-map'
+
+
+def test_dmlab_constructor_kwargs_test_mode():
+  cfg = Config(width=96, height=72, dataset_path='/data/brady',
+               num_action_repeats=4)
+  kwargs = dmlab.constructor_kwargs('rooms_watermaze', seed=7,
+                                    is_test=True, config=cfg)
+  assert kwargs['level'] == 'rooms_watermaze'
+  assert kwargs['config']['allowHoldOutLevels'] == 'true'
+  assert int(kwargs['config']['mixerSeed']) == 0x600D5EED
+  assert kwargs['config']['datasetPath'] == '/data/brady'
+  train_kwargs = dmlab.constructor_kwargs('rooms_watermaze', seed=7,
+                                          is_test=False, config=cfg)
+  assert 'mixerSeed' not in train_kwargs['config']
+
+
+def test_dmlab_specs_and_import_guard():
+  specs = dmlab.DmLabEnv._tensor_specs(
+      'step', None, {'config': {'height': 72, 'width': 96}})
+  reward, done, (frame, instr) = specs
+  assert frame.shape == (72, 96, 3) and frame.dtype == np.uint8
+  assert instr.shape == (MAX_INSTRUCTION_LEN,)
+  assert reward.dtype == np.float32 and done.dtype == np.dtype(bool)
+  if dmlab.deepmind_lab is None:
+    with pytest.raises(ImportError, match='deepmind_lab'):
+      dmlab.DmLabEnv('rooms_watermaze',
+                     {'height': 72, 'width': 96}, seed=1)
+
+
+def test_factory_dmlab_spec():
+  cfg = Config(env_backend='dmlab', level_name='rooms_watermaze')
+  spec = factory.make_env_spec(cfg, 'rooms_watermaze', seed=2)
+  assert spec.num_actions == 9
+  assert spec.frame_shape == (72, 96, 3)
+
+
+# --- Atari preprocessing (pure) ---
+
+def test_resize_uint8_downsamples():
+  frame = np.zeros((210, 160, 3), np.uint8)
+  frame[0:105] = 200  # top half bright
+  out = atari.resize_uint8(frame, 72, 96)
+  assert out.shape == (72, 96, 3) and out.dtype == np.uint8
+  assert (out[:30] == 200).all() and (out[-30:] == 0).all()
+
+
+def test_resize_uint8_identity():
+  frame = np.arange(4 * 6 * 3, dtype=np.uint8).reshape(4, 6, 3)
+  np.testing.assert_array_equal(atari.resize_uint8(frame, 4, 6), frame)
+
+
+def test_pooled_frame_max():
+  a = np.full((2, 2, 3), 10, np.uint8)
+  b = np.full((2, 2, 3), 7, np.uint8)
+  b[0, 0] = 255
+  out = atari.pooled_frame((a, b))
+  assert out[0, 0, 0] == 255 and out[1, 1, 1] == 10
+
+
+# --- Atari adapter over a scripted backend ---
+
+class FakeAle:
+  """Deterministic ALE stand-in: frame = step counter; episode ends
+  after `episode_len` acts; reward = the action index."""
+
+  def __init__(self, episode_len=6):
+    self._episode_len = episode_len
+    self._t = 0
+    self._acts = 0
+    self.resets = 0
+
+  def action_set(self):
+    return [0, 1, 2, 3]
+
+  def reset(self):
+    self.resets += 1
+    self._acts = 0
+
+  def act(self, action):
+    self._t += 1
+    self._acts += 1
+    return float(action)
+
+  def game_over(self):
+    return self._acts >= self._episode_len
+
+  def screen_rgb(self):
+    return np.full((210, 160, 3), self._t % 256, np.uint8)
+
+
+def test_atari_env_step_and_auto_reset():
+  ale = FakeAle(episode_len=6)
+  env = atari.AtariEnv('pong', seed=0, height=24, width=32,
+                       num_action_repeats=4, noop_max=0, ale=ale)
+  frame, instr = env.initial()
+  assert frame.shape == (24, 32, 3)
+  assert (instr == 0).all()  # no language channel
+
+  reward, done, obs = env.step(2)
+  assert reward == 2.0 * 4  # action reward accumulated over repeats
+  assert not done
+  # Next step crosses the 6-act episode boundary: repeat loop breaks
+  # at game over, env auto-resets.
+  reward, done, obs = env.step(1)
+  assert done
+  assert ale.resets == 2  # initial + auto-reset
+  # Flicker pooling: frame is the max of the last two raw screens.
+  r, d, (frame, _) = env.step(0)
+  assert frame.max() == ale._t % 256
+
+
+def test_atari_noop_starts_bounded():
+  ale = FakeAle(episode_len=1000)
+  atari.AtariEnv('pong', seed=123, height=24, width=32,
+                 noop_max=30, ale=ale)
+  assert 0 <= ale._acts <= 30
+
+
+def test_atari_specs():
+  specs = atari.AtariEnv._tensor_specs('step', None,
+                                       {'height': 84, 'width': 84})
+  _, _, (frame, instr) = specs
+  assert frame.shape == (84, 84, 3)
+
+
+def test_atari_import_guard_message():
+  with pytest.raises(ImportError, match='Atari backend'):
+    atari._make_ale('definitely_not_a_game_xyz', 0, True)

@@ -114,3 +114,23 @@ def test_param_sharding_rules():
   for path, spec in specs.items():
     if 'policy_logits' in path or 'baseline' in path:
       assert 'model' not in str(spec)
+
+
+def test_global_batch_from_local_single_process():
+  """Single-process slice of the multi-host path: local numpy unrolls →
+  globally-sharded arrays on the data axis (parallel/distributed.py;
+  with one process the local batch IS the global batch)."""
+  from scalable_agent_tpu.parallel import distributed
+
+  mesh = mesh_lib.make_mesh(model_parallelism=1)
+  batch = _fake_batch(1, 5, 8)
+  spec = mesh_lib.batch_shardings(batch, mesh)
+  host_batch = jax.tree_util.tree_map(np.asarray, batch)
+  global_batch = distributed.global_batch_from_local(mesh, spec,
+                                                     host_batch)
+  assert global_batch.env_outputs.reward.shape == (5, 8)
+  assert (global_batch.env_outputs.reward.sharding.spec ==
+          spec.env_outputs.reward.spec)
+  np.testing.assert_array_equal(
+      np.asarray(global_batch.env_outputs.reward),
+      host_batch.env_outputs.reward)
