@@ -83,6 +83,10 @@ flags.DEFINE_bool('use_py_process', _DEFAULTS.use_py_process,
                   'Host each env in its own OS process.')
 flags.DEFINE_bool('use_instruction', _DEFAULTS.use_instruction,
                   'Enable the language/instruction channel.')
+flags.DEFINE_bool('use_popart', _DEFAULTS.use_popart,
+                  'PopArt per-task value normalization.')
+flags.DEFINE_float('pixel_control_cost', _DEFAULTS.pixel_control_cost,
+                   'UNREAL pixel-control aux loss weight (0 = off).')
 flags.DEFINE_integer('episode_length', _DEFAULTS.episode_length,
                      'Episode length of the fake/bandit backends.')
 flags.DEFINE_integer('publish_params_every',

@@ -59,6 +59,11 @@ class Config:
   use_instruction: bool = True
   compute_dtype: str = 'float32'          # float32 | bfloat16
   use_associative_scan: bool = False      # parallel V-trace recursion
+  use_popart: bool = False                # PopArt value normalization
+  popart_beta: float = 3e-4               # PopArt stats EMA step size
+  pixel_control_cost: float = 0.0         # >0 enables UNREAL aux task
+  pixel_control_discount: float = 0.9
+  pixel_control_cell_size: int = 4
   grad_clip_norm: Optional[float] = None
   checkpoint_secs: int = 600              # reference save_checkpoint_secs
   summary_secs: int = 30                  # reference save_summaries_secs

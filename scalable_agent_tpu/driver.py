@@ -47,11 +47,17 @@ from scalable_agent_tpu.runtime.inference import InferenceServer
 log = logging.getLogger('scalable_agent_tpu')
 
 
-def build_agent(config: Config, num_actions: int) -> ImpalaAgent:
+def build_agent(config: Config, num_actions: int,
+                num_tasks: int = 1) -> ImpalaAgent:
   dtype = (jnp.bfloat16 if config.compute_dtype == 'bfloat16'
            else jnp.float32)
   return ImpalaAgent(num_actions=num_actions, torso=config.torso,
-                     use_instruction=config.use_instruction, dtype=dtype)
+                     use_instruction=config.use_instruction,
+                     num_popart_tasks=(num_tasks if config.use_popart
+                                       else 0),
+                     use_pixel_control=config.pixel_control_cost > 0,
+                     pixel_control_cell_size=config.pixel_control_cell_size,
+                     dtype=dtype)
 
 
 def _choose_mesh(config: Config):
@@ -105,9 +111,10 @@ def train(config: Config, max_steps: Optional[int] = None,
   levels = factory.level_names(config)
   spec0 = factory.make_env_spec(config, levels[0], seed=1)
   num_actions = spec0.num_actions
-  agent = build_agent(config, num_actions)
+  agent = build_agent(config, num_actions, num_tasks=len(levels))
   params = init_params(agent, jax.random.PRNGKey(config.seed),
                        spec0.obs_spec)
+  num_popart_tasks = len(levels) if config.use_popart else 0
 
   # Multi-host: config.batch_size is GLOBAL; each host's fleet feeds
   # its process-local shard (SURVEY §5.8 — trajectory transport stays
@@ -127,11 +134,13 @@ def train(config: Config, max_steps: Optional[int] = None,
         config.unroll_length + 1, config.batch_size, h, w, num_actions,
         MAX_INSTRUCTION_LEN)
     state = train_parallel.make_sharded_train_state(
-        params, config, mesh, enable_tp=config.model_parallelism > 1)
+        params, config, mesh, enable_tp=config.model_parallelism > 1,
+        num_popart_tasks=num_popart_tasks)
     train_step, place_fn = train_parallel.make_sharded_train_step(
         agent, config, mesh, example_batch)
   else:
-    state = learner_lib.make_train_state(params, config)
+    state = learner_lib.make_train_state(params, config,
+                                         num_popart_tasks)
     train_step = learner_lib.make_train_step(agent, config)
     place_fn = lambda b: jax.tree_util.tree_map(  # noqa: E731
         lambda x: jax.device_put(np.asarray(x)), b)
@@ -308,13 +317,16 @@ def evaluate(config: Config) -> Dict[str, List[float]]:
   test_levels = factory.test_level_names(config)
   spec0 = factory.make_env_spec(config, test_levels[0], seed=1,
                                 is_test=True)
-  agent = build_agent(config, spec0.num_actions)
+  agent = build_agent(config, spec0.num_actions,
+                      num_tasks=len(train_levels))
   params = init_params(agent, jax.random.PRNGKey(config.seed),
                        spec0.obs_spec)
 
   checkpointer = checkpoint_lib.Checkpointer(
       config.logdir + '/checkpoints')
-  state = learner_lib.make_train_state(params, config)
+  state = learner_lib.make_train_state(
+      params, config,
+      len(train_levels) if config.use_popart else 0)
   restored = checkpointer.restore_latest(state)
   if restored is None:
     raise FileNotFoundError(

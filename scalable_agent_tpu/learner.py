@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import optax
 
 from scalable_agent_tpu import losses as losses_lib
+from scalable_agent_tpu import popart as popart_lib
+from scalable_agent_tpu import unreal
 from scalable_agent_tpu import vtrace
 from scalable_agent_tpu.config import Config
 from scalable_agent_tpu.structs import ActorOutput
@@ -37,6 +39,7 @@ class TrainState(NamedTuple):
   params: Any
   opt_state: Any
   update_steps: Any  # i32 [] — device-side; frames derived host-side.
+  popart: Any = None  # PopArtState when config.use_popart
 
 
 class VTraceInputs(NamedTuple):
@@ -85,13 +88,38 @@ def align_batch(env_outputs, agent_outputs, learner_outputs, config):
       bootstrap_value=bootstrap_value)
 
 
-def loss_fn(params, agent, batch: ActorOutput, config: Config):
-  """Total IMPALA loss for one batch; returns (loss, metrics)."""
-  learner_outputs, _ = agent.apply(
-      params, batch.agent_outputs.action, batch.env_outputs,
-      batch.agent_state)
+def loss_fn(params, agent, batch: ActorOutput, config: Config,
+            popart_state=None):
+  """Total IMPALA loss for one batch; returns (loss, (metrics, aux)).
+
+  With PopArt (popart_state not None): the agent's baseline is the
+  NORMALIZED per-task value; V-trace runs on the unnormalized σ·n + μ,
+  the baseline loss in normalized space with the CURRENT statistics
+  (the stats/preservation update happens in train_step, one step
+  behind — standard PopArt ordering). aux carries the vs targets for
+  that update."""
+  task_ids = jnp.asarray(batch.level_name).astype(jnp.int32)
+  use_pc = config.pixel_control_cost > 0
+  if use_pc:
+    ((learner_outputs, _), mutables) = agent.apply(
+        params, batch.agent_outputs.action, batch.env_outputs,
+        batch.agent_state, level_ids=task_ids,
+        compute_pixel_control=True, mutable=['intermediates'])
+    pc_q = mutables['intermediates']['pixel_control_q'][0]
+  else:
+    learner_outputs, _ = agent.apply(
+        params, batch.agent_outputs.action, batch.env_outputs,
+        batch.agent_state, level_ids=task_ids)
+
+  if popart_state is not None:
+    normalized = learner_outputs.baseline  # [T+1, B]
+    unnormalized = popart_lib.unnormalize(popart_state, normalized,
+                                          task_ids)
+    learner_for_align = learner_outputs._replace(baseline=unnormalized)
+  else:
+    learner_for_align = learner_outputs
   inputs = align_batch(batch.env_outputs, batch.agent_outputs,
-                       learner_outputs, config)
+                       learner_for_align, config)
 
   vtrace_returns = vtrace.from_logits(
       behaviour_policy_logits=inputs.behaviour_logits,
@@ -105,8 +133,16 @@ def loss_fn(params, agent, batch: ActorOutput, config: Config):
 
   pg_loss = losses_lib.compute_policy_gradient_loss(
       inputs.target_logits, inputs.actions, vtrace_returns.pg_advantages)
-  baseline_loss = losses_lib.compute_baseline_loss(
-      vtrace_returns.vs - inputs.values)
+  if popart_state is not None:
+    # Regress the normalized head toward normalized targets.
+    norm_targets = popart_lib.normalize(
+        popart_state, vtrace_returns.vs, task_ids)
+    baseline_loss = losses_lib.compute_baseline_loss(
+        jax.lax.stop_gradient(norm_targets) -
+        learner_outputs.baseline[:-1])
+  else:
+    baseline_loss = losses_lib.compute_baseline_loss(
+        vtrace_returns.vs - inputs.values)
   entropy_loss = losses_lib.compute_entropy_loss(inputs.target_logits)
 
   total_loss = (pg_loss + config.baseline_cost * baseline_loss +
@@ -117,7 +153,22 @@ def loss_fn(params, agent, batch: ActorOutput, config: Config):
       'baseline_loss': baseline_loss,
       'entropy_loss': entropy_loss,
   }
-  return total_loss, metrics
+  if use_pc:
+    # UNREAL pixel control (unreal.py): pseudo-rewards from frame
+    # deltas; action on the t→t+1 transition is agent_outputs[t+1]
+    # (the [1:] slice — same alignment as the policy inputs).
+    frames = batch.env_outputs.observation[0]
+    pc_rewards = unreal.pixel_control_rewards(
+        frames, config.pixel_control_cell_size)
+    pc_loss = unreal.pixel_control_loss(
+        pc_q, inputs.actions, pc_rewards,
+        jnp.asarray(batch.env_outputs.done)[1:],
+        discount=config.pixel_control_discount)
+    total_loss = total_loss + config.pixel_control_cost * pc_loss
+    metrics['pixel_control_loss'] = pc_loss
+    metrics['total_loss'] = total_loss
+  aux = {'vs': vtrace_returns.vs, 'task_ids': task_ids}
+  return total_loss, (metrics, aux)
 
 
 def frames_per_step(config: Config):
@@ -153,12 +204,15 @@ def make_optimizer(config: Config):
   return opt
 
 
-def make_train_state(params, config: Config) -> TrainState:
+def make_train_state(params, config: Config,
+                     num_popart_tasks: int = 0) -> TrainState:
   optimizer = make_optimizer(config)
   return TrainState(
       params=params,
       opt_state=optimizer.init(params),
-      update_steps=jnp.zeros((), jnp.int32))
+      update_steps=jnp.zeros((), jnp.int32),
+      popart=(popart_lib.init(max(num_popart_tasks, 1))
+              if config.use_popart else None))
 
 
 def make_train_step_fn(agent, config: Config):
@@ -169,15 +223,26 @@ def make_train_step_fn(agent, config: Config):
   schedule = make_schedule(config)
 
   def train_step(state: TrainState, batch: ActorOutput):
-    (total_loss, metrics), grads = jax.value_and_grad(
-        loss_fn, has_aux=True)(state.params, agent, batch, config)
+    (total_loss, (metrics, aux)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(state.params, agent, batch, config,
+                               state.popart)
     # Pre-clip norm: explosions must stay visible even with clipping on.
     metrics['grad_norm'] = optax.global_norm(grads)
     updates, new_opt_state = optimizer.update(
         grads, state.opt_state, state.params)
     new_params = optax.apply_updates(state.params, updates)
+    new_popart = state.popart
+    if state.popart is not None:
+      # PopArt: EMA the per-task moments toward this batch's targets,
+      # then rewrite the value head so unnormalized outputs are
+      # preserved exactly (popart.py).
+      new_popart = popart_lib.update_stats(
+          state.popart, aux['vs'], aux['task_ids'],
+          beta=config.popart_beta)
+      new_params = popart_lib.apply_preservation(
+          new_params, state.popart, new_popart)
     new_state = TrainState(new_params, new_opt_state,
-                           state.update_steps + 1)
+                           state.update_steps + 1, new_popart)
     metrics['learning_rate'] = schedule(state.update_steps)
     return new_state, metrics
 

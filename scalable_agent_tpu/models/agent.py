@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from scalable_agent_tpu.structs import AgentOutput
 from scalable_agent_tpu.models.torsos import TORSOS
 from scalable_agent_tpu.models.instruction import InstructionEncoder
+from scalable_agent_tpu.unreal import PixelControlHead
 
 
 class _ResetCore(nn.Module):
@@ -54,6 +55,12 @@ class ImpalaAgent(nn.Module):
   torso: str = 'deep'        # 'deep' (reference) | 'shallow' (paper)
   hidden_size: int = 256
   use_instruction: bool = True
+  # PopArt (popart.py): >0 ⇒ the value head emits one NORMALIZED value
+  # column per task and `level_ids` selects each trajectory's column.
+  num_popart_tasks: int = 0
+  # UNREAL pixel control (unreal.py): adds the auxiliary deconv Q-head.
+  use_pixel_control: bool = False
+  pixel_control_cell_size: int = 4
   dtype: jnp.dtype = jnp.float32
 
   def initial_state(self, batch_size):
@@ -63,7 +70,8 @@ class ImpalaAgent(nn.Module):
 
   @nn.compact
   def __call__(self, prev_actions, env_outputs, core_state,
-               sample_rng=None):
+               sample_rng=None, level_ids=None,
+               compute_pixel_control=False):
     """Unroll over a [T, B] trajectory.
 
     Args:
@@ -74,6 +82,13 @@ class ImpalaAgent(nn.Module):
       sample_rng: PRNG key → actions are sampled from the policy
         (actor/eval path, reference `tf.multinomial` ≈L165); None →
         argmax (learner path, where the action output is unused).
+      level_ids: i32 [B] task ids (PopArt only) — selects each
+        trajectory's value column. None → task 0 (the act-time path,
+        where the recorded baseline is unused by the learner).
+      compute_pixel_control: run the auxiliary pixel-control Q-head
+        and sow its output as intermediates['pixel_control_q']
+        ([T, B, Hc, Wc, A]) — learner path only; actors skip the
+        deconv cost. Params exist either way (created at init).
 
     Returns:
       (AgentOutput([T, B, ...]), final core_state).
@@ -109,12 +124,30 @@ class ImpalaAgent(nn.Module):
 
     # --- Heads over merged time+batch. ---
     flat_core = core_out.reshape(t * b, -1)
+    if self.use_pixel_control and (compute_pixel_control or
+                                   self.is_initializing()):
+      cell = self.pixel_control_cell_size
+      hc, wc = frame.shape[2] // cell, frame.shape[3] // cell
+      pc_q = PixelControlHead(self.num_actions, (hc, wc),
+                              dtype=self.dtype,
+                              name='pixel_control')(flat_core)
+      self.sow('intermediates', 'pixel_control_q',
+               pc_q.reshape(t, b, hc, wc, self.num_actions))
     policy_logits = nn.Dense(self.num_actions, dtype=self.dtype,
                              name='policy_logits')(flat_core)
-    baseline = nn.Dense(1, dtype=self.dtype, name='baseline')(flat_core)
+    num_values = max(self.num_popart_tasks, 1)
+    baseline = nn.Dense(num_values, dtype=self.dtype,
+                        name='baseline')(flat_core)
     policy_logits = policy_logits.astype(jnp.float32).reshape(
         t, b, self.num_actions)
-    baseline = baseline.astype(jnp.float32).reshape(t, b)
+    baseline = baseline.astype(jnp.float32).reshape(t, b, num_values)
+    if self.num_popart_tasks:
+      if level_ids is None:
+        level_ids = jnp.zeros((b,), jnp.int32)
+      baseline = jnp.take_along_axis(
+          baseline, level_ids[None, :, None].astype(jnp.int32),
+          axis=2)
+    baseline = baseline[..., 0]
 
     if sample_rng is not None:
       action = jax.random.categorical(sample_rng, policy_logits, axis=-1)

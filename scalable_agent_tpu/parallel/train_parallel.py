@@ -20,12 +20,13 @@ from scalable_agent_tpu.parallel import mesh as mesh_lib
 
 
 def make_sharded_train_state(params, config: Config, mesh: Mesh,
-                             enable_tp: bool = False):
+                             enable_tp: bool = False,
+                             num_popart_tasks: int = 0):
   """Place params on the mesh (replicated, or TP-sharded kernels) and
   build the TrainState there; opt state inherits param placements."""
   p_shard = mesh_lib.param_shardings(params, mesh, enable_tp)
   params = jax.tree_util.tree_map(jax.device_put, params, p_shard)
-  return learner_lib.make_train_state(params, config)
+  return learner_lib.make_train_state(params, config, num_popart_tasks)
 
 
 def make_sharded_train_step(agent, config: Config, mesh: Mesh,

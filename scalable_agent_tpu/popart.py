@@ -1,0 +1,121 @@
+"""PopArt value normalization (multi-task IMPALA extension).
+
+NOT in the reference — listed there as a planned extension (SURVEY
+§2.12 / BASELINE.json config ladder). Implements Pop-Art ("Preserving
+Outputs Precisely while Adaptively Rescaling Targets", van Hasselt et
+al. 2016) as used by multi-task PopArt-IMPALA (Hessel et al. 2018):
+
+- the value head emits NORMALIZED per-task values n_i(x) (one output
+  column per task; the agent selects the column for each trajectory's
+  task id);
+- per-task first/second moments (μ_i, ν_i) track the V-trace targets
+  with an EMA; σ_i = sqrt(ν_i − μ_i²), clipped;
+- V-trace runs on UNNORMALIZED values σ·n + μ; the baseline loss runs
+  in normalized space (targets (vs − μ)/σ);
+- whenever the statistics move, the head's weights are rewritten so
+  its unnormalized outputs are preserved exactly:
+      w'_i = w_i·σ_i/σ'_i,   b'_i = (σ_i·b_i + μ_i − μ'_i)/σ'_i.
+
+Everything is a pure function over `PopArtState` — it lives in the
+TrainState pytree, is checkpointed with it, and runs inside the one
+jitted learner step.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# Paper defaults (Hessel et al. 2018 §3 / appendix).
+DEFAULT_BETA = 3e-4
+DEFAULT_SIGMA_MIN = 1e-4
+DEFAULT_SIGMA_MAX = 1e6
+
+
+class PopArtState(NamedTuple):
+  mu: Any   # f32 [num_tasks] — first moment of value targets
+  nu: Any   # f32 [num_tasks] — second moment
+  sigma_min: Any = DEFAULT_SIGMA_MIN
+  sigma_max: Any = DEFAULT_SIGMA_MAX
+
+
+def init(num_tasks: int, sigma_min: float = DEFAULT_SIGMA_MIN,
+         sigma_max: float = DEFAULT_SIGMA_MAX) -> PopArtState:
+  """μ=0, ν=1 ⇒ σ=1: normalization starts as the identity."""
+  return PopArtState(
+      mu=jnp.zeros((num_tasks,), jnp.float32),
+      nu=jnp.ones((num_tasks,), jnp.float32),
+      sigma_min=jnp.float32(sigma_min),
+      sigma_max=jnp.float32(sigma_max))
+
+
+def sigma(state: PopArtState):
+  return jnp.clip(jnp.sqrt(state.nu - jnp.square(state.mu)),
+                  state.sigma_min, state.sigma_max)
+
+
+def unnormalize(state: PopArtState, normalized_values, task_ids):
+  """σ[task]·n + μ[task]. task_ids broadcasts against the trailing
+  batch dim of [T, B] values (ids are per-trajectory, [B])."""
+  return (sigma(state)[task_ids] * normalized_values +
+          state.mu[task_ids])
+
+
+def normalize(state: PopArtState, values, task_ids):
+  return (values - state.mu[task_ids]) / sigma(state)[task_ids]
+
+
+def update_stats(state: PopArtState, targets, task_ids,
+                 beta: float = DEFAULT_BETA) -> PopArtState:
+  """EMA the per-task moments toward this batch's value targets.
+
+  Args:
+    state: current statistics.
+    targets: f32 [T, B] unnormalized value targets (V-trace vs).
+    task_ids: i32 [B] task id per trajectory.
+    beta: EMA step size. Tasks absent from the batch keep their stats
+      (their effective beta is 0 — no decay toward unseen data).
+  """
+  num_tasks = state.mu.shape[0]
+  onehot = jax.nn.one_hot(task_ids, num_tasks, dtype=jnp.float32)  # [B,K]
+  count = jnp.einsum('tb,bk->k', jnp.ones_like(targets), onehot)
+  total = jnp.einsum('tb,bk->k', targets, onehot)
+  total_sq = jnp.einsum('tb,bk->k', jnp.square(targets), onehot)
+  present = count > 0
+  safe = jnp.maximum(count, 1.0)
+  batch_mu = total / safe
+  batch_nu = total_sq / safe
+  new_mu = jnp.where(present, (1 - beta) * state.mu + beta * batch_mu,
+                     state.mu)
+  new_nu = jnp.where(present, (1 - beta) * state.nu + beta * batch_nu,
+                     state.nu)
+  return state._replace(mu=new_mu, nu=new_nu)
+
+
+def preserve_outputs(kernel, bias, old: PopArtState, new: PopArtState):
+  """Rewrite the value head so unnormalized outputs are unchanged.
+
+  kernel: f32 [hidden, num_tasks]; bias: f32 [num_tasks]. Returns the
+  rewritten (kernel, bias). Exact per task: for every input x,
+  σ'·(w'x + b') + μ' == σ·(wx + b) + μ.
+  """
+  old_sigma, new_sigma = sigma(old), sigma(new)
+  new_kernel = kernel * (old_sigma / new_sigma)[None, :]
+  new_bias = (old_sigma * bias + old.mu - new.mu) / new_sigma
+  return new_kernel, new_bias
+
+
+def apply_preservation(params, old: PopArtState, new: PopArtState,
+                       head_name: str = 'baseline'):
+  """preserve_outputs applied inside the agent param pytree (flax
+  layout: params['params'][head_name]{'kernel','bias'})."""
+  tree = params['params'] if 'params' in params else params
+  head = tree[head_name]
+  new_kernel, new_bias = preserve_outputs(head['kernel'], head['bias'],
+                                          old, new)
+  new_head = dict(head, kernel=new_kernel, bias=new_bias)
+  new_tree = dict(tree)
+  new_tree[head_name] = new_head
+  if 'params' in params:
+    return dict(params, params=new_tree)
+  return new_tree

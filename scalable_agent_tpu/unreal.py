@@ -1,0 +1,118 @@
+"""UNREAL pixel-control auxiliary task.
+
+NOT in the reference — a planned extension (SURVEY §2.12 / BASELINE
+config ladder). Implements the pixel-control auxiliary objective of
+UNREAL ("Reinforcement Learning with Unsupervised Auxiliary Tasks",
+Jaderberg et al. 2017 §3.1):
+
+- pseudo-rewards: the frame is divided into `cell_size`×`cell_size`
+  cells; the reward for a cell at step t is the mean absolute pixel
+  change within the cell between consecutive observations;
+- an auxiliary dueling Q-head (deconv from the LSTM output) predicts,
+  per cell and per action, the discounted pseudo-return of maximally
+  changing that cell;
+- the loss is n-step Q-learning over the unroll, bootstrapped from
+  max_a Q at the final frame (the same backward-recursion shape as
+  V-trace — `lax.scan` over reversed time).
+
+Everything here is pure JAX over [T, B] time-major tensors; the head
+itself lives in models/agent.py (it needs the LSTM features).
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+DEFAULT_CELL_SIZE = 4
+DEFAULT_DISCOUNT = 0.9
+
+
+def pixel_control_rewards(frames, cell_size: int = DEFAULT_CELL_SIZE):
+  """Per-cell mean |Δpixel| between consecutive frames.
+
+  Args:
+    frames: uint8/float [T+1, B, H, W, C] observations (H, W divisible
+      by cell_size).
+  Returns:
+    f32 [T, B, H/cell, W/cell] pseudo-rewards; entry t covers the
+    transition from frame t to frame t+1.
+  """
+  t1, b, h, w, c = frames.shape
+  if h % cell_size or w % cell_size:
+    raise ValueError(
+        f'frame {h}x{w} not divisible by pixel-control cell_size '
+        f'{cell_size}')
+  f = frames.astype(jnp.float32) / 255.0
+  diff = jnp.abs(f[1:] - f[:-1])  # [T, B, H, W, C]
+  hc, wc = h // cell_size, w // cell_size
+  diff = diff.reshape(t1 - 1, b, hc, cell_size, wc, cell_size, c)
+  return diff.mean(axis=(3, 5, 6))
+
+
+class PixelControlHead(nn.Module):
+  """Dueling deconv Q-head: LSTM features → [Hc, Wc, A] Q-values.
+
+  UNREAL §3.1 architecture shape: FC → spatial map → deconv ×2 → dueling
+  value/advantage maps. `target_cells` = (H/cell, W/cell) of the frame.
+  """
+  num_actions: int
+  target_cells: Any  # (hc, wc)
+  dtype: jnp.dtype = jnp.float32
+
+  @nn.compact
+  def __call__(self, core_out):
+    hc, wc = self.target_cells
+    # Round the base grid UP so the stride-2 deconv covers the target;
+    # crop after (odd cell grids — e.g. 84x84/4 → 21x21 — just work).
+    base_h, base_w, ch = (hc + 1) // 2, (wc + 1) // 2, 32
+    x = nn.Dense(base_h * base_w * ch, dtype=self.dtype,
+                 name='pc_fc')(core_out)
+    x = nn.relu(x)
+    x = x.reshape(x.shape[0], base_h, base_w, ch)
+    x = nn.ConvTranspose(ch, (4, 4), strides=(2, 2), padding='SAME',
+                         dtype=self.dtype, name='pc_deconv')(x)
+    x = nn.relu(x)[:, :hc, :wc]
+    value = nn.ConvTranspose(1, (3, 3), padding='SAME',
+                             dtype=self.dtype, name='pc_value')(x)
+    advantage = nn.ConvTranspose(self.num_actions, (3, 3),
+                                 padding='SAME', dtype=self.dtype,
+                                 name='pc_advantage')(x)
+    advantage = advantage - advantage.mean(axis=-1, keepdims=True)
+    return (value + advantage).astype(jnp.float32)  # [N, hc, wc, A]
+
+
+def pixel_control_loss(q_values, actions, rewards, done,
+                       discount: float = DEFAULT_DISCOUNT):
+  """n-step Q loss for the pixel-control head.
+
+  Args:
+    q_values: f32 [T+1, B, Hc, Wc, A] — Q at every observation; the
+      last frame provides the max-Q bootstrap.
+    actions: i32 [T, B] — action taken on the t→t+1 transition.
+    rewards: f32 [T, B, Hc, Wc] pseudo-rewards (pixel_control_rewards).
+    done: bool [T, B] — done[t] True ⇒ the t'th transition crosses an
+      episode reset (frame t+1 starts a new episode): no reward flows
+      and the return recursion cuts.
+  Returns:
+    scalar loss: 0.5·Σ_cells (target − Q[a])², meaned over T and B.
+  """
+  not_done = (~done).astype(jnp.float32)[..., None, None]  # [T,B,1,1]
+  rewards = rewards * not_done
+  bootstrap = q_values[-1].max(axis=-1)  # [B, Hc, Wc]
+
+  def step(carry, inputs):
+    r, nd = inputs
+    ret = r + discount * nd * carry
+    return ret, ret
+
+  _, targets = jax.lax.scan(
+      step, bootstrap, (jnp.flip(rewards, 0), jnp.flip(not_done, 0)))
+  targets = jnp.flip(targets, 0)  # [T, B, Hc, Wc]
+  targets = jax.lax.stop_gradient(targets)
+
+  q_taken = jnp.take_along_axis(
+      q_values[:-1], actions[:, :, None, None, None], axis=-1)[..., 0]
+  per_step = 0.5 * jnp.square(targets - q_taken).sum(axis=(2, 3))
+  return per_step.mean()

@@ -1,0 +1,137 @@
+"""UNREAL pixel-control tests: pseudo-rewards against hand-computed
+cell deltas, the n-step Q recursion against an explicit python loop,
+and the learner integration (aux loss trains, gradients reach the
+torso through the aux head).
+
+Pixel control is a TPU-build extension (SURVEY §2.12 — planned, not in
+the reference); ground truth is Jaderberg et al. 2017 §3.1.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from scalable_agent_tpu import learner as learner_lib
+from scalable_agent_tpu import unreal
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.models import ImpalaAgent, init_params
+from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+from scalable_agent_tpu.testing import make_example_batch
+
+
+def test_pixel_control_rewards_hand_computed():
+  # 2 frames, 1 env, 8x8, cell 4 → 2x2 cells.
+  frames = np.zeros((2, 1, 8, 8, 3), np.uint8)
+  frames[1, 0, :4, :4] = 255        # top-left cell fully changes
+  frames[1, 0, 4:, :4, 0] = 51      # bottom-left: one channel, 51/255
+  r = np.asarray(unreal.pixel_control_rewards(jnp.asarray(frames), 4))
+  assert r.shape == (1, 1, 2, 2)
+  np.testing.assert_allclose(r[0, 0, 0, 0], 1.0, rtol=1e-6)
+  np.testing.assert_allclose(r[0, 0, 1, 0], (51 / 255.0) / 3, rtol=1e-5)
+  np.testing.assert_allclose(r[0, 0, 0, 1], 0.0)
+  np.testing.assert_allclose(r[0, 0, 1, 1], 0.0)
+
+
+def test_pixel_control_loss_matches_python_recursion():
+  rng = np.random.RandomState(0)
+  t, b, hc, wc, a = 5, 2, 3, 3, 4
+  q = rng.randn(t + 1, b, hc, wc, a).astype(np.float32)
+  actions = rng.randint(0, a, (t, b)).astype(np.int32)
+  rewards = rng.rand(t, b, hc, wc).astype(np.float32)
+  done = np.zeros((t, b), bool)
+  done[2, 1] = True  # cut the recursion mid-sequence for env 1
+  gamma = 0.9
+
+  # Explicit per-(t, b) python ground truth.
+  targets = np.zeros((t, b, hc, wc), np.float32)
+  for bi in range(b):
+    acc = q[-1, bi].max(axis=-1)
+    for ti in reversed(range(t)):
+      if done[ti, bi]:
+        acc = np.zeros_like(acc)
+        r = np.zeros_like(rewards[ti, bi])
+      else:
+        r = rewards[ti, bi]
+      acc = r + gamma * acc
+      targets[ti, bi] = acc
+  expected = 0.0
+  for ti in range(t):
+    for bi in range(b):
+      q_taken = q[ti, bi, :, :, actions[ti, bi]]
+      expected += 0.5 * np.square(targets[ti, bi] - q_taken).sum()
+  expected /= t * b
+
+  loss = float(unreal.pixel_control_loss(
+      jnp.asarray(q), jnp.asarray(actions), jnp.asarray(rewards),
+      jnp.asarray(done), discount=gamma))
+  np.testing.assert_allclose(loss, expected, rtol=1e-5)
+
+
+def test_head_shapes_and_sow():
+  a = 4
+  agent = ImpalaAgent(num_actions=a, torso='shallow',
+                      use_pixel_control=True, use_instruction=False)
+  obs = {'frame': (24, 32, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+  params = init_params(agent, jax.random.PRNGKey(0), obs)
+  assert 'pixel_control' in params['params']
+  batch = make_example_batch(3, 2, 24, 32, a, MAX_INSTRUCTION_LEN)
+  ((out, _), mutables) = agent.apply(
+      params, batch.agent_outputs.action, batch.env_outputs,
+      batch.agent_state, compute_pixel_control=True,
+      mutable=['intermediates'])
+  pc_q = mutables['intermediates']['pixel_control_q'][0]
+  assert pc_q.shape == (3, 2, 6, 8, a)
+  # Actor path: no intermediates computed, same params work.
+  out2, _ = agent.apply(params, batch.agent_outputs.action,
+                        batch.env_outputs, batch.agent_state)
+  assert out2.policy_logits.shape == out.policy_logits.shape
+
+
+def test_learner_with_pixel_control_trains():
+  a, h, w = 4, 24, 32
+  obs = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+  cfg = Config(batch_size=2, unroll_length=4, num_action_repeats=1,
+               total_environment_frames=10**6, torso='shallow',
+               pixel_control_cost=0.01)
+  agent = ImpalaAgent(num_actions=a, torso='shallow',
+                      use_pixel_control=True)
+  params = init_params(agent, jax.random.PRNGKey(0), obs)
+  state = learner_lib.make_train_state(params, cfg)
+  step = learner_lib.make_train_step(agent, cfg)
+  batch = make_example_batch(5, 2, h, w, a, MAX_INSTRUCTION_LEN,
+                             done_prob=0.1)
+  # Snapshot BEFORE the step: train_step donates the state, deleting
+  # the original param buffers.
+  before = np.asarray(
+      params['params']['pixel_control']['pc_fc']['kernel']).copy()
+  state, metrics = step(state, batch)
+  assert np.isfinite(float(metrics['total_loss']))
+  assert float(metrics['pixel_control_loss']) > 0.0
+  # The aux head's params must have received gradient.
+  after = state.params['params']['pixel_control']['pc_fc']['kernel']
+  assert not np.allclose(before, np.asarray(after))
+
+
+def test_head_odd_cell_grid():
+  """84x84 Atari with cell 4 → 21x21 cells (odd): the deconv stack
+  rounds up and crops rather than crashing."""
+  a = 4
+  agent = ImpalaAgent(num_actions=a, torso='shallow',
+                      use_pixel_control=True, use_instruction=False)
+  obs = {'frame': (84, 84, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+  params = init_params(agent, jax.random.PRNGKey(0), obs)
+  batch = make_example_batch(3, 1, 84, 84, a, MAX_INSTRUCTION_LEN)
+  ((_, _), mutables) = agent.apply(
+      params, batch.agent_outputs.action, batch.env_outputs,
+      batch.agent_state, compute_pixel_control=True,
+      mutable=['intermediates'])
+  assert mutables['intermediates']['pixel_control_q'][0].shape == (
+      3, 1, 21, 21, a)
+
+
+def test_rewards_indivisible_frame_raises():
+  import pytest
+  frames = jnp.zeros((2, 1, 10, 8, 3), jnp.uint8)
+  with pytest.raises(ValueError, match='not divisible'):
+    unreal.pixel_control_rewards(frames, 4)
