@@ -59,6 +59,7 @@ class Config:
   use_instruction: bool = True
   compute_dtype: str = 'float32'          # float32 | bfloat16
   use_associative_scan: bool = False      # parallel V-trace recursion
+  use_pallas_vtrace: bool = False         # fused Pallas V-trace kernel
   use_popart: bool = False                # PopArt value normalization
   popart_beta: float = 3e-4               # PopArt stats EMA step size
   pixel_control_cost: float = 0.0         # >0 enables UNREAL aux task

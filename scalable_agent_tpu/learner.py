@@ -129,7 +129,8 @@ def loss_fn(params, agent, batch: ActorOutput, config: Config,
       rewards=inputs.rewards,
       values=inputs.values,
       bootstrap_value=inputs.bootstrap_value,
-      use_associative_scan=config.use_associative_scan)
+      use_associative_scan=config.use_associative_scan,
+      use_pallas=config.use_pallas_vtrace)
 
   pg_loss = losses_lib.compute_policy_gradient_loss(
       inputs.target_logits, inputs.actions, vtrace_returns.pg_advantages)

@@ -50,8 +50,13 @@ def init(num_tasks: int, sigma_min: float = DEFAULT_SIGMA_MIN,
 
 
 def sigma(state: PopArtState):
-  return jnp.clip(jnp.sqrt(state.nu - jnp.square(state.mu)),
-                  state.sigma_min, state.sigma_max)
+  # Clip the VARIANCE before the sqrt: float rounding can push
+  # nu - mu² slightly negative for a near-constant-target task, and
+  # sqrt(negative) = NaN would poison the head permanently.
+  variance = jnp.clip(state.nu - jnp.square(state.mu),
+                      jnp.square(state.sigma_min),
+                      jnp.square(state.sigma_max))
+  return jnp.sqrt(variance)
 
 
 def unnormalize(state: PopArtState, normalized_values, task_ids):

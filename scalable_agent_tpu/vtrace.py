@@ -48,7 +48,7 @@ def log_probs_from_logits_and_actions(policy_logits, actions):
 def from_logits(behaviour_policy_logits, target_policy_logits, actions,
                 discounts, rewards, values, bootstrap_value,
                 clip_rho_threshold=1.0, clip_pg_rho_threshold=1.0,
-                use_associative_scan=False):
+                use_associative_scan=False, use_pallas=False):
   """V-trace for softmax policies (reference: vtrace.py ≈L80).
 
   Shapes (time-major): logits [T, B, NUM_ACTIONS], actions [T, B],
@@ -68,7 +68,8 @@ def from_logits(behaviour_policy_logits, target_policy_logits, actions,
       bootstrap_value=bootstrap_value,
       clip_rho_threshold=clip_rho_threshold,
       clip_pg_rho_threshold=clip_pg_rho_threshold,
-      use_associative_scan=use_associative_scan)
+      use_associative_scan=use_associative_scan,
+      use_pallas=use_pallas)
   return VTraceFromLogitsReturns(
       log_rhos=log_rhos,
       behaviour_action_log_probs=behaviour_action_log_probs,
@@ -110,14 +111,35 @@ def _vs_minus_v_xs_associative(deltas, discounts_cs):
 def from_importance_weights(log_rhos, discounts, rewards, values,
                             bootstrap_value, clip_rho_threshold=1.0,
                             clip_pg_rho_threshold=1.0,
-                            use_associative_scan=False):
+                            use_associative_scan=False,
+                            use_pallas=False):
   """V-trace from log importance weights (reference: vtrace.py ≈L130).
 
   rhos = exp(log_rhos); clipped at `clip_rho_threshold` (rho-bar) for the
   value fixpoint and `clip_pg_rho_threshold` for the policy-gradient
   advantage; cs = min(1, rhos). Outputs are stop-gradient'ed exactly like
   the reference.
+
+  `use_pallas=True` runs the whole computation as one fused Pallas TPU
+  kernel (ops/vtrace_pallas.py) — no HBM intermediates; interpreter
+  mode off-TPU keeps CI on the same code path.
   """
+  if use_pallas:
+    from scalable_agent_tpu.ops import vtrace_pallas
+    # Stop gradients on the INPUTS: the outputs are stop-gradiented
+    # anyway (below and in the reference), and pallas_call has no jvp
+    # rule — tangents reaching the kernel under value_and_grad would
+    # fail at trace time.
+    (log_rhos, discounts, rewards, values,
+     bootstrap_value) = jax.tree_util.tree_map(
+         lax.stop_gradient,
+         (log_rhos, discounts, rewards, values, bootstrap_value))
+    vs, pg_advantages = vtrace_pallas.from_importance_weights(
+        log_rhos, discounts, rewards, values, bootstrap_value,
+        clip_rho_threshold=clip_rho_threshold,
+        clip_pg_rho_threshold=clip_pg_rho_threshold)
+    return VTraceReturns(vs=lax.stop_gradient(vs),
+                         pg_advantages=lax.stop_gradient(pg_advantages))
   log_rhos = jnp.asarray(log_rhos, jnp.float32)
   discounts = jnp.asarray(discounts, jnp.float32)
   rewards = jnp.asarray(rewards, jnp.float32)

@@ -171,3 +171,19 @@ def test_popart_unnormalized_values_continuous_across_update():
   # …but with lr=0 the unnormalized predictions are preserved.
   after = unnorm_values(state2)
   np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-4)
+
+
+def test_sigma_no_nan_for_near_constant_targets():
+  """Float rounding can push nu - mu² slightly negative for a
+  near-constant-target task; sigma must clip the variance BEFORE the
+  sqrt (a NaN here poisons the head permanently)."""
+  state = popart.init(1)
+  targets = jnp.full((4, 1), 1000.07, jnp.float32)
+  ids = jnp.array([0])
+  for _ in range(60):
+    state = popart.update_stats(state, targets, ids, beta=0.1)
+  s = np.asarray(popart.sigma(state))
+  assert np.all(np.isfinite(s)), s
+  assert s[0] >= float(state.sigma_min)
+  n = popart.normalize(state, targets, ids)
+  assert np.all(np.isfinite(np.asarray(n)))
