@@ -126,6 +126,13 @@ def train(config: Config, max_steps: Optional[int] = None,
   local_batch_size = config.batch_size // num_processes
 
   mesh = _choose_mesh(config)
+  if mesh is not None and config.use_pallas_vtrace:
+    # pallas_call has no SPMD partitioning rule: under the sharded
+    # step it would be rejected or force replication of the [T, B]
+    # operands. (CI can't catch this — interpret mode off-TPU lowers
+    # to plain ops, which partition fine.)
+    raise ValueError('use_pallas_vtrace is single-device only; disable '
+                     'it or run without a mesh')
   if mesh is not None:
     from scalable_agent_tpu.testing import make_example_batch
     from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN

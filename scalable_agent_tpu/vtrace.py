@@ -124,6 +124,9 @@ def from_importance_weights(log_rhos, discounts, rewards, values,
   kernel (ops/vtrace_pallas.py) — no HBM intermediates; interpreter
   mode off-TPU keeps CI on the same code path.
   """
+  if use_pallas and use_associative_scan:
+    raise ValueError('use_pallas and use_associative_scan are mutually '
+                     'exclusive — pick one V-trace form')
   if use_pallas:
     from scalable_agent_tpu.ops import vtrace_pallas
     # Stop gradients on the INPUTS: the outputs are stop-gradiented

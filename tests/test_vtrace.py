@@ -331,3 +331,10 @@ class TestVtracePallas:
 
     g = jax.grad(loss)(values['values'])
     assert np.all(np.isfinite(np.asarray(g)))
+
+  def test_pallas_and_associative_scan_mutually_exclusive(self):
+    values = _make_inputs(1)
+    with pytest.raises(ValueError, match='mutually exclusive'):
+      vtrace.from_importance_weights(use_pallas=True,
+                                     use_associative_scan=True,
+                                     **values)
