@@ -44,7 +44,7 @@ def main():
   h, w = (72, 96) if not smoke else (24, 32)
 
   agent = ImpalaAgent(num_actions=num_actions, torso=cfg.torso,
-                      dtype=jnp.bfloat16)
+                      scan_unroll=cfg.scan_unroll, dtype=jnp.bfloat16)
   obs_spec = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
   params = init_params(agent, jax.random.PRNGKey(0), obs_spec)
 

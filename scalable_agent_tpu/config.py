@@ -56,6 +56,9 @@ class Config:
   publish_params_every: int = 1           # actor weight-snapshot cadence
   model_parallelism: int = 1              # TP width of the mesh
   torso: str = 'deep'                     # deep | shallow
+  scan_unroll: int = 5                    # LSTM time-scan unroll factor
+                                          # (measured ~7% step-time win
+                                          # on v5e at T=100, B=32)
   use_instruction: bool = True
   compute_dtype: str = 'float32'          # float32 | bfloat16
   use_associative_scan: bool = False      # parallel V-trace recursion

@@ -57,6 +57,7 @@ def build_agent(config: Config, num_actions: int,
                                        else 0),
                      use_pixel_control=config.pixel_control_cost > 0,
                      pixel_control_cell_size=config.pixel_control_cell_size,
+                     scan_unroll=config.scan_unroll,
                      dtype=dtype)
 
 
@@ -125,6 +126,11 @@ def train(config: Config, max_steps: Optional[int] = None,
                      f'process count {num_processes}')
   local_batch_size = config.batch_size // num_processes
 
+  if config.use_pallas_vtrace and config.use_associative_scan:
+    # Fail before any env/checkpoint spin-up (vtrace re-checks at
+    # trace time for library users).
+    raise ValueError('use_pallas_vtrace and use_associative_scan are '
+                     'mutually exclusive')
   mesh = _choose_mesh(config)
   if mesh is not None and config.use_pallas_vtrace:
     # pallas_call has no SPMD partitioning rule: under the sharded

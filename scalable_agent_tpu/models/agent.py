@@ -61,6 +61,10 @@ class ImpalaAgent(nn.Module):
   # UNREAL pixel control (unreal.py): adds the auxiliary deconv Q-head.
   use_pixel_control: bool = False
   pixel_control_cell_size: int = 4
+  # Partial unrolling of the LSTM time scan (XLA loop unroll factor):
+  # amortizes per-iteration loop overhead on TPU; must divide nothing
+  # (lax.scan handles remainders). 1 = plain scan.
+  scan_unroll: int = 1
   dtype: jnp.dtype = jnp.float32
 
   def initial_state(self, batch_size):
@@ -114,7 +118,7 @@ class ImpalaAgent(nn.Module):
     scan = nn.scan(
         lambda core, carry, x: core(carry, x),
         variable_broadcast='params', split_rngs={'params': False},
-        in_axes=0, out_axes=0)
+        in_axes=0, out_axes=0, unroll=self.scan_unroll)
     core = _ResetCore(self.hidden_size, dtype=self.dtype)
     core_state = jax.tree_util.tree_map(
         lambda s: s.astype(self.dtype), core_state)

@@ -61,10 +61,13 @@ class InstructionEncoder(nn.Module):
     emb = nn.Embed(self.vocab_size + 1, self.embed_size,
                    dtype=self.dtype)(ids)  # [B, L, E]
     cell = nn.OptimizedLSTMCell(self.lstm_size, dtype=self.dtype)
+    # Fully unrolled: L=16 steps — unrolling removes the XLA loop
+    # overhead entirely (measured win on v5e; see models/agent.py
+    # scan_unroll for the time-scan analog).
     scan = nn.scan(
         lambda c, carry, x: c(carry, x),
         variable_broadcast='params', split_rngs={'params': False},
-        in_axes=1, out_axes=1)
+        in_axes=1, out_axes=1, unroll=True)
     import jax
     carry = cell.initialize_carry(
         jax.random.PRNGKey(0), (batch, self.embed_size))

@@ -87,3 +87,33 @@ def test_evaluate_without_checkpoint_raises(tmp_path):
   cfg = _config(tmp_path)
   with pytest.raises(FileNotFoundError):
     driver.evaluate(cfg)
+
+
+def test_train_with_popart_and_pixel_control(tmp_path):
+  """The extension stack end-to-end through the driver: PopArt state
+  lives in the TrainState, checkpoints, and restores; the aux loss
+  contributes."""
+  cfg = _config(tmp_path, use_popart=True, pixel_control_cost=0.01,
+                height=24, width=32)
+  run = driver.train(cfg, max_steps=3, stall_timeout_secs=60)
+  assert run.state.popart is not None
+  mu = np.asarray(run.state.popart.mu)
+  assert mu.shape == (1,)  # single level
+  assert np.all(np.isfinite(mu))
+
+  # Resume restores the PopArt stats alongside params (max_steps=0:
+  # the returned state is exactly the restored checkpoint).
+  run2 = driver.train(cfg, max_steps=0, stall_timeout_secs=60)
+  assert int(run2.state.update_steps) == 3
+  np.testing.assert_allclose(np.asarray(run2.state.popart.mu)[0],
+                             mu[0], rtol=1e-6)
+
+
+def test_train_with_process_hosted_envs(tmp_path):
+  """The production env-hosting path (use_py_process=True): each env in
+  its own OS process behind the spec protocol, through the full driver."""
+  cfg = _config(tmp_path, use_py_process=True, num_actors=2)
+  run = driver.train(cfg, max_steps=2, stall_timeout_secs=120)
+  assert int(run.state.update_steps) == 2
+  stats = run.fleet.stats()
+  assert stats['unrolls'] >= 2
