@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# CI entry: everything the repo can verify without real simulators.
+# (The reference ships no CI at all — SURVEY §4 "no CI config"; this is
+# the do-better path.) Exits nonzero on any failure.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo '== native batcher: build + stress test =='
+make -C scalable_agent_tpu/ops/batcher clean all test
+
+echo '== native batcher: ThreadSanitizer =='
+make -C scalable_agent_tpu/ops/batcher tsan-test
+
+echo '== unit + integration tests (CPU, 8 virtual devices) =='
+python -m pytest tests/ -q
+
+echo '== multi-chip sharding dry-run =='
+python __graft_entry__.py
+
+echo '== bench smoke (mechanics only, tiny shapes) =='
+BENCH_SMOKE=1 python bench.py
+
+echo 'CI OK'
