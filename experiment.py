@@ -92,6 +92,13 @@ flags.DEFINE_integer('episode_length', _DEFAULTS.episode_length,
 flags.DEFINE_integer('publish_params_every',
                      _DEFAULTS.publish_params_every,
                      'Learner steps between actor weight snapshots.')
+flags.DEFINE_integer('inference_min_batch', _DEFAULTS.inference_min_batch,
+                     'Dynamic batcher minimum merge size.')
+flags.DEFINE_integer('inference_max_batch', _DEFAULTS.inference_max_batch,
+                     'Dynamic batcher maximum merge size.')
+flags.DEFINE_integer('inference_timeout_ms',
+                     _DEFAULTS.inference_timeout_ms,
+                     'Dynamic batcher flush timeout.')
 flags.DEFINE_string('coordinator_address', '',
                     'jax.distributed coordinator (host:port); empty '
                     'for single-host.')

@@ -177,6 +177,10 @@ def train(config: Config, max_steps: Optional[int] = None,
   server = InferenceServer(agent, state.params, config,
                            seed=config.seed + 1000)
   server.update_params(state.params)
+  # Pre-compile inference buckets up to the fleet size: a bucket's
+  # first appearance otherwise stalls every parked actor for the TPU
+  # compile (the reference's TF graph had dynamic batch dims).
+  server.warmup(spec0.obs_spec, max_size=config.num_actors)
 
   # --- Actor fleet over the trajectory buffer. ---
   capacity = max(config.queue_capacity_batches * config.batch_size,

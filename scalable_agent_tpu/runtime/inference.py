@@ -53,6 +53,7 @@ class InferenceServer:
 
   def __init__(self, agent, params, config, seed=0):
     self._agent = agent
+    self._core_sizes = (agent.hidden_size, agent.hidden_size)  # (c, h)
     self._params = params
     self._params_lock = threading.Lock()
     self._key = jax.random.PRNGKey(seed)
@@ -90,12 +91,64 @@ class InferenceServer:
       outs = self._step(params, sub, *map(
           pad0, (prev_action, reward, done, frame, instr, core_c,
                  core_h)))
-      return tuple(np.asarray(o)[:n] for o in outs)
+      # ONE device_get for all outputs: each separate device→host
+      # readback is a full round trip (85 ms through this sandbox's
+      # remote-TPU tunnel, vs ~µs co-located — either way, batching
+      # the transfer is strictly better).
+      outs = jax.device_get(outs)
+      return tuple(o[:n] for o in outs)
 
     self._batched = dynamic_batching.batch_fn_with_options(
         minimum_batch_size=config.inference_min_batch,
         maximum_batch_size=config.inference_max_batch,
         timeout_ms=config.inference_timeout_ms)(batched)
+
+  def warmup(self, obs_spec, sizes=None, max_size=None):
+    """Pre-compile the jitted step for the padded bucket sizes.
+
+    XLA compiles one program per padded batch shape (powers of two up
+    to max_batch). Without this, each new bucket's first appearance
+    stalls EVERY parked actor thread for the 20–40 s TPU compile; the
+    reference's TF graph had no such stall (dynamic batch dims). Call
+    before starting the fleet.
+
+    Args:
+      obs_spec: {'frame': (H, W, C), 'instr_len': L}.
+      sizes: iterable of *unpadded* sizes to warm. Default: every
+        power-of-two bucket up to `max_size` (capped at
+        maximum_batch_size) — pass max_size=fleet size so only
+        reachable buckets compile.
+      max_size: see `sizes`; None means maximum_batch_size.
+    """
+    h, w, c = obs_spec['frame']
+    l = obs_spec['instr_len']
+    core_c, core_h = (np.zeros((1, s), np.float32)
+                      for s in self._core_sizes)
+    if sizes is None:
+      cap = self._max_batch if max_size is None else min(
+          _next_power_of_two(max_size), self._max_batch)
+      sizes, s = [], 1
+      while s <= cap:
+        sizes.append(s)
+        s *= 2
+    padded_done = set()
+    for size in sizes:
+      padded = min(_next_power_of_two(size), self._max_batch)
+      if padded in padded_done:
+        continue
+      padded_done.add(padded)
+      with self._params_lock:
+        params = self._params
+      self._key, sub = jax.random.split(self._key)
+      outs = self._step(
+          params, sub,
+          np.zeros((padded,), np.int32),
+          np.zeros((padded,), np.float32),
+          np.zeros((padded,), bool),
+          np.zeros((padded, h, w, c), np.uint8),
+          np.zeros((padded, l), np.int32),
+          np.repeat(core_c, padded, 0), np.repeat(core_h, padded, 0))
+      jax.block_until_ready(outs)
 
   def update_params(self, params):
     """Publish a new weight snapshot.
