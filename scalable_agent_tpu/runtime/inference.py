@@ -131,6 +131,10 @@ class InferenceServer:
       while s <= cap:
         sizes.append(s)
         s *= 2
+      if sizes[-1] != cap:
+        # A non-power-of-two max_batch cap is itself a reachable
+        # padded size (batched() pads to min(pow2, max_batch)).
+        sizes.append(cap)
     padded_done = set()
     for size in sizes:
       padded = min(_next_power_of_two(size), self._max_batch)

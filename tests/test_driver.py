@@ -117,3 +117,17 @@ def test_train_with_process_hosted_envs(tmp_path):
   assert int(run.state.update_steps) == 2
   stats = run.fleet.stats()
   assert stats['unrolls'] >= 2
+
+
+def test_evaluate_multitask_parallel(tmp_path):
+  """Batched eval: all 30 dmlab30 levels evaluate concurrently through
+  the shared dynamic batcher (bandit stand-in envs); every level
+  reaches test_num_episodes and the human-normalized scores compute."""
+  cfg = _config(tmp_path, level_name='dmlab30', num_actors=2,
+                unroll_length=4, episode_length=2,
+                test_num_episodes=1)
+  driver.train(cfg, max_steps=1, stall_timeout_secs=120)
+  returns = driver.evaluate(cfg)
+  assert len(returns) == 30
+  for name, rs in returns.items():
+    assert len(rs) == 1, name
