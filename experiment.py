@@ -99,6 +99,9 @@ flags.DEFINE_integer('inference_max_batch', _DEFAULTS.inference_max_batch,
 flags.DEFINE_integer('inference_timeout_ms',
                      _DEFAULTS.inference_timeout_ms,
                      'Dynamic batcher flush timeout.')
+flags.DEFINE_string('profile_dir', _DEFAULTS.profile_dir,
+                    'Capture a jax.profiler trace of a few learner '
+                    'steps into this directory.')
 flags.DEFINE_string('coordinator_address', '',
                     'jax.distributed coordinator (host:port); empty '
                     'for single-host.')

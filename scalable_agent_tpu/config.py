@@ -71,6 +71,11 @@ class Config:
   grad_clip_norm: Optional[float] = None
   checkpoint_secs: int = 600              # reference save_checkpoint_secs
   summary_secs: int = 30                  # reference save_summaries_secs
+  # jax.profiler trace capture (SURVEY §5.1 — absent upstream):
+  # non-empty dir ⇒ capture steps [profile_start, profile_start+steps).
+  profile_dir: str = ''
+  profile_start_step: int = 20            # past warmup/compile
+  profile_num_steps: int = 5
   # Inference batching (reference dynamic_batching defaults, ≈2.9).
   inference_min_batch: int = 1
   inference_max_batch: int = 1024

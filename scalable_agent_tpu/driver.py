@@ -40,7 +40,7 @@ from scalable_agent_tpu.models import ImpalaAgent, init_params
 from scalable_agent_tpu.parallel import mesh as mesh_lib
 from scalable_agent_tpu.parallel import train_parallel
 from scalable_agent_tpu.runtime import ring_buffer
-from scalable_agent_tpu.runtime.actor import Actor, batch_unrolls
+from scalable_agent_tpu.runtime.actor import Actor
 from scalable_agent_tpu.runtime.fleet import ActorFleet
 from scalable_agent_tpu.runtime.inference import InferenceServer
 
@@ -230,6 +230,7 @@ def train(config: Config, max_steps: Optional[int] = None,
 
   fleet.start()
   steps_done = 0
+  profiling = False
   last_summary = time.monotonic()
   last_batch_time = time.monotonic()
   poll_secs = 10.0 if stall_timeout_secs is None else min(
@@ -262,6 +263,18 @@ def train(config: Config, max_steps: Optional[int] = None,
           raise errors[0]
         raise
       last_batch_time = time.monotonic()
+      # jax.profiler capture window (SURVEY §5.1 — the reference has
+      # no tracing at all): [start, start+num) learner steps, placed
+      # after warmup so compiles don't drown the timeline.
+      if config.profile_dir:
+        if steps_done == config.profile_start_step:
+          jax.profiler.start_trace(config.profile_dir)
+          profiling = True
+        elif profiling and steps_done == (config.profile_start_step +
+                                          config.profile_num_steps):
+          jax.profiler.stop_trace()
+          profiling = False
+          log.info('profiler trace written to %s', config.profile_dir)
       state, metrics = train_step(run.state, batch_device)
       run.state = state
       steps_done += 1
@@ -292,6 +305,8 @@ def train(config: Config, max_steps: Optional[int] = None,
       checkpointer.maybe_save(state)
       fleet.check_health(stall_timeout_secs=stall_timeout_secs)
   finally:
+    if profiling:
+      jax.profiler.stop_trace()
     fleet.stop()
     prefetcher.close()
     server.close()
@@ -303,7 +318,9 @@ def train(config: Config, max_steps: Optional[int] = None,
   return run
 
 
-def evaluate(config: Config) -> Dict[str, List[float]]:
+def evaluate(config: Config, stall_timeout_secs: Optional[float] = None,
+             eval_drought_secs: float = 600.0
+             ) -> Dict[str, List[float]]:
   """Play test_num_episodes per level from the latest checkpoint.
 
   Returns {train_level_name: [episode returns]}; logs DMLab-30
@@ -357,24 +374,48 @@ def evaluate(config: Config) -> Dict[str, List[float]]:
   fleet = ActorFleet(make_actor, buffer, len(test_levels))
   level_returns: Dict[str, List[float]] = {
       name: [] for name in train_levels}
+
+  def stats_view(unroll):
+    """Single-unroll [T+1, 1] view of done/info/level only — no frame
+    stacking (extract_episodes never reads observations)."""
+    from scalable_agent_tpu.structs import ActorOutput, StepOutput
+    expand = lambda x: np.asarray(x)[:, None]  # noqa: E731
+    return ActorOutput(
+        level_name=np.asarray([unroll.level_name]),
+        agent_state=None,
+        env_outputs=StepOutput(
+            reward=None,
+            info=jax.tree_util.tree_map(expand,
+                                        unroll.env_outputs.info),
+            done=expand(unroll.env_outputs.done),
+            observation=None),
+        agent_outputs=None)
+
   try:
     fleet.start()
+    last_unroll_time = time.monotonic()
     while any(len(level_returns[name]) < config.test_num_episodes
               for name in train_levels):
       try:
-        unroll = buffer.get(timeout=600)
-      except (ring_buffer.Closed, TimeoutError):
+        unroll = buffer.get(timeout=10)
+      except TimeoutError:
+        # Detect dead AND stalled actors (a wedged env whose thread is
+        # alive would otherwise spin this loop forever while healthy
+        # levels keep producing).
+        fleet.check_health(stall_timeout_secs=stall_timeout_secs)
+        if time.monotonic() - last_unroll_time > eval_drought_secs:
+          errors = fleet.errors()
+          raise errors[0] if errors else TimeoutError(
+              f'eval produced no unrolls for {eval_drought_secs}s')
+        continue
+      except ring_buffer.Closed:
         errors = fleet.errors()
-        raise errors[0] if errors else TimeoutError(
-            'eval produced no unrolls for 600s')
-      batch = batch_unrolls([unroll])
+        raise errors[0] if errors else ring_buffer.Closed()
+      last_unroll_time = time.monotonic()
       for level_id, ep_return, _ in observability.extract_episodes(
-          batch):
+          stats_view(unroll)):
         level_returns[train_levels[level_id]].append(ep_return)
-      # A dead level's actor must be respawned, or its episode count
-      # never fills while the healthy levels keep the buffer busy and
-      # the while-any loop spins forever.
-      fleet.check_health()
+      fleet.check_health(stall_timeout_secs=stall_timeout_secs)
   finally:
     fleet.stop()
     server.close()

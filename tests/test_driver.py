@@ -131,3 +131,15 @@ def test_evaluate_multitask_parallel(tmp_path):
   assert len(returns) == 30
   for name, rs in returns.items():
     assert len(rs) == 1, name
+
+
+def test_profiler_trace_capture(tmp_path):
+  """jax.profiler hooks (SURVEY §5.1 — absent upstream): a capture
+  window writes a trace the standard tooling can open."""
+  prof_dir = str(tmp_path / 'profile')
+  cfg = _config(tmp_path, profile_dir=prof_dir, profile_start_step=1,
+                profile_num_steps=1)
+  driver.train(cfg, max_steps=3, stall_timeout_secs=60)
+  traces = glob.glob(os.path.join(prof_dir, '**', '*.xplane.pb'),
+                     recursive=True)
+  assert traces, f'no trace under {prof_dir}'

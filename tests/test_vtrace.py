@@ -338,3 +338,25 @@ class TestVtracePallas:
       vtrace.from_importance_weights(use_pallas=True,
                                      use_associative_scan=True,
                                      **values)
+
+
+def test_associative_scan_long_sequence():
+  """Long-T readiness (SURVEY §5.7): the associative-scan V-trace is
+  the sequence-scaling door — verify it matches the sequential scan at
+  T=4096 (far beyond the T=100 unrolls of the reference)."""
+  t, b = 4096, 4
+  rng = np.random.RandomState(0)
+  kwargs = dict(
+      log_rhos=jnp.asarray(rng.randn(t, b) * 0.3),
+      discounts=jnp.asarray(0.99 * (rng.rand(t, b) > 0.01)),
+      rewards=jnp.asarray(rng.randn(t, b)),
+      values=jnp.asarray(rng.randn(t, b)),
+      bootstrap_value=jnp.asarray(rng.randn(b)))
+  seq = vtrace.from_importance_weights(**kwargs)
+  par = vtrace.from_importance_weights(use_associative_scan=True,
+                                       **kwargs)
+  np.testing.assert_allclose(np.asarray(seq.vs), np.asarray(par.vs),
+                             rtol=2e-4, atol=2e-4)
+  np.testing.assert_allclose(np.asarray(seq.pg_advantages),
+                             np.asarray(par.pg_advantages),
+                             rtol=2e-4, atol=2e-4)
