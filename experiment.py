@@ -102,6 +102,10 @@ flags.DEFINE_integer('inference_timeout_ms',
 flags.DEFINE_string('profile_dir', _DEFAULTS.profile_dir,
                     'Capture a jax.profiler trace of a few learner '
                     'steps into this directory.')
+flags.DEFINE_integer('profile_start_step', _DEFAULTS.profile_start_step,
+                     'Learner step at which the trace starts.')
+flags.DEFINE_integer('profile_num_steps', _DEFAULTS.profile_num_steps,
+                     'Learner steps the trace covers.')
 flags.DEFINE_string('coordinator_address', '',
                     'jax.distributed coordinator (host:port); empty '
                     'for single-host.')
@@ -125,6 +129,17 @@ def main(argv):
   logging.basicConfig(
       level=logging.INFO,
       format='%(asctime)s %(name)s %(levelname)s %(message)s')
+  # Preemption safety: SIGTERM (k8s eviction, TPU-VM maintenance)
+  # must run driver.train's finally block — final checkpoint save and
+  # clean fleet/batcher shutdown — not kill the process mid-step. The
+  # reference relied on MonitoredTrainingSession's periodic saves and
+  # simply lost the tail; here the tail is saved.
+  import signal
+
+  def _terminate(signum, frame):
+    raise KeyboardInterrupt(f'signal {signum}')
+
+  signal.signal(signal.SIGTERM, _terminate)
   if FLAGS.coordinator_address:
     from scalable_agent_tpu.parallel import distributed
     distributed.initialize(FLAGS.coordinator_address,

@@ -47,6 +47,19 @@ from scalable_agent_tpu.runtime.inference import InferenceServer
 log = logging.getLogger('scalable_agent_tpu')
 
 
+def _stats_only_view(level_name, info, done):
+  """ActorOutput carrying ONLY what observability.extract_episodes
+  reads ([T+1, B] done/info + [B] level ids) — the single place that
+  encodes its input contract for both train() and evaluate()."""
+  from scalable_agent_tpu.structs import ActorOutput, StepOutput
+  return ActorOutput(
+      level_name=level_name,
+      agent_state=None,
+      env_outputs=StepOutput(reward=None, info=info, done=done,
+                             observation=None),
+      agent_outputs=None)
+
+
 def build_agent(config: Config, num_actions: int,
                 num_tasks: int = 1) -> ImpalaAgent:
   dtype = (jnp.bfloat16 if config.compute_dtype == 'bfloat16'
@@ -205,17 +218,10 @@ def train(config: Config, max_steps: Optional[int] = None,
     info / level ids — the batch is host numpy right here) BEFORE the
     device transfer, so the train loop never device_gets frames just to
     read episode stats."""
-    from scalable_agent_tpu.structs import ActorOutput, StepOutput
-    stats_view = ActorOutput(
-        level_name=np.asarray(host_batch.level_name),
-        agent_state=None,
-        env_outputs=StepOutput(
-            reward=None,
-            info=jax.tree_util.tree_map(
-                np.asarray, host_batch.env_outputs.info),
-            done=np.asarray(host_batch.env_outputs.done),
-            observation=None),
-        agent_outputs=None)
+    stats_view = _stats_only_view(
+        np.asarray(host_batch.level_name),
+        jax.tree_util.tree_map(np.asarray, host_batch.env_outputs.info),
+        np.asarray(host_batch.env_outputs.done))
     return stats_view, place_fn(host_batch)
 
   prefetcher = ring_buffer.BatchPrefetcher(
@@ -307,6 +313,13 @@ def train(config: Config, max_steps: Optional[int] = None,
   finally:
     if profiling:
       jax.profiler.stop_trace()
+    elif (config.profile_dir and
+          steps_done <= config.profile_start_step):
+      log.warning(
+          'profile_dir set but the run ended at step %d, before '
+          'profile_start_step=%d — no trace was captured (lower '
+          '--profile_start_step)', steps_done,
+          config.profile_start_step)
     fleet.stop()
     prefetcher.close()
     server.close()
@@ -318,7 +331,8 @@ def train(config: Config, max_steps: Optional[int] = None,
   return run
 
 
-def evaluate(config: Config, stall_timeout_secs: Optional[float] = None,
+def evaluate(config: Config,
+             stall_timeout_secs: Optional[float] = 300.0,
              eval_drought_secs: float = 600.0
              ) -> Dict[str, List[float]]:
   """Play test_num_episodes per level from the latest checkpoint.
@@ -376,35 +390,31 @@ def evaluate(config: Config, stall_timeout_secs: Optional[float] = None,
       name: [] for name in train_levels}
 
   def stats_view(unroll):
-    """Single-unroll [T+1, 1] view of done/info/level only — no frame
-    stacking (extract_episodes never reads observations)."""
-    from scalable_agent_tpu.structs import ActorOutput, StepOutput
+    """Single-unroll [T+1, 1] view — no frame stacking."""
     expand = lambda x: np.asarray(x)[:, None]  # noqa: E731
-    return ActorOutput(
-        level_name=np.asarray([unroll.level_name]),
-        agent_state=None,
-        env_outputs=StepOutput(
-            reward=None,
-            info=jax.tree_util.tree_map(expand,
-                                        unroll.env_outputs.info),
-            done=expand(unroll.env_outputs.done),
-            observation=None),
-        agent_outputs=None)
+    return _stats_only_view(
+        np.asarray([unroll.level_name]),
+        jax.tree_util.tree_map(expand, unroll.env_outputs.info),
+        expand(unroll.env_outputs.done))
 
   try:
     fleet.start()
     last_unroll_time = time.monotonic()
+    errors: List[BaseException] = []
     while any(len(level_returns[name]) < config.test_num_episodes
               for name in train_levels):
       try:
         unroll = buffer.get(timeout=10)
       except TimeoutError:
+        # Read errors BEFORE check_health — a respawn clears the
+        # slot's error, and a crash-looping actor's root cause must
+        # survive to the drought raise below.
+        errors = fleet.errors() or errors
         # Detect dead AND stalled actors (a wedged env whose thread is
         # alive would otherwise spin this loop forever while healthy
         # levels keep producing).
         fleet.check_health(stall_timeout_secs=stall_timeout_secs)
         if time.monotonic() - last_unroll_time > eval_drought_secs:
-          errors = fleet.errors()
           raise errors[0] if errors else TimeoutError(
               f'eval produced no unrolls for {eval_drought_secs}s')
         continue
