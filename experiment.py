@@ -137,6 +137,9 @@ def main(argv):
   import signal
 
   def _terminate(signum, frame):
+    # Disarm first: a second SIGTERM during the cleanup (final save)
+    # must not abort the very save this handler exists to protect.
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
     raise KeyboardInterrupt(f'signal {signum}')
 
   signal.signal(signal.SIGTERM, _terminate)

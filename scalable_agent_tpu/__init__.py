@@ -18,5 +18,22 @@ deepmind/scalable_agent, arXiv:1802.01561) for TPU:
 """
 
 from scalable_agent_tpu import vtrace  # noqa: F401
+from scalable_agent_tpu.config import Config  # noqa: F401
+from scalable_agent_tpu.structs import (  # noqa: F401
+    ActorOutput, AgentOutput, StepOutput, StepOutputInfo)
 
 __version__ = '0.1.0'
+
+
+def __getattr__(name):
+  """Lazy top-level API (heavy deps — flax/orbax — load on demand):
+  `scalable_agent_tpu.ImpalaAgent`, `.driver`, `.learner`, etc."""
+  import importlib
+  if name in ('driver', 'learner', 'losses', 'popart', 'unreal',
+              'checkpoint', 'observability', 'models', 'envs',
+              'runtime', 'parallel'):
+    return importlib.import_module(f'scalable_agent_tpu.{name}')
+  if name == 'ImpalaAgent':
+    from scalable_agent_tpu.models import ImpalaAgent
+    return ImpalaAgent
+  raise AttributeError(name)

@@ -22,7 +22,10 @@ maps as:
 in multi-task mode (reference test() ≈L595–630).
 """
 
+import dataclasses
+import json
 import logging
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -228,6 +231,11 @@ def train(config: Config, max_steps: Optional[int] = None,
       buffer, local_batch_size, place_fn=stage)
 
   writer = observability.SummaryWriter(config.logdir)
+  # Reproducibility: the exact config of every run lives next to its
+  # checkpoints/summaries (the reference leaves flags only in shell
+  # history).
+  with open(os.path.join(config.logdir, 'config.json'), 'w') as f:
+    json.dump(dataclasses.asdict(config), f, indent=2, sort_keys=True)
   stats = observability.EpisodeStats(
       levels, multi_task=(config.level_name == 'dmlab30'), writer=writer)
   fps_meter = observability.FpsMeter()
@@ -237,6 +245,7 @@ def train(config: Config, max_steps: Optional[int] = None,
   fleet.start()
   steps_done = 0
   profiling = False
+  last_inference_snap = {'calls': 0, 'requests': 0}
   last_summary = time.monotonic()
   last_batch_time = time.monotonic()
   poll_secs = 10.0 if stall_timeout_secs is None else min(
@@ -308,6 +317,16 @@ def train(config: Config, max_steps: Optional[int] = None,
         writer.scalar('actors_alive', fleet_stats['alive'], step_now)
         writer.scalar('actor_respawns', fleet_stats['respawns'],
                       step_now)
+        # Merge telemetry over THIS summary interval (a cumulative
+        # mean would hide regressions late in a long run): ≈1 means
+        # the batcher is not merging — the single-machine throughput
+        # lever (paper Table 1).
+        snap = server.stats()
+        d_calls = snap['calls'] - last_inference_snap['calls']
+        d_reqs = snap['requests'] - last_inference_snap['requests']
+        last_inference_snap = snap
+        writer.scalar('inference_mean_batch',
+                      (d_reqs / d_calls) if d_calls else 0.0, step_now)
       checkpointer.maybe_save(state)
       fleet.check_health(stall_timeout_secs=stall_timeout_secs)
   finally:

@@ -165,6 +165,14 @@ class TestInferenceServer:
               np.asarray(u.agent_outputs.policy_logits)).all()
           assert (np.asarray(u.agent_outputs.action) >= 0).all()
           assert (np.asarray(u.agent_outputs.action) < A).all()
+      # Merge telemetry: all requests accounted for, and with 4
+      # concurrent actors against one computation thread some calls
+      # MUST have merged (calls strictly < requests) — the
+      # single-machine throughput lever the stats exist to expose.
+      stats = server.stats()
+      assert stats['requests'] >= 4 * 2 * 8
+      assert stats['calls'] < stats['requests']
+      assert stats['mean_batch'] > 1.0
     finally:
       server.close()
 
