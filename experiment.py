@@ -70,8 +70,9 @@ flags.DEFINE_float('epsilon', _DEFAULTS.epsilon, 'RMSProp epsilon.')
 
 # --- TPU-build additions (not in the reference). ---
 flags.DEFINE_enum('env_backend', _DEFAULTS.env_backend,
-                  ['dmlab', 'atari', 'fake', 'bandit'],
-                  'Environment backend.')
+                  ['dmlab', 'atari', 'fake', 'bandit', 'cue_memory'],
+                  'Environment backend (fake/bandit/cue_memory are '
+                  'simulator-free smoke tasks).')
 flags.DEFINE_enum('torso', _DEFAULTS.torso, ['deep', 'shallow'],
                   'Agent torso: deep ResNet (reference) or the '
                   "paper's shallow CNN.")

@@ -56,11 +56,13 @@ def make_env_spec(config: Config, level_name: str, seed: int,
                   is_test: bool = False) -> EnvSpec:
   """One environment spec for (backend, level, seed)."""
   backend = config.env_backend
-  if backend in ('fake', 'bandit'):
+  if backend in ('fake', 'bandit', 'cue_memory'):
     from scalable_agent_tpu.envs import fake
-    env_class = (fake.ContextualBanditEnv if backend == 'bandit'
-                 else fake.FakeEnv)
-    num_actions = config.num_actions or (3 if backend == 'bandit' else 5)
+    env_class = {'bandit': fake.ContextualBanditEnv,
+                 'cue_memory': fake.CueMemoryEnv,
+                 'fake': fake.FakeEnv}[backend]
+    num_actions = config.num_actions or (
+        5 if backend == 'fake' else 3)
     kwargs = dict(height=config.height, width=config.width,
                   num_actions=num_actions,
                   episode_length=config.episode_length,

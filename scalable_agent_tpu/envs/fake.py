@@ -59,6 +59,62 @@ class FakeEnv(base.Environment):
     return None
 
 
+class CueMemoryEnv(base.Environment):
+  """Two-step memory task: the cue is visible ONLY on the first frame.
+
+  initial()/post-reset observation shows the cue (dominant color
+  channel 0..2); the next frame is blank; the action taken on the
+  BLANK frame earns reward 1 iff it matches the cue. A feedforward
+  policy cannot beat 1/num_actions here — solving it requires the
+  recurrent core to carry the cue across the step (the done-reset LSTM
+  path end-to-end).
+  """
+
+  def __init__(self, height=16, width=16, num_actions=3,
+               episode_length=2, seed=0, level_name='cue_memory',
+               num_action_repeats=1):
+    del episode_length  # fixed two-step episodes
+    if num_actions != 3:
+      raise ValueError('CueMemoryEnv is a 3-action task (one action '
+                       'per RGB cue channel); got num_actions='
+                       f'{num_actions}')
+    self._h, self._w = height, width
+    self._num_actions = num_actions
+    self._rng = np.random.RandomState(seed)
+    self._instr = hash_instruction(level_name)
+    self._step_in_episode = 0
+    self._cue = int(self._rng.randint(3))
+
+  def _observation(self):
+    frame = np.zeros((self._h, self._w, 3), np.uint8)
+    if self._step_in_episode == 0:  # cue only on the first frame
+      frame[:, :, self._cue] = 255
+    return (frame, self._instr.copy())
+
+  def initial(self):
+    return self._observation()
+
+  def step(self, action):
+    if self._step_in_episode == 0:
+      # First action: no reward; next frame is blank.
+      self._step_in_episode = 1
+      return np.float32(0.0), np.bool_(False), self._observation()
+    reward = np.float32(1.0 if int(action) == self._cue else 0.0)
+    self._cue = int(self._rng.randint(3))
+    self._step_in_episode = 0
+    return reward, np.bool_(True), self._observation()
+
+  @staticmethod
+  def _tensor_specs(method_name, unused_kwargs, constructor_kwargs):
+    h = constructor_kwargs.get('height', 16)
+    w = constructor_kwargs.get('width', 16)
+    if method_name == 'initial':
+      return base.observation_specs(h, w, MAX_INSTRUCTION_LEN)
+    if method_name == 'step':
+      return base.step_output_specs(h, w, MAX_INSTRUCTION_LEN)
+    return None
+
+
 class ContextualBanditEnv(base.Environment):
   """One-step contextual bandit: act = argmax-channel ⇒ reward 1.
 
