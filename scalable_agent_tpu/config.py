@@ -49,9 +49,11 @@ class Config:
   epsilon: float = 0.1
 
   # TPU-build additions (not in the reference).
-  env_backend: str = 'dmlab'              # dmlab | atari | fake | bandit
+  env_backend: str = 'dmlab'              # dmlab | atari | fake |
+                                          # bandit | cue_memory
   num_actions: Optional[int] = None       # backend default when None
-  episode_length: int = 100               # fake/bandit backends only
+  episode_length: int = 100               # fake/bandit only (cue_memory
+                                          # is fixed two-step episodes)
   use_py_process: bool = True             # host each env in its own process
   publish_params_every: int = 1           # actor weight-snapshot cadence
   model_parallelism: int = 1              # TP width of the mesh

@@ -64,10 +64,15 @@ class CueMemoryEnv(base.Environment):
 
   initial()/post-reset observation shows the cue (dominant color
   channel 0..2); the next frame is blank; the action taken on the
-  BLANK frame earns reward 1 iff it matches the cue. A feedforward
-  policy cannot beat 1/num_actions here — solving it requires the
-  recurrent core to carry the cue across the step (the done-reset LSTM
-  path end-to-end).
+  BLANK frame earns reward 1 iff it matches the cue.
+
+  Relay-proof: because the agent's core input includes
+  one_hot(prev_action), a memoryless policy could otherwise smuggle
+  the cue through its own first action. So the FIRST action is paid
+  2.0 iff it is the fixed action 0 — an information-free optimum.
+  Best achievable returns per episode: memory policy 3.0 (2 + 1);
+  relay policy 1.0 (forfeits the first reward); memoryless honest
+  policy 2 + 1/3. Only a working recurrent carry clears ~2.6.
   """
 
   def __init__(self, height=16, width=16, num_actions=3,
@@ -96,9 +101,11 @@ class CueMemoryEnv(base.Environment):
 
   def step(self, action):
     if self._step_in_episode == 0:
-      # First action: no reward; next frame is blank.
+      # First action: paid 2.0 for the FIXED action 0 (carries no cue
+      # information; relaying the cue here forfeits this reward).
       self._step_in_episode = 1
-      return np.float32(0.0), np.bool_(False), self._observation()
+      reward = np.float32(2.0 if int(action) == 0 else 0.0)
+      return reward, np.bool_(False), self._observation()
     reward = np.float32(1.0 if int(action) == self._cue else 0.0)
     self._cue = int(self._rng.randint(3))
     self._step_in_episode = 0

@@ -156,9 +156,11 @@ def test_bandit_learning_improves_return():
 
 def test_cue_memory_learning_requires_recurrence():
   """The LSTM core end-to-end: the cue is visible only on the FIRST
-  frame of each 2-step episode and the rewarded action happens on the
-  blank second frame — a feedforward policy cannot beat 1/3. Hit-rate
-  must approach 1 (measured: ~1.0 by update ~100 on CPU)."""
+  frame of each 2-step episode; the rewarded action happens on the
+  blank second frame, and the first action is paid 2.0 only for the
+  fixed action 0 (so smuggling the cue through prev_action forfeits
+  more than it gains — see CueMemoryEnv). Episode return must clear
+  2.6: memory policy 3.0, best memoryless 2.33, relay 1.0."""
   h, w = 24, 32
   obs = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
   agent = ImpalaAgent(num_actions=3, torso='shallow',
@@ -177,8 +179,8 @@ def test_cue_memory_learning_requires_recurrence():
   state = learner_lib.make_train_state(params, cfg)
   train_step = learner_lib.make_train_step(agent, cfg)
 
-  late_hits = []
-  num_updates = 130
+  late_returns = []
+  num_updates = 150
   for i in range(num_updates):
     batch = batch_unrolls([a.unroll() for a in actors])
     state, _ = train_step(state, batch)
@@ -186,8 +188,9 @@ def test_cue_memory_learning_requires_recurrence():
                                                   state.params)
     if i >= num_updates - 20:
       done = np.asarray(batch.env_outputs.done)[1:]
-      rewards = np.asarray(batch.env_outputs.reward)[1:]
+      ep_returns = np.asarray(
+          batch.env_outputs.info.episode_return)[1:]
       if done.any():
-        late_hits.append(float(rewards[done].mean()))
+        late_returns.append(float(ep_returns[done].mean()))
 
-  assert np.mean(late_hits) > 0.7, np.mean(late_hits)
+  assert np.mean(late_returns) > 2.6, np.mean(late_returns)

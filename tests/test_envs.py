@@ -165,3 +165,22 @@ def test_atari_specs():
 def test_atari_import_guard_message():
   with pytest.raises(ImportError, match='Atari backend'):
     atari._make_ale('definitely_not_a_game_xyz', 0, True)
+
+
+def test_factory_cue_memory_backend():
+  cfg = Config(env_backend='cue_memory', height=24, width=32)
+  spec = factory.make_env_spec(cfg, 'cue', seed=1)
+  assert spec.num_actions == 3
+  env = spec.build()
+  frame, instr = env.initial()
+  assert frame.shape == (24, 32, 3)
+  # Cue visible on first frame, blank after the first step.
+  assert frame.max() == 255
+  _, done, (frame2, _) = env.step(0)
+  assert not done and frame2.max() == 0
+
+
+def test_cue_memory_rejects_wrong_action_count():
+  from scalable_agent_tpu.envs.fake import CueMemoryEnv
+  with pytest.raises(ValueError, match='3-action'):
+    CueMemoryEnv(num_actions=4)

@@ -118,3 +118,34 @@ class TestSchedule:
     # Past the end: clamps at 0, never negative.
     lr_end = learner_lib.make_schedule(cfg)(jnp.asarray(1000, jnp.int32))
     np.testing.assert_allclose(float(lr_end), 0.0, atol=1e-9)
+
+
+class TestVtraceFormsInLearner:
+  """The config-selected V-trace forms must agree inside the full
+  jitted train step, not just in isolation (the learner is where the
+  flags are actually consumed)."""
+
+  @pytest.mark.parametrize('variant', [
+      dict(use_associative_scan=True),
+      dict(use_pallas_vtrace=True),
+  ])
+  def test_matches_default_scan(self, variant):
+    from scalable_agent_tpu.models import ImpalaAgent, init_params
+    from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+    from scalable_agent_tpu.testing import make_example_batch
+    a, h, w = 4, 24, 32
+    obs = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+    agent = ImpalaAgent(num_actions=a, torso='shallow')
+    batch = make_example_batch(5, 2, h, w, a, MAX_INSTRUCTION_LEN,
+                               done_prob=0.1)
+
+    losses = []
+    for overrides in ({}, variant):
+      cfg = Config(batch_size=2, unroll_length=4, num_action_repeats=1,
+                   total_environment_frames=10**6, **overrides)
+      params = init_params(agent, jax.random.PRNGKey(0), obs)
+      state = learner_lib.make_train_state(params, cfg)
+      step = learner_lib.make_train_step(agent, cfg)
+      state, metrics = step(state, batch)
+      losses.append(float(metrics['total_loss']))
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-4)
