@@ -38,6 +38,7 @@ class Config:
 
   # Environment.
   dataset_path: str = ''
+  level_cache_dir: str = '/tmp/level_cache'  # DMLab compiled-map cache
   level_name: str = 'explore_goal_locations_small'
   width: int = 96
   height: int = 72

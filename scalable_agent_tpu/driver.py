@@ -449,12 +449,17 @@ def evaluate(config: Config,
     fleet.stop()
     server.close()
 
+  writer = observability.SummaryWriter(config.logdir,
+                                       filename='eval_summaries.jsonl')
+  step = int(jax.device_get(restored.update_steps))
   for train_name, test_name in zip(train_levels, test_levels):
     returns = level_returns[train_name][:config.test_num_episodes]
     level_returns[train_name] = returns
+    mean_return = float(np.mean(returns)) if returns else float('nan')
     log.info('level %s: mean return %.2f over %d episodes', test_name,
-             float(np.mean(returns)) if returns else float('nan'),
-             len(returns))
+             mean_return, len(returns))
+    writer.scalar(f'{test_name}/test_episode_return', mean_return,
+                  step)
 
   if config.level_name == 'dmlab30':
     no_cap = dmlab30.compute_human_normalized_score(
@@ -463,4 +468,7 @@ def evaluate(config: Config,
         level_returns, per_level_cap=100)
     log.info('dmlab30 human-normalized: no_cap=%.1f cap_100=%.1f',
              no_cap, cap_100)
+    writer.scalar('dmlab30/test_no_cap', no_cap, step)
+    writer.scalar('dmlab30/test_cap_100', cap_100, step)
+  writer.close()
   return level_returns

@@ -91,7 +91,8 @@ def constructor_kwargs(level_name: str, seed: int, is_test: bool,
     lab_config['allowHoldOutLevels'] = 'true'
     lab_config['mixerSeed'] = str(TEST_MIXER_SEED)
   return dict(level=level_name, config=lab_config, seed=seed,
-              num_action_repeats=config.num_action_repeats)
+              num_action_repeats=config.num_action_repeats,
+              level_cache_dir=config.level_cache_dir)
 
 
 class DmLabEnv(base.Environment):
@@ -101,6 +102,7 @@ class DmLabEnv(base.Environment):
                num_action_repeats: int = 4,
                action_set=DEFAULT_ACTION_SET,
                level_cache: Optional[LocalLevelCache] = None,
+               level_cache_dir: Optional[str] = None,
                runfiles_path: Optional[str] = None):
     if deepmind_lab is None:
       raise ImportError(
@@ -114,7 +116,8 @@ class DmLabEnv(base.Environment):
     self._random_state = np.random.RandomState(seed=seed)
     self._level_name = level
     if level_cache is None:
-      level_cache = LocalLevelCache()
+      level_cache = (LocalLevelCache(level_cache_dir)
+                     if level_cache_dir else LocalLevelCache())
     self._env = deepmind_lab.Lab(
         level=level,
         observations=['RGB_INTERLEAVED', 'INSTR'],

@@ -70,9 +70,10 @@ class CueMemoryEnv(base.Environment):
   one_hot(prev_action), a memoryless policy could otherwise smuggle
   the cue through its own first action. So the FIRST action is paid
   2.0 iff it is the fixed action 0 — an information-free optimum.
-  Best achievable returns per episode: memory policy 3.0 (2 + 1);
-  relay policy 1.0 (forfeits the first reward); memoryless honest
-  policy 2 + 1/3. Only a working recurrent carry clears ~2.6.
+  Expected returns per episode: memory policy 3.0 (2 + 1); relay
+  policy 5/3 (the 2.0 pays only when the cue happens to be 0, + 1);
+  best memoryless policy 2 + 1/3. Only a working recurrent carry
+  clears ~2.6.
   """
 
   def __init__(self, height=16, width=16, num_actions=3,

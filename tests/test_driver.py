@@ -72,6 +72,10 @@ def test_evaluate_from_checkpoint(tmp_path):
   assert len(returns[cfg.level_name]) == cfg.test_num_episodes
   for r in returns[cfg.level_name]:
     assert 0.0 <= r <= cfg.episode_length
+  # Eval scores land in their own summary stream.
+  with open(os.path.join(str(tmp_path), 'eval_summaries.jsonl')) as f:
+    tags = {json.loads(line)['tag'] for line in f}
+  assert f'{cfg.level_name}/test_episode_return' in tags
 
 
 def test_sharded_train_path(tmp_path):

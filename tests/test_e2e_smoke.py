@@ -160,7 +160,7 @@ def test_cue_memory_learning_requires_recurrence():
   blank second frame, and the first action is paid 2.0 only for the
   fixed action 0 (so smuggling the cue through prev_action forfeits
   more than it gains — see CueMemoryEnv). Episode return must clear
-  2.6: memory policy 3.0, best memoryless 2.33, relay 1.0."""
+  2.6: memory policy 3.0, best memoryless 2.33, relay 5/3."""
   h, w = 24, 32
   obs = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
   agent = ImpalaAgent(num_actions=3, torso='shallow',
