@@ -68,16 +68,28 @@ class Checkpointer:
       self._last_save_time = time.monotonic()
     return saved
 
-  def maybe_save(self, state: TrainState,
-                 step: Optional[int] = None) -> bool:
-    """Save iff the save interval elapsed (call freely from the learner
-    loop). The first call after construction starts the clock rather
-    than saving, matching the reference's every-N-seconds hook."""
+  def should_save(self) -> bool:
+    """Whether the save interval has elapsed (host-local wall clock).
+
+    Multi-host callers MUST NOT act on this independently: clocks
+    differ per host, Orbax saves are collective, and disagreeing hosts
+    deadlock in the barrier sync. Broadcast process 0's decision
+    (driver.train does) and pass it to `maybe_save(decision=...)`.
+    The first call after construction starts the clock."""
     now = time.monotonic()
     if self._last_save_time is None:
       self._last_save_time = now
       return False
-    if now - self._last_save_time < self._save_interval_secs:
+    return now - self._last_save_time >= self._save_interval_secs
+
+  def maybe_save(self, state: TrainState, step: Optional[int] = None,
+                 decision: Optional[bool] = None) -> bool:
+    """Save iff the save interval elapsed (call freely from the learner
+    loop), matching the reference's every-N-seconds hook. `decision`
+    overrides the local clock (multi-host: broadcast from process 0)."""
+    if decision is None:
+      decision = self.should_save()
+    if not decision:
       return False
     return self.save(state, step)
 

@@ -38,7 +38,9 @@ class Config:
 
   # Environment.
   dataset_path: str = ''
-  level_cache_dir: str = '/tmp/level_cache'  # DMLab compiled-map cache
+  level_cache_dir: str = ''               # DMLab compiled-map cache
+                                          # override ('' = adapter
+                                          # default)
   level_name: str = 'explore_goal_locations_small'
   width: int = 96
   height: int = 72

@@ -49,6 +49,9 @@ from scalable_agent_tpu.runtime.inference import InferenceServer
 
 log = logging.getLogger('scalable_agent_tpu')
 
+# Learner steps between cross-host checkpoint-cadence broadcasts.
+_CKPT_CHECK_EVERY = 20
+
 
 def _stats_only_view(level_name, info, done):
   """ActorOutput carrying ONLY what observability.extract_episodes
@@ -230,12 +233,19 @@ def train(config: Config, max_steps: Optional[int] = None,
   prefetcher = ring_buffer.BatchPrefetcher(
       buffer, local_batch_size, place_fn=stage)
 
-  writer = observability.SummaryWriter(config.logdir)
+  # Multi-host: every host logs its OWN fleet's stream; process 0 keeps
+  # the canonical filename (shared logdirs must not interleave writers).
+  process_index = jax.process_index()
+  summary_name = ('summaries.jsonl' if process_index == 0
+                  else f'summaries_p{process_index}.jsonl')
+  writer = observability.SummaryWriter(config.logdir,
+                                       filename=summary_name)
   # Reproducibility: the exact config of every run lives next to its
   # checkpoints/summaries (the reference leaves flags only in shell
   # history).
-  with open(os.path.join(config.logdir, 'config.json'), 'w') as f:
-    json.dump(dataclasses.asdict(config), f, indent=2, sort_keys=True)
+  if process_index == 0:
+    with open(os.path.join(config.logdir, 'config.json'), 'w') as f:
+      json.dump(dataclasses.asdict(config), f, indent=2, sort_keys=True)
   stats = observability.EpisodeStats(
       levels, multi_task=(config.level_name == 'dmlab30'), writer=writer)
   fps_meter = observability.FpsMeter()
@@ -327,7 +337,20 @@ def train(config: Config, max_steps: Optional[int] = None,
         last_inference_snap = snap
         writer.scalar('inference_mean_batch',
                       (d_reqs / d_calls) if d_calls else 0.0, step_now)
-      checkpointer.maybe_save(state)
+      # Checkpoint cadence: Orbax saves are collective across hosts;
+      # clocks differ, so all hosts act on PROCESS 0's decision (a
+      # host-local clock here would desync the barrier and deadlock).
+      # The broadcast is a cross-host sync, so it runs only every
+      # CKPT_CHECK_EVERY steps — the cadence check itself must not tax
+      # the hot loop (at worst the save lands that many steps late,
+      # noise against checkpoint_secs=600).
+      if num_processes == 1:
+        checkpointer.maybe_save(state)
+      elif steps_done % _CKPT_CHECK_EVERY == 0:
+        from jax.experimental import multihost_utils
+        decision = bool(multihost_utils.broadcast_one_to_all(
+            jnp.asarray(checkpointer.should_save())))
+        checkpointer.maybe_save(state, decision=decision)
       fleet.check_health(stall_timeout_secs=stall_timeout_secs)
   finally:
     if profiling:
@@ -449,8 +472,10 @@ def evaluate(config: Config,
     fleet.stop()
     server.close()
 
+  eval_name = ('eval_summaries.jsonl' if jax.process_index() == 0
+               else f'eval_summaries_p{jax.process_index()}.jsonl')
   writer = observability.SummaryWriter(config.logdir,
-                                       filename='eval_summaries.jsonl')
+                                       filename=eval_name)
   step = int(jax.device_get(restored.update_steps))
   for train_name, test_name in zip(train_levels, test_levels):
     returns = level_returns[train_name][:config.test_num_episodes]

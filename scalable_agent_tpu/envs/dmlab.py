@@ -92,7 +92,7 @@ def constructor_kwargs(level_name: str, seed: int, is_test: bool,
     lab_config['mixerSeed'] = str(TEST_MIXER_SEED)
   return dict(level=level_name, config=lab_config, seed=seed,
               num_action_repeats=config.num_action_repeats,
-              level_cache_dir=config.level_cache_dir)
+              level_cache_dir=config.level_cache_dir or None)
 
 
 class DmLabEnv(base.Environment):

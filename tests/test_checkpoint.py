@@ -120,3 +120,22 @@ def test_save_same_step_twice_reports_skip(setup, tmp_path):
   ckpt.wait_until_finished()
   assert not ckpt.save(state, step=5)  # existing step skipped → False
   ckpt.close()
+
+
+def test_should_save_and_decision_override(setup, tmp_path):
+  """Multi-host contract: a host whose local clock hasn't elapsed must
+  still save when handed decision=True (process 0's broadcast), and
+  must skip when handed False even if its own clock elapsed."""
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(params, cfg)
+  ckpt = Checkpointer(str(tmp_path / 'decision'),
+                      save_interval_secs=10**6)
+  try:
+    assert not ckpt.should_save()  # first call starts the clock
+    assert not ckpt.maybe_save(state)          # local clock: no
+    assert ckpt.maybe_save(state, decision=True)   # broadcast: yes
+    state2 = state._replace(update_steps=state.update_steps + 1)
+    assert not ckpt.maybe_save(state2, decision=False)
+    assert ckpt.latest_step() == 0
+  finally:
+    ckpt.close()

@@ -48,7 +48,11 @@ def test_dmlab_constructor_kwargs_test_mode():
   assert kwargs['config']['allowHoldOutLevels'] == 'true'
   assert int(kwargs['config']['mixerSeed']) == 0x600D5EED
   assert kwargs['config']['datasetPath'] == '/data/brady'
-  assert kwargs['level_cache_dir'] == '/tmp/level_cache'
+  assert kwargs['level_cache_dir'] is None  # '' config → adapter default
+  cached = Config(level_cache_dir='/data/cache')
+  assert dmlab.constructor_kwargs(
+      'rooms_watermaze', seed=7, is_test=False,
+      config=cached)['level_cache_dir'] == '/data/cache'
   train_kwargs = dmlab.constructor_kwargs('rooms_watermaze', seed=7,
                                           is_test=False, config=cfg)
   assert 'mixerSeed' not in train_kwargs['config']
