@@ -106,8 +106,16 @@ class Checkpointer:
     step = self._manager.latest_step()
     if step is None:
       return None
-    abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
-                                      target)
+
+    def to_abstract(x):
+      # Pin the TARGET's sharding so restored leaves land exactly on
+      # its placements (mesh-sharded or single-device alike).
+      if isinstance(x, jax.Array):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                    sharding=x.sharding)
+      return ocp.utils.to_shape_dtype_struct(x)
+
+    abstract = jax.tree_util.tree_map(to_abstract, target)
     return self._manager.restore(
         step, args=ocp.args.StandardRestore(abstract))
 

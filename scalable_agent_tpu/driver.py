@@ -26,6 +26,7 @@ import dataclasses
 import json
 import logging
 import os
+import sys
 import time
 from typing import Dict, List, Optional
 
@@ -33,6 +34,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import multihost_utils
 
 from scalable_agent_tpu import checkpoint as checkpoint_lib
 from scalable_agent_tpu import learner as learner_lib
@@ -347,7 +349,6 @@ def train(config: Config, max_steps: Optional[int] = None,
       if num_processes == 1:
         checkpointer.maybe_save(state)
       elif steps_done % _CKPT_CHECK_EVERY == 0:
-        from jax.experimental import multihost_utils
         decision = bool(multihost_utils.broadcast_one_to_all(
             jnp.asarray(checkpointer.should_save())))
         checkpointer.maybe_save(state, decision=decision)
@@ -366,7 +367,19 @@ def train(config: Config, max_steps: Optional[int] = None,
     prefetcher.close()
     server.close()
     try:
-      checkpointer.save(run.state, force=True)
+      # The final save is a COLLECTIVE. On a clean exit every host
+      # reaches it in lockstep (termination is a deterministic
+      # function of the shared step count). When unwinding from a
+      # host-local exception, other hosts are still inside the
+      # collective train step — entering the Orbax barrier here would
+      # deadlock the job instead of surfacing the error; periodic
+      # checkpoints cover the tail.
+      exiting_clean = sys.exc_info()[0] is None
+      if num_processes == 1 or exiting_clean:
+        checkpointer.save(run.state, force=True)
+      else:
+        log.warning('skipping final collective checkpoint on '
+                    'exception unwind (multi-host)')
     finally:
       checkpointer.close()
       writer.close()

@@ -23,10 +23,24 @@ def make_sharded_train_state(params, config: Config, mesh: Mesh,
                              enable_tp: bool = False,
                              num_popart_tasks: int = 0):
   """Place params on the mesh (replicated, or TP-sharded kernels) and
-  build the TrainState there; opt state inherits param placements."""
+  build the TrainState there. Optimizer moment trees inherit the param
+  placements (eager zeros_like follows its input's sharding); scalar
+  leaves (step/opt counters, PopArt stats) are explicitly replicated —
+  a single-device committed scalar next to mesh-committed params is a
+  mixed-placement error under jit (bites after checkpoint restore)."""
   p_shard = mesh_lib.param_shardings(params, mesh, enable_tp)
   params = jax.tree_util.tree_map(jax.device_put, params, p_shard)
-  return learner_lib.make_train_state(params, config, num_popart_tasks)
+  state = learner_lib.make_train_state(params, config, num_popart_tasks)
+  replicated = NamedSharding(mesh, P())
+  mesh_devices = set(mesh.devices.flat)
+
+  def ensure_on_mesh(x):
+    if (isinstance(x, jax.Array) and
+        x.sharding.device_set == mesh_devices):
+      return x
+    return jax.device_put(x, replicated)
+
+  return jax.tree_util.tree_map(ensure_on_mesh, state)
 
 
 def make_sharded_train_step(agent, config: Config, mesh: Mesh,
