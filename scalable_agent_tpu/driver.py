@@ -339,6 +339,11 @@ def train(config: Config, max_steps: Optional[int] = None,
         last_inference_snap = snap
         writer.scalar('inference_mean_batch',
                       (d_reqs / d_calls) if d_calls else 0.0, step_now)
+        # Staleness: how many snapshots actors have been served (the
+        # reference's "actions within one unroll may span weight
+        # versions" caveat, made observable).
+        writer.scalar('params_version', snap['params_version'],
+                      step_now)
       # Checkpoint cadence: Orbax saves are collective across hosts;
       # clocks differ, so all hosts act on PROCESS 0's decision (a
       # host-local clock here would desync the barrier and deadlock).
