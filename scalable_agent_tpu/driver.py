@@ -195,8 +195,13 @@ def train(config: Config, max_steps: Optional[int] = None,
   _initial_steps = int(jax.device_get(state.update_steps))
 
   # --- Inference server (weights served host-side to actor threads). ---
+  # Per-process seed offset: params/init use config.seed IDENTICALLY on
+  # every host (multi-host device_put asserts equality), while env and
+  # action-sampling streams must NOT repeat across hosts.
+  process_index = jax.process_index()
+  process_seed_base = process_index * max(config.num_actors, 1000)
   server = InferenceServer(agent, state.params, config,
-                           seed=config.seed + 1000)
+                           seed=config.seed + 1000 + process_seed_base)
   server.update_params(state.params)
   # Pre-compile inference buckets up to the fleet size: a bucket's
   # first appearance otherwise stalls every parked actor for the TPU
@@ -210,7 +215,8 @@ def train(config: Config, max_steps: Optional[int] = None,
 
   def make_actor(i):
     level = levels[i % len(levels)]
-    spec = factory.make_env_spec(config, level, seed=i + 1)
+    spec = factory.make_env_spec(config, level,
+                                 seed=process_seed_base + i + 1)
     env, process = factory.build_environment(
         spec, use_py_process=config.use_py_process)
     actor = Actor(env, server.policy, agent.initial_state(1),
@@ -237,7 +243,6 @@ def train(config: Config, max_steps: Optional[int] = None,
 
   # Multi-host: every host logs its OWN fleet's stream; process 0 keeps
   # the canonical filename (shared logdirs must not interleave writers).
-  process_index = jax.process_index()
   summary_name = ('summaries.jsonl' if process_index == 0
                   else f'summaries_p{process_index}.jsonl')
   writer = observability.SummaryWriter(config.logdir,

@@ -1,0 +1,43 @@
+"""Child process for the two-process multi-host driver test.
+
+Each process joins jax.distributed (2 procs × 2 virtual CPU devices =
+a 4-way data mesh), runs the REAL driver.train against its own actor
+fleet, and exits 0 on success. Run by test_multihost.py — not collected
+by pytest itself.
+"""
+
+import os
+import sys
+
+
+def main():
+  proc = int(sys.argv[1])
+  port = sys.argv[2]
+  logdir = sys.argv[3]
+  os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=2'
+  import jax
+  jax.config.update('jax_platforms', 'cpu')
+  jax.distributed.initialize(f'localhost:{port}', num_processes=2,
+                             process_id=proc)
+  assert jax.device_count() == 4 and jax.local_device_count() == 2
+
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu.config import Config
+  cfg = Config(
+      logdir=logdir, env_backend='bandit', level_name='bandit',
+      num_actors=2, batch_size=4,          # GLOBAL batch; 2 per host
+      unroll_length=5, num_action_repeats=1, episode_length=4,
+      height=24, width=32, torso='shallow', use_py_process=False,
+      use_instruction=False, total_environment_frames=10**6,
+      inference_timeout_ms=5, checkpoint_secs=0, summary_secs=0,
+      # Same seed on every process: model init must be IDENTICAL
+      # across hosts (the driver diversifies env/sampling streams by
+      # process internally).
+      seed=3)
+  run = driver.train(cfg, max_steps=3, stall_timeout_secs=120)
+  assert int(run.state.update_steps) == 3, run.state.update_steps
+  print(f'child {proc}: ok', flush=True)
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,65 @@
+"""REAL multi-host test: two OS processes join jax.distributed and run
+the full driver over one 4-device mesh (2 virtual CPU devices each).
+
+The reference tests its gRPC distributed mode not at all (SURVEY §4:
+"how they test distributed without a cluster: they don't"). This drives
+the actual multi-process path: per-host fleets feeding process-local
+shards (`make_array_from_process_local_data`), the gradient psum across
+processes, the broadcast-gated collective checkpoint, and per-process
+summary streams.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+def _free_port():
+  s = socket.socket()
+  s.bind(('localhost', 0))
+  port = s.getsockname()[1]
+  s.close()
+  return port
+
+
+def test_two_process_training(tmp_path):
+  # Bounded by the children's communicate(timeout=280) below.
+  child = os.path.join(os.path.dirname(__file__), '_multihost_child.py')
+  port = str(_free_port())
+  logdir = str(tmp_path)
+  repo_root = os.path.dirname(os.path.dirname(child))
+  env = {k: v for k, v in os.environ.items()
+         if k not in ('XLA_FLAGS', 'JAX_PLATFORMS')}
+  # Children run a script by path, so the package root must be on
+  # PYTHONPATH (they pin the CPU backend, so the axon plugin's
+  # PYTHONPATH sensitivity doesn't apply).
+  existing = os.environ.get('PYTHONPATH', '')
+  env['PYTHONPATH'] = (repo_root + os.pathsep + existing if existing
+                       else repo_root)
+  procs = [
+      subprocess.Popen([sys.executable, child, str(i), port, logdir],
+                       stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                       env=env, cwd=repo_root)
+      for i in range(2)]
+  outs = []
+  try:
+    for p in procs:
+      out, _ = p.communicate(timeout=280)
+      outs.append(out.decode())
+  finally:
+    # A child hung in a collective (e.g. its peer died) must not be
+    # orphaned holding CPU and the distributed port.
+    for p in procs:
+      if p.poll() is None:
+        p.kill()
+  for i, (p, out) in enumerate(zip(procs, outs)):
+    assert p.returncode == 0, f'child {i} failed:\n{out[-3000:]}'
+    assert f'child {i}: ok' in out
+
+  # Per-process summary streams; config.json from process 0 only.
+  assert os.path.exists(os.path.join(logdir, 'summaries.jsonl'))
+  assert os.path.exists(os.path.join(logdir, 'summaries_p1.jsonl'))
+  assert os.path.exists(os.path.join(logdir, 'config.json'))
+  # The collective final checkpoint landed (step 3).
+  ckpts = os.listdir(os.path.join(logdir, 'checkpoints'))
+  assert '3' in ckpts, ckpts
