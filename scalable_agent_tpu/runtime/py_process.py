@@ -309,18 +309,32 @@ def close_all(processes, timeout=5.0, pool_size=None):
     pool.map(lambda p: p.close(timeout), processes)
 
 
-class hosted(object):
-  """Context manager: `with hosted([PyProcess(...), ...]) as procs:` —
-  starts the fleet on enter, closes it on exit (error or not)."""
+class PyProcessHook:
+  """Reference-named lifecycle hook (reference: py_process.py ≈L190
+  `PyProcessHook(SessionRunHook)`): `begin()` starts the registered
+  fleet, `end()` closes it. There is no TF session to hook into here —
+  call begin/end around your run loop, or use `hosted(...)` as a
+  context manager (same implementation, exception-safe)."""
 
   def __init__(self, processes):
     self._processes = list(processes)
 
-  def __enter__(self):
+  def begin(self):
     return start_all(self._processes)
 
+  def end(self, timeout=5.0):
+    close_all(self._processes, timeout=timeout)
+
+
+class hosted(PyProcessHook):
+  """Context manager form: `with hosted([PyProcess(...), ...]) as
+  procs:` — begin() on enter, end() on exit (error or not)."""
+
+  def __enter__(self):
+    return self.begin()
+
   def __exit__(self, *exc):
-    close_all(self._processes)
+    self.end()
     return False
 
 

@@ -160,3 +160,24 @@ def test_proxy_env_runs_fake_env_out_of_process():
     assert dones == [False, False, True, False, False, True]
   finally:
     env.close()
+
+
+def test_py_process_hook_lifecycle():
+  """Reference-named hook: begin() starts the fleet, end() closes it
+  (reference: PyProcessHook ≈L190)."""
+  from scalable_agent_tpu.envs.fake import FakeEnv
+  from scalable_agent_tpu.runtime.py_process import (
+      ProxyEnv, PyProcess, PyProcessHook)
+  processes = [PyProcess(FakeEnv,
+                         constructor_kwargs=dict(height=8, width=8))
+               for _ in range(2)]
+  hook = PyProcessHook(processes)
+  hook.begin()
+  try:
+    envs = [ProxyEnv(p) for p in processes]
+    for env in envs:
+      frame, _ = env.initial()
+      assert frame.shape == (8, 8, 3)
+  finally:
+    hook.end()
+  assert all(not p.running for p in processes)
