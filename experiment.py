@@ -58,6 +58,8 @@ flags.DEFINE_enum('reward_clipping', _DEFAULTS.reward_clipping,
 flags.DEFINE_string('dataset_path', _DEFAULTS.dataset_path,
                     'Path to dataset needed for psychlab_*, see '
                     'DMLab docs.')
+flags.DEFINE_string('level_cache_dir', _DEFAULTS.level_cache_dir,
+                    'DMLab compiled-level cache directory override.')
 flags.DEFINE_string('level_name', _DEFAULTS.level_name,
                     "Level name, or 'dmlab30' for the full benchmark.")
 flags.DEFINE_integer('width', _DEFAULTS.width, 'Frame width.')
