@@ -147,3 +147,15 @@ def test_profiler_trace_capture(tmp_path):
   traces = glob.glob(os.path.join(prof_dir, '**', '*.xplane.pb'),
                      recursive=True)
   assert traces, f'no trace under {prof_dir}'
+
+
+def test_pallas_vtrace_rejected_under_mesh(tmp_path):
+  """pallas_call has no SPMD partitioning rule; the driver must reject
+  the combination before any env/checkpoint spin-up."""
+  cfg = _config(tmp_path, batch_size=8, use_pallas_vtrace=True)
+  with pytest.raises(ValueError, match='single-device'):
+    driver.train(cfg, max_steps=1)
+  cfg2 = _config(tmp_path, use_pallas_vtrace=True,
+                 use_associative_scan=True)
+  with pytest.raises(ValueError, match='mutually exclusive'):
+    driver.train(cfg2, max_steps=1)
