@@ -119,6 +119,36 @@ class Checkpointer:
     return self._manager.restore(
         step, args=ocp.args.StandardRestore(abstract))
 
+  def restore_latest_params(self, abstract_state: TrainState):
+    """Restore ONLY params (+ the update_steps counter) from the latest
+    checkpoint; returns (params, update_steps) or None.
+
+    Eval needs the policy weights, not the optimizer moments (≈2×
+    params of dead HBM if restored). Every leaf outside
+    params/update_steps is marked `ocp.PLACEHOLDER`, so Orbax never
+    reads or materializes it. `abstract_state` is a shape/dtype(/
+    sharding) TrainState — build it with `jax.eval_shape` over
+    `make_train_state` so the moments are never materialized host-side
+    either.
+    """
+    step = self._manager.latest_step()
+    if step is None:
+      return None
+
+    placeholder = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda _: ocp.PLACEHOLDER, t)
+    target = abstract_state._replace(
+        opt_state=placeholder(abstract_state.opt_state),
+        popart=placeholder(abstract_state.popart))
+    # PLACEHOLDER is a PyTreeRestore feature (StandardRestore rejects
+    # it), and a manager that already did a StandardSave has its item
+    # handler pinned — so restore straight from the step directory
+    # with a standalone PyTree checkpointer.
+    path = os.path.join(self._directory, str(step), 'default')
+    restored = ocp.PyTreeCheckpointer().restore(
+        path, args=ocp.args.PyTreeRestore(target))
+    return restored.params, int(jax.device_get(restored.update_steps))
+
   def wait_until_finished(self):
     self._manager.wait_until_finished()
 

@@ -95,6 +95,14 @@ def _choose_mesh(config: Config):
         f'{len(devices)}')
   dp = len(devices) // mp
   if config.batch_size % dp != 0:
+    if jax.process_count() > 1:
+      # Multi-host: the fallback would leave every host training an
+      # independent, never-synchronized replica against a shared
+      # logdir — silently wrong training. Refuse.
+      raise ValueError(
+          f'batch_size={config.batch_size} not divisible by '
+          f'data-parallel width {dp}; single-device fallback is only '
+          'safe single-host')
     log.warning('batch_size %d not divisible by data-parallel width %d;'
                 ' falling back to single-device training',
                 config.batch_size, dp)
@@ -262,6 +270,7 @@ def train(config: Config, max_steps: Optional[int] = None,
   fleet.start()
   steps_done = 0
   profiling = False
+  errors: List[BaseException] = []
   last_inference_snap = {'calls': 0, 'requests': 0}
   last_summary = time.monotonic()
   last_batch_time = time.monotonic()
@@ -278,23 +287,27 @@ def train(config: Config, max_steps: Optional[int] = None,
         stats_view, batch_device = prefetcher.get(timeout=poll_secs)
       except TimeoutError:
         # No data yet: surface actor failures instead of hanging (the
-        # reference hangs silently here — SURVEY §5.3). check_health
-        # respawns failed actors; a respawn whose env construction
-        # fails raises out of train().
+        # reference hangs silently here — SURVEY §5.3). Read errors
+        # BEFORE check_health — a respawn clears the slot's error, and
+        # a crash-looping actor's root cause must survive to the stall
+        # raise below (same ordering as evaluate()).
+        errors = fleet.errors() or errors
         fleet.check_health(stall_timeout_secs=stall_timeout_secs)
         if (stall_timeout_secs is not None and
             time.monotonic() - last_batch_time >
             max(3 * stall_timeout_secs, 30.0)):
-          errors = fleet.errors()
           raise errors[0] if errors else TimeoutError(
               'no trajectory batch despite healthy actors')
         continue
       except ring_buffer.Closed:
-        errors = fleet.errors()
+        errors = fleet.errors() or errors
         if errors:
           raise errors[0]
         raise
       last_batch_time = time.monotonic()
+      # Data is flowing again: captured errors are from a recovered
+      # incident; keeping them would misattribute a much later stall.
+      errors = []
       # jax.profiler capture window (SURVEY §5.1 — the reference has
       # no tracing at all): [start, start+num) learner steps, placed
       # after warmup so compiles don't drown the timeline.
@@ -423,14 +436,28 @@ def evaluate(config: Config,
 
   checkpointer = checkpoint_lib.Checkpointer(
       config.logdir + '/checkpoints')
-  state = learner_lib.make_train_state(
-      params, config,
-      len(train_levels) if config.use_popart else 0)
-  restored = checkpointer.restore_latest(state)
+  # Params-only restore: eval never materializes the RMSProp moments
+  # (≈2× params) — the abstract target is built under eval_shape and
+  # the moment leaves restore as placeholders. The restored leaves
+  # need explicit placements (Orbax requires shardings when
+  # process_count > 1): pin them from the concrete init params.
+  abstract_state = jax.eval_shape(
+      lambda p: learner_lib.make_train_state(
+          p, config, len(train_levels) if config.use_popart else 0),
+      params)
+  as_abstract = lambda c: jax.ShapeDtypeStruct(  # noqa: E731
+      c.shape, c.dtype, sharding=c.sharding)
+  dev_sharding = jax.tree_util.tree_leaves(params)[0].sharding
+  abstract_state = abstract_state._replace(
+      params=jax.tree_util.tree_map(as_abstract, params),
+      update_steps=jax.ShapeDtypeStruct(
+          abstract_state.update_steps.shape,
+          abstract_state.update_steps.dtype, sharding=dev_sharding))
+  restored = checkpointer.restore_latest_params(abstract_state)
   if restored is None:
     raise FileNotFoundError(
         f'no checkpoint under {config.logdir}/checkpoints')
-  params = restored.params
+  params, restored_steps = restored
   checkpointer.close()
 
   server = InferenceServer(agent, params, config,
@@ -484,9 +511,10 @@ def evaluate(config: Config,
               f'eval produced no unrolls for {eval_drought_secs}s')
         continue
       except ring_buffer.Closed:
-        errors = fleet.errors()
+        errors = fleet.errors() or errors
         raise errors[0] if errors else ring_buffer.Closed()
       last_unroll_time = time.monotonic()
+      errors = []  # recovered; see train()
       for level_id, ep_return, _ in observability.extract_episodes(
           stats_view(unroll)):
         level_returns[train_levels[level_id]].append(ep_return)
@@ -499,7 +527,7 @@ def evaluate(config: Config,
                else f'eval_summaries_p{jax.process_index()}.jsonl')
   writer = observability.SummaryWriter(config.logdir,
                                        filename=eval_name)
-  step = int(jax.device_get(restored.update_steps))
+  step = restored_steps
   for train_name, test_name in zip(train_levels, test_levels):
     returns = level_returns[train_name][:config.test_num_episodes]
     level_returns[train_name] = returns

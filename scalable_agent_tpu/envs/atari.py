@@ -55,6 +55,7 @@ class AtariEnv(base.Environment):
                width: int = 96, num_action_repeats: int = 4,
                noop_max: int = DEFAULT_NOOP_MAX,
                full_action_set: bool = True, is_test: bool = False,
+               num_actions: Optional[int] = None,
                ale: Optional[object] = None):
     """`ale` injects a backend (testing); otherwise ale_py/gymnasium."""
     self._h, self._w = height, width
@@ -65,6 +66,15 @@ class AtariEnv(base.Environment):
     self._ale = ale if ale is not None else _make_ale(
         game, self._rng.randint(0, 2 ** 31 - 1), full_action_set)
     self._actions = self._ale.action_set()
+    if num_actions is not None and num_actions != len(self._actions):
+      # Fail fast: a policy head sized differently from the backend's
+      # action set would silently alias actions (e.g. num_actions=18
+      # against a minimal set) and corrupt the policy/env
+      # correspondence.
+      raise ValueError(
+          f'num_actions={num_actions} but the {game!r} backend exposes '
+          f'{len(self._actions)} actions '
+          f'(full_action_set={full_action_set})')
     self._reset()
 
   def _reset(self):
@@ -86,7 +96,7 @@ class AtariEnv(base.Environment):
     return self._observation()
 
   def step(self, action):
-    raw_action = self._actions[int(action) % len(self._actions)]
+    raw_action = self._actions[int(action)]
     reward = 0.0
     for _ in range(self._num_action_repeats):
       reward += self._ale.act(raw_action)

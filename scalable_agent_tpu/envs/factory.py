@@ -80,10 +80,17 @@ def make_env_spec(config: Config, level_name: str, seed: int,
     from scalable_agent_tpu.envs import atari
     env_class = atari.AtariEnv
     num_actions = config.num_actions or atari.DEFAULT_NUM_ACTIONS
+    # The factory knows both the policy-head size and the backend; the
+    # env validates they agree at construction (no silent aliasing).
+    # A head smaller than the full 18-action ALE set means the user
+    # wants the game's minimal action set — the env still verifies the
+    # backend's set has exactly num_actions entries.
     kwargs = dict(game=level_name, seed=seed,
                   height=config.height, width=config.width,
                   num_action_repeats=config.num_action_repeats,
-                  is_test=is_test)
+                  is_test=is_test, num_actions=num_actions,
+                  full_action_set=(
+                      num_actions == atari.DEFAULT_NUM_ACTIONS))
     frame_shape = (config.height, config.width, 3)
   else:
     raise ValueError(f'unknown env backend: {backend!r}')

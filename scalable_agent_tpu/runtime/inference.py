@@ -60,6 +60,10 @@ class InferenceServer:
     self._calls = 0
     self._merged_requests = 0
     self._params_version = 0
+    # _key is split from both warmup (caller thread) and batched (the
+    # batcher's computation thread); the lock makes that safe without
+    # relying on warmup-completes-before-serving ordering.
+    self._key_lock = threading.Lock()
     self._key = jax.random.PRNGKey(seed)
     self._max_batch = config.inference_max_batch
 
@@ -94,7 +98,8 @@ class InferenceServer:
 
       with self._params_lock:
         params = self._params
-      self._key, sub = jax.random.split(self._key)
+      with self._key_lock:
+        self._key, sub = jax.random.split(self._key)
       outs = self._step(params, sub, *map(
           pad0, (prev_action, reward, done, frame, instr, core_c,
                  core_h)))
@@ -150,7 +155,8 @@ class InferenceServer:
       padded_done.add(padded)
       with self._params_lock:
         params = self._params
-      self._key, sub = jax.random.split(self._key)
+      with self._key_lock:
+        self._key, sub = jax.random.split(self._key)
       outs = self._step(
           params, sub,
           np.zeros((padded,), np.int32),

@@ -153,6 +153,17 @@ def test_atari_env_step_and_auto_reset():
   assert frame.max() == ale._t % 256
 
 
+def test_atari_num_actions_mismatch_fails_fast():
+  """A policy head sized differently from the backend's action set must
+  raise at construction, not silently alias actions (ADVICE r1)."""
+  with pytest.raises(ValueError, match='num_actions=18'):
+    atari.AtariEnv('pong', seed=0, height=24, width=32,
+                   num_actions=18, ale=FakeAle())
+  # Matching sizes construct fine.
+  atari.AtariEnv('pong', seed=0, height=24, width=32,
+                 num_actions=4, noop_max=0, ale=FakeAle())
+
+
 def test_atari_noop_starts_bounded():
   ale = FakeAle(episode_len=1000)
   atari.AtariEnv('pong', seed=123, height=24, width=32,
