@@ -122,31 +122,59 @@ class Actor:
     self._env.close()
 
 
-def run_actor_loop(actor: Actor, buffer, stop_event) -> None:
+def run_actor_loop(actor: Actor, buffer, stop_event,
+                   on_unroll: Optional[Callable[[], bool]] = None,
+                   on_failure: Optional[Callable] = None) -> None:
   """Produce unrolls into `buffer` until stopped (thread target).
 
-  Clean-shutdown contract: a closed buffer or a cancelled inference
-  call (batcher closed) while stopping is normal termination, mirroring
-  the reference's closed-pipe → StopIteration convention
-  (py_process.py ≈L72). The same exceptions while NOT stopping are
-  real failures and propagate."""
+  THE actor loop — the fleet (`runtime.fleet.ActorFleet`) and
+  standalone threads both run this, so there is exactly one
+  shutdown/poison contract:
+
+  - Clean shutdown: a closed buffer or a cancelled inference call
+    (batcher closed) while `stop_event` is set is normal termination,
+    mirroring the reference's closed-pipe → StopIteration convention
+    (reference: py_process.py ≈L72).
+  - Real failure (the same exceptions while NOT stopping, or any other
+    exception): by default the buffer is poisoned — closed, so the
+    learner's next get raises instead of hanging — and the exception
+    surfaces on this thread. `on_failure(exc)` overrides this (the
+    fleet records the error on its slot and keeps the shared buffer
+    open for the other actors).
+
+  Args:
+    actor: the Actor to roll (closed on exit, always).
+    buffer: TrajectoryBuffer receiving unrolls.
+    stop_event: threading.Event signalling shutdown.
+    on_unroll: called after each successful put; returning False ends
+      the loop (the fleet's orphaned-slot check). None = run forever.
+    on_failure: called with the failure exception instead of the
+      default poison-and-raise.
+  """
   from scalable_agent_tpu.ops.dynamic_batching import BatcherCancelled
   from scalable_agent_tpu.runtime import ring_buffer
+
+  def fail(exc):
+    if on_failure is None:
+      buffer.close()
+      raise exc
+    on_failure(exc)
+
   try:
     while not stop_event.is_set():
       buffer.put(actor.unroll())
-  except (ring_buffer.Closed, BatcherCancelled):
+      if on_unroll is not None and not on_unroll():
+        return  # orphaned: a replacement owns this actor's slot
+  except (ring_buffer.Closed, BatcherCancelled) as e:
     if not stop_event.is_set():
-      buffer.close()  # signal the learner instead of stalling silently
-      raise
-  except BaseException:
-    # A real actor failure (bad policy output, env crash): poison the
-    # buffer so the learner's next get raises instead of hanging, then
-    # let the exception surface on this thread.
-    buffer.close()
-    raise
+      fail(e)
+  except BaseException as e:
+    fail(e)
   finally:
-    actor.close()
+    try:
+      actor.close()
+    except Exception:
+      pass
 
 
 def batch_unrolls(unrolls):

@@ -78,40 +78,36 @@ class ActorFleet:
     slot.thread.start()
 
   def _run(self, slot: _Slot, generation: int, actor: Actor, process):
-    """Thread body. Touches only ITS OWN actor/process objects and
-    writes slot state only while it is still the slot's current
-    generation — an orphaned thread (replaced after a stall) must not
-    mark the healthy replacement dead or close its process."""
-    from scalable_agent_tpu.ops.dynamic_batching import BatcherCancelled
+    """Thread body: `actor.run_actor_loop` (the one shutdown/poison
+    contract) with fleet bookkeeping hooked in. Touches only ITS OWN
+    actor/process objects and writes slot state only while it is still
+    the slot's current generation — an orphaned thread (replaced after
+    a stall) must not mark the healthy replacement dead or close its
+    process. Failures are recorded on the slot (the shared buffer
+    stays open for the other actors); the learner surfaces them via
+    errors() on its stall path."""
+    from scalable_agent_tpu.runtime.actor import run_actor_loop
 
     def still_current():
       return slot.generation == generation
 
-    try:
-      while not self._stop.is_set():
-        unroll = actor.unroll()
-        self._buffer.put(unroll)
-        with self._lock:
-          if not still_current():
-            return  # orphaned: a replacement owns the slot now
-          slot.last_heartbeat = time.monotonic()
-          slot.unrolls_done += 1
-    except (ring_buffer.Closed, BatcherCancelled):
-      # Normal during shutdown (closed buffer/batcher = the reference's
-      # closed-pipe → StopIteration convention); a failure otherwise.
-      if not self._stop.is_set():
-        with self._lock:
-          if still_current():
-            slot.error = ring_buffer.Closed('buffer closed under actor')
-    except BaseException as e:
+    def on_unroll():
+      with self._lock:
+        if not still_current():
+          return False  # orphaned: a replacement owns the slot now
+        slot.last_heartbeat = time.monotonic()
+        slot.unrolls_done += 1
+        return True
+
+    def on_failure(exc):
       with self._lock:
         if still_current():
-          slot.error = e
+          slot.error = exc
+
+    try:
+      run_actor_loop(actor, self._buffer, self._stop,
+                     on_unroll=on_unroll, on_failure=on_failure)
     finally:
-      try:
-        actor.close()
-      except Exception:
-        pass
       if process is not None:
         try:
           process.close(timeout=2.0)
