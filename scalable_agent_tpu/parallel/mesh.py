@@ -49,14 +49,28 @@ def make_mesh(devices=None, model_parallelism: int = 1) -> Mesh:
 
 
 # Parameter sharding rules: regex on the flattened param path → spec.
-# Anonymous Dense kernels (torso projections) shard their output
-# features over the model axis; the named heads (policy_logits,
-# baseline) and everything else stay replicated — heads are tiny and
-# their outputs feed cross-replica math. Rules are deliberately few and
-# auditable; at IMPALA scale TP is headroom, not a necessity.
+# The bulk of the params shard their OUTPUT-feature dim over the model
+# axis:
+# - anonymous Dense kernels (torso projections),
+# - every OptimizedLSTMCell gate kernel (i{i,f,g,o} input-to-gate and
+#   h{i,f,g,o} hidden-to-gate) — the recurrent carry then propagates
+#   model-sharded through the time scan, the Megatron-style LSTM cut,
+# - Conv kernels ([kh, kw, in, out]) on their out-channel dim.
+# The named heads (policy_logits, baseline) stay replicated — they are
+# tiny and their outputs feed cross-replica math. Leaves whose sharded
+# dim does not divide the model width drop to replicated
+# (param_shardings guard). At IMPALA scale TP is headroom, not a
+# necessity; the mechanism is real and tested (tests/test_parallel.py
+# asserts both the placements and TP-vs-single-device numerics).
 _PARAM_RULES = (
     (re.compile(r'.*Dense_\d+/kernel$'), P(None, MODEL_AXIS)),
     (re.compile(r'.*Dense_\d+/bias$'), P(MODEL_AXIS)),
+    (re.compile(r'.*OptimizedLSTMCell_\d+/[ih][ifgo]/kernel$'),
+     P(None, MODEL_AXIS)),
+    (re.compile(r'.*OptimizedLSTMCell_\d+/[ih][ifgo]/bias$'),
+     P(MODEL_AXIS)),
+    (re.compile(r'.*Conv_\d+/kernel$'), P(None, None, None, MODEL_AXIS)),
+    (re.compile(r'.*Conv_\d+/bias$'), P(MODEL_AXIS)),
 )
 
 

@@ -102,15 +102,34 @@ def test_tp_sharded_step_runs_and_matches():
 
 
 def test_param_sharding_rules():
-  agent = ImpalaAgent(num_actions=A, torso='shallow')
+  """TP must actually cut the bulk of the params — the LSTM core and
+  the torso Convs, not just anonymous Dense projections (VERDICT W2:
+  the claim must equal the mechanism). Deep torso + instruction
+  encoder covers every rule."""
+  agent = ImpalaAgent(num_actions=A, torso='deep', use_instruction=True)
   params = init_params(agent, jax.random.PRNGKey(0), OBS)
   mesh = mesh_lib.make_mesh(model_parallelism=2)
   shardings = mesh_lib.param_shardings(params, mesh, enable_tp=True)
   flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
   specs = {'/'.join(str(getattr(k, 'key', k)) for k in kp):
            s.spec for kp, s in flat}
-  # At least one Dense kernel is model-sharded; heads stay replicated.
-  assert any('model' in str(spec) for spec in specs.values()), specs
+
+  def sharded(path):
+    return 'model' in str(specs[path])
+
+  # The recurrent core: all 8 gate kernels + 4 biases model-sharded.
+  for gate in ('ii', 'if', 'ig', 'io', 'hi', 'hf', 'hg', 'ho'):
+    assert sharded(
+        f'params/_ResetCore_0/OptimizedLSTMCell_0/{gate}/kernel'), gate
+  # Torso convs shard their out-channel dim.
+  assert sharded('params/DeepResNetTorso_0/Conv_0/kernel')
+  assert sharded('params/DeepResNetTorso_0/ResidualBlock_0/Conv_0/kernel')
+  # Torso Dense projection.
+  assert any('Dense' in p and sharded(p) for p in specs)
+  # Instruction-encoder LSTM shards too.
+  assert sharded(
+      'params/InstructionEncoder_0/OptimizedLSTMCell_0/hf/kernel')
+  # Heads stay replicated (tiny; outputs feed cross-replica math).
   for path, spec in specs.items():
     if 'policy_logits' in path or 'baseline' in path:
       assert 'model' not in str(spec)
