@@ -119,34 +119,51 @@ class Checkpointer:
     return self._manager.restore(
         step, args=ocp.args.StandardRestore(abstract))
 
-  def restore_latest_params(self, abstract_state: TrainState):
+  def restore_latest_params(self, params, make_state):
     """Restore ONLY params (+ the update_steps counter) from the latest
     checkpoint; returns (params, update_steps) or None.
 
     Eval needs the policy weights, not the optimizer moments (≈2×
-    params of dead HBM if restored). Every leaf outside
-    params/update_steps is marked `ocp.PLACEHOLDER`, so Orbax never
-    reads or materializes it. `abstract_state` is a shape/dtype(/
-    sharding) TrainState — build it with `jax.eval_shape` over
-    `make_train_state` so the moments are never materialized host-side
-    either.
+    params of dead HBM if restored). The full-state target is built
+    only abstractly (`jax.eval_shape` over `make_state`) so the
+    moments are never materialized, and every leaf outside
+    params/update_steps restores as `ocp.PLACEHOLDER` — Orbax never
+    reads it. Restored leaves land on `params`' own placements (Orbax
+    requires explicit shardings when process_count > 1).
+
+    Args:
+      params: CONCRETE param pytree of jax.Arrays (init_params output);
+        supplies both the tree structure and the target placements.
+      make_state: params → TrainState (e.g. a make_train_state
+        closure); evaluated under eval_shape only.
     """
     step = self._manager.latest_step()
     if step is None:
       return None
 
+    abstract = jax.eval_shape(make_state, params)
+    as_abstract = lambda c: jax.ShapeDtypeStruct(  # noqa: E731
+        c.shape, c.dtype, sharding=c.sharding)
+    dev_sharding = jax.tree_util.tree_leaves(params)[0].sharding
     placeholder = lambda t: jax.tree_util.tree_map(  # noqa: E731
         lambda _: ocp.PLACEHOLDER, t)
-    target = abstract_state._replace(
-        opt_state=placeholder(abstract_state.opt_state),
-        popart=placeholder(abstract_state.popart))
+    target = abstract._replace(
+        params=jax.tree_util.tree_map(as_abstract, params),
+        update_steps=jax.ShapeDtypeStruct(
+            abstract.update_steps.shape, abstract.update_steps.dtype,
+            sharding=dev_sharding),
+        opt_state=placeholder(abstract.opt_state),
+        popart=placeholder(abstract.popart))
     # PLACEHOLDER is a PyTreeRestore feature (StandardRestore rejects
     # it), and a manager that already did a StandardSave has its item
-    # handler pinned — so restore straight from the step directory
-    # with a standalone PyTree checkpointer.
-    path = os.path.join(self._directory, str(step), 'default')
-    restored = ocp.PyTreeCheckpointer().restore(
-        path, args=ocp.args.PyTreeRestore(target))
+    # handler pinned — restore through a FRESH manager so the step
+    # layout stays Orbax's concern, not ours.
+    manager = ocp.CheckpointManager(self._directory)
+    try:
+      restored = manager.restore(step,
+                                 args=ocp.args.PyTreeRestore(target))
+    finally:
+      manager.close()
     return restored.params, int(jax.device_get(restored.update_steps))
 
   def wait_until_finished(self):

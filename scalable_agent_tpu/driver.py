@@ -237,14 +237,20 @@ def train(config: Config, max_steps: Optional[int] = None,
 
   def stage(host_batch):
     """Prefetcher stage: peel off a tiny host-side stats view (done /
-    info / level ids — the batch is host numpy right here) BEFORE the
-    device transfer, so the train loop never device_gets frames just to
-    read episode stats."""
+    info / level ids / action counts — the batch is host numpy right
+    here) BEFORE the device transfer, so the train loop never
+    device_gets frames just to read episode stats."""
     stats_view = _stats_only_view(
         np.asarray(host_batch.level_name),
         jax.tree_util.tree_map(np.asarray, host_batch.env_outputs.info),
         np.asarray(host_batch.env_outputs.done))
-    return stats_view, place_fn(host_batch)
+    # Action histogram source (reference build_learner's
+    # tf.summary.histogram, ≈L395): bincount of the trained-on actions
+    # ([1:] drops the overlap row, like the loss shift).
+    action_counts = np.bincount(
+        np.asarray(host_batch.agent_outputs.action)[1:].ravel(),
+        minlength=num_actions)
+    return stats_view, action_counts, place_fn(host_batch)
 
   prefetcher = ring_buffer.BatchPrefetcher(
       buffer, local_batch_size, place_fn=stage)
@@ -271,6 +277,7 @@ def train(config: Config, max_steps: Optional[int] = None,
   steps_done = 0
   profiling = False
   errors: List[BaseException] = []
+  action_counts_acc = np.zeros((num_actions,), np.int64)
   last_inference_snap = {'calls': 0, 'requests': 0}
   last_summary = time.monotonic()
   last_batch_time = time.monotonic()
@@ -284,7 +291,8 @@ def train(config: Config, max_steps: Optional[int] = None,
       if max_steps is not None and steps_done >= max_steps:
         break
       try:
-        stats_view, batch_device = prefetcher.get(timeout=poll_secs)
+        stats_view, action_counts, batch_device = prefetcher.get(
+            timeout=poll_secs)
       except TimeoutError:
         # No data yet: surface actor failures instead of hanging (the
         # reference hangs silently here — SURVEY §5.3). Read errors
@@ -324,6 +332,7 @@ def train(config: Config, max_steps: Optional[int] = None,
       run.state = state
       steps_done += 1
       fps_meter.update(config.frames_per_step)
+      action_counts_acc += action_counts
 
       # Episode stats ride in the trajectory; the prefetcher peeled a
       # host-side view before the device transfer — no device_get here.
@@ -362,6 +371,10 @@ def train(config: Config, max_steps: Optional[int] = None,
         # versions" caveat, made observable).
         writer.scalar('params_version', snap['params_version'],
                       step_now)
+        # Per-interval action distribution (cumulative would hide a
+        # late policy collapse).
+        writer.histogram('actions', action_counts_acc, step_now)
+        action_counts_acc = np.zeros_like(action_counts_acc)
       # Checkpoint cadence: Orbax saves are collective across hosts;
       # clocks differ, so all hosts act on PROCESS 0's decision (a
       # host-local clock here would desync the barrier and deadlock).
@@ -437,23 +450,11 @@ def evaluate(config: Config,
   checkpointer = checkpoint_lib.Checkpointer(
       config.logdir + '/checkpoints')
   # Params-only restore: eval never materializes the RMSProp moments
-  # (≈2× params) — the abstract target is built under eval_shape and
-  # the moment leaves restore as placeholders. The restored leaves
-  # need explicit placements (Orbax requires shardings when
-  # process_count > 1): pin them from the concrete init params.
-  abstract_state = jax.eval_shape(
+  # (≈2× params) — see Checkpointer.restore_latest_params.
+  restored = checkpointer.restore_latest_params(
+      params,
       lambda p: learner_lib.make_train_state(
-          p, config, len(train_levels) if config.use_popart else 0),
-      params)
-  as_abstract = lambda c: jax.ShapeDtypeStruct(  # noqa: E731
-      c.shape, c.dtype, sharding=c.sharding)
-  dev_sharding = jax.tree_util.tree_leaves(params)[0].sharding
-  abstract_state = abstract_state._replace(
-      params=jax.tree_util.tree_map(as_abstract, params),
-      update_steps=jax.ShapeDtypeStruct(
-          abstract_state.update_steps.shape,
-          abstract_state.update_steps.dtype, sharding=dev_sharding))
-  restored = checkpointer.restore_latest_params(abstract_state)
+          p, config, len(train_levels) if config.use_popart else 0))
   if restored is None:
     raise FileNotFoundError(
         f'no checkpoint under {config.logdir}/checkpoints')

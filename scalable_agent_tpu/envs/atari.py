@@ -96,7 +96,13 @@ class AtariEnv(base.Environment):
     return self._observation()
 
   def step(self, action):
-    raw_action = self._actions[int(action)]
+    a = int(action)
+    if not 0 <= a < len(self._actions):
+      # Python negative indexing would silently alias to the end of
+      # the action set; out-of-range must raise either way.
+      raise IndexError(
+          f'action {a} outside [0, {len(self._actions)})')
+    raw_action = self._actions[a]
     reward = 0.0
     for _ in range(self._num_action_repeats):
       reward += self._ale.act(raw_action)

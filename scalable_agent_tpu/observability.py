@@ -51,6 +51,22 @@ class SummaryWriter:
     for tag, value in values.items():
       self.scalar(tag, value, step)
 
+  def histogram(self, tag: str, counts, step: int, edges=None):
+    """Fixed-bin histogram event (the reference's
+    tf.summary.histogram channel, experiment.py ≈L395 — its one use is
+    the per-update action histogram, the main policy-collapse signal).
+
+    `counts[i]` is the count of bin i — for discrete data (actions)
+    the bin IS the value; for continuous data pass `edges` (len
+    = len(counts)+1, np.histogram convention)."""
+    event = {'wall_time': round(time.time(), 3), 'step': int(step),
+             'tag': tag, 'kind': 'histogram',
+             'counts': [int(c) for c in np.asarray(counts).ravel()]}
+    if edges is not None:
+      event['edges'] = [float(e) for e in np.asarray(edges).ravel()]
+    with self._lock:
+      self._file.write(json.dumps(event) + '\n')
+
   def close(self):
     with self._lock:
       self._file.close()

@@ -75,7 +75,6 @@ def test_restore_latest_params_only(setup, tmp_path):
   """Eval-path restore: params + step counter come back equal, while
   the optimizer moments are never materialized (placeholder leaves) —
   VERDICT W5."""
-  import orbax.checkpoint as ocp
   cfg, agent, params, batch = setup
   params = jax.tree_util.tree_map(jnp.copy, params)
   train_step = learner_lib.make_train_step(agent, cfg)
@@ -86,9 +85,8 @@ def test_restore_latest_params_only(setup, tmp_path):
   ckpt.save(state)
   ckpt.wait_until_finished()
 
-  abstract = jax.eval_shape(
-      lambda p: learner_lib.make_train_state(p, cfg), state.params)
-  restored = ckpt.restore_latest_params(abstract)
+  restored = ckpt.restore_latest_params(
+      state.params, lambda p: learner_lib.make_train_state(p, cfg))
   assert restored is not None
   got_params, got_steps = restored
   _tree_equal(got_params, state.params)
@@ -99,9 +97,8 @@ def test_restore_latest_params_only(setup, tmp_path):
 def test_restore_latest_params_only_none_when_empty(setup, tmp_path):
   cfg, agent, params, _ = setup
   ckpt = Checkpointer(str(tmp_path / 'empty'), save_interval_secs=0)
-  abstract = jax.eval_shape(
-      lambda p: learner_lib.make_train_state(p, cfg), params)
-  assert ckpt.restore_latest_params(abstract) is None
+  assert ckpt.restore_latest_params(
+      params, lambda p: learner_lib.make_train_state(p, cfg)) is None
   ckpt.close()
 
 

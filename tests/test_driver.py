@@ -55,6 +55,15 @@ def test_train_smoke_and_checkpoint_roundtrip(tmp_path):
   with open(files[0]) as f:
     events = [json.loads(line) for line in f]
   assert any(e['tag'] == 'env_frames_per_sec' for e in events)
+  # Action histogram (reference ≈L395): counts over the action space,
+  # summing to the trained-on actions of the interval's batches.
+  hists = [e for e in events if e.get('kind') == 'histogram'
+           and e['tag'] == 'actions']
+  assert hists
+  num_actions = 3  # bandit backend default
+  assert all(len(h['counts']) == num_actions for h in hists)
+  assert sum(sum(h['counts']) for h in hists) <= \
+      5 * cfg.unroll_length * cfg.batch_size
 
 
 def test_train_total_frames_termination(tmp_path):

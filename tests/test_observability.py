@@ -65,6 +65,24 @@ def test_episode_stats_writes_summaries(tmp_path):
   assert ret['value'] == 3.5 and ret['step'] == 7
 
 
+def test_histogram_events(tmp_path):
+  """Histogram channel (reference tf.summary.histogram ≈L395): counts
+  round-trip as ints; continuous form carries bin edges."""
+  writer = obs.SummaryWriter(str(tmp_path))
+  writer.histogram('actions', np.array([5, 0, 2, 1]), step=3)
+  values = np.array([0.1, 0.4, 0.9])
+  counts, edges = np.histogram(values, bins=4)
+  writer.histogram('baseline', counts, step=3, edges=edges)
+  writer.close()
+  events = [json.loads(line) for line in open(writer.path)]
+  act = next(e for e in events if e['tag'] == 'actions')
+  assert act['kind'] == 'histogram'
+  assert act['counts'] == [5, 0, 2, 1]
+  assert act['step'] == 3 and 'edges' not in act
+  cont = next(e for e in events if e['tag'] == 'baseline')
+  assert len(cont['edges']) == len(cont['counts']) + 1
+
+
 def test_multi_task_scores_emitted_once_all_levels_report(tmp_path):
   levels = list(dmlab30.ALL_LEVELS)
   writer = obs.SummaryWriter(str(tmp_path))
