@@ -31,8 +31,18 @@ flags.DEFINE_integer('test_num_episodes', _DEFAULTS.test_num_episodes,
 flags.DEFINE_integer('task', _DEFAULTS.task,
                      'Process index in multi-host mode (-1: single).')
 flags.DEFINE_string('job_name', _DEFAULTS.job_name,
-                    'Kept for reference familiarity; multi-host roles '
-                    'are derived from jax.distributed, not this flag.')
+                    "Role: 'learner' (default) or 'actor'. An actor "
+                    'job runs an env fleet with CPU inference and '
+                    'streams unrolls to --learner_address (the '
+                    "reference's --job_name=actor gRPC topology). "
+                    'Learner-side multi-CHIP roles are derived from '
+                    'jax.distributed, not this flag.')
+flags.DEFINE_string('learner_address', _DEFAULTS.learner_address,
+                    'host:port of the learner ingest server '
+                    '(--job_name=actor).')
+flags.DEFINE_integer('remote_actor_port', _DEFAULTS.remote_actor_port,
+                     'Learner: listen for remote actor hosts on this '
+                     'port (0 = disabled).')
 flags.DEFINE_integer('num_actors', _DEFAULTS.num_actors,
                      'Actor (environment) count.')
 flags.DEFINE_integer('total_environment_frames',
@@ -151,8 +161,17 @@ def main(argv):
     distributed.initialize(FLAGS.coordinator_address,
                            num_processes=FLAGS.num_processes,
                            process_id=max(FLAGS.task, 0))
-  from scalable_agent_tpu import driver
   cfg = config_from_flags()
+  if cfg.job_name == 'actor':
+    # Actor-only host: no TPU, no learner — stream unrolls to the
+    # learner's ingest server (reference ≈L625 actor loop).
+    if not cfg.learner_address:
+      raise app.UsageError('--job_name=actor needs --learner_address')
+    from scalable_agent_tpu.runtime import remote
+    remote.run_remote_actor(cfg, cfg.learner_address,
+                            task=max(cfg.task, 0))
+    return
+  from scalable_agent_tpu import driver
   if cfg.mode == 'train':
     run = driver.train(cfg)
     logging.info('training done at %d frames', run.frames)

@@ -88,6 +88,14 @@ class Config:
   # Ring buffer capacity in batches (reference FIFOQueue capacity=1 +
   # StagingArea double buffer ⇒ bounded policy lag; keep it small).
   queue_capacity_batches: int = 1
+  # Remote actors (reference --job_name=actor gRPC topology, SURVEY
+  # §3.4): learner listens on this port for actor-host connections
+  # (0 = disabled); actor hosts point learner_address at it.
+  remote_actor_port: int = 0
+  learner_address: str = ''
+  # Min seconds between param snapshots published to remote hosts (a
+  # publish is a full device_get; remote staleness ~ this value).
+  remote_publish_secs: float = 2.0
 
   @property
   def frames_per_step(self):

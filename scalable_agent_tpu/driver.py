@@ -82,6 +82,34 @@ def build_agent(config: Config, num_actions: int,
                      dtype=dtype)
 
 
+def make_fleet(config: Config, agent, policy, buffer, levels,
+               seed_base: int = 0, level_offset: int = 0,
+               is_test: bool = False,
+               num_actors: Optional[int] = None) -> ActorFleet:
+  """The one env+actor+fleet construction, shared by train(),
+  evaluate(), and the remote-actor role (they differ only in seeds,
+  level assignment, and fleet size). Actor i plays
+  levels[(level_offset + i) % len] with env seed `seed_base + i + 1`.
+  """
+  n = config.num_actors if num_actors is None else num_actors
+
+  def make_actor(i):
+    idx = level_offset + i
+    level = levels[idx % len(levels)]
+    spec = factory.make_env_spec(config, level,
+                                 seed=seed_base + i + 1,
+                                 is_test=is_test)
+    env, process = factory.build_environment(
+        spec, use_py_process=config.use_py_process)
+    actor = Actor(env, policy, agent.initial_state(1),
+                  unroll_length=config.unroll_length,
+                  num_action_repeats=config.num_action_repeats,
+                  level_name_id=idx % len(levels))
+    return env, process, actor
+
+  return ActorFleet(make_actor, buffer, n)
+
+
 def _choose_mesh(config: Config):
   """Mesh over all local devices when the batch can shard; None means
   plain single-device jit (the reference's single-machine mode)."""
@@ -202,6 +230,23 @@ def train(config: Config, max_steps: Optional[int] = None,
   # dispatch pipeline each step).
   _initial_steps = int(jax.device_get(state.update_steps))
 
+  # --- Trajectory buffer + remote ingest, BEFORE inference warmup:
+  # remote actor hosts connect and fetch params while this host spends
+  # its 20–40 s compiling, instead of timing out against a closed port
+  # (reference's learner-hosted shared FIFOQueue that remote actors
+  # enqueue into, ≈L470/SURVEY §3.4 — remote unrolls land in the SAME
+  # buffer as the local fleet's, so downstream is source-oblivious). ---
+  capacity = max(config.queue_capacity_batches * config.batch_size,
+                 config.batch_size)
+  buffer = ring_buffer.TrajectoryBuffer(capacity)
+  ingest = None
+  if config.remote_actor_port:
+    from scalable_agent_tpu.runtime import remote
+    ingest = remote.TrajectoryIngestServer(
+        buffer, jax.device_get(state.params),
+        port=config.remote_actor_port)
+    log.info('remote-actor ingest listening on port %d', ingest.port)
+
   # --- Inference server (weights served host-side to actor threads). ---
   # Per-process seed offset: params/init use config.seed IDENTICALLY on
   # every host (multi-host device_put asserts equality), while env and
@@ -216,24 +261,8 @@ def train(config: Config, max_steps: Optional[int] = None,
   # compile (the reference's TF graph had dynamic batch dims).
   server.warmup(spec0.obs_spec, max_size=config.num_actors)
 
-  # --- Actor fleet over the trajectory buffer. ---
-  capacity = max(config.queue_capacity_batches * config.batch_size,
-                 config.batch_size)
-  buffer = ring_buffer.TrajectoryBuffer(capacity)
-
-  def make_actor(i):
-    level = levels[i % len(levels)]
-    spec = factory.make_env_spec(config, level,
-                                 seed=process_seed_base + i + 1)
-    env, process = factory.build_environment(
-        spec, use_py_process=config.use_py_process)
-    actor = Actor(env, server.policy, agent.initial_state(1),
-                  unroll_length=config.unroll_length,
-                  num_action_repeats=config.num_action_repeats,
-                  level_name_id=i % len(levels))
-    return env, process, actor
-
-  fleet = ActorFleet(make_actor, buffer, config.num_actors)
+  fleet = make_fleet(config, agent, server.policy, buffer, levels,
+                     seed_base=process_seed_base)
 
   def stage(host_batch):
     """Prefetcher stage: peel off a tiny host-side stats view (done /
@@ -272,12 +301,14 @@ def train(config: Config, max_steps: Optional[int] = None,
   fps_meter = observability.FpsMeter()
   run = TrainRun(config, agent, state, fleet, prefetcher, server,
                  checkpointer, writer, stats, fps_meter)
+  run.ingest = ingest
 
   fleet.start()
   steps_done = 0
   profiling = False
   errors: List[BaseException] = []
   action_counts_acc = np.zeros((num_actions,), np.int64)
+  last_remote_publish = float('-inf')
   last_inference_snap = {'calls': 0, 'requests': 0}
   last_summary = time.monotonic()
   last_batch_time = time.monotonic()
@@ -344,6 +375,18 @@ def train(config: Config, max_steps: Optional[int] = None,
 
       if steps_done % config.publish_params_every == 0:
         server.update_params(state.params)
+        if (ingest is not None and
+            time.monotonic() - last_remote_publish >=
+            config.remote_publish_secs and
+            ingest.stats()['live'] > 0):
+          # Remote hosts poll-on-ack: publishing bumps the version the
+          # next ack reports (the reference's per-run gRPC weight
+          # fetch, as an explicit snapshot). Unlike the local pointer
+          # swap above, this is a blocking device_get of the whole
+          # param tree — hence the wall-clock throttle and the
+          # nobody-connected gate.
+          last_remote_publish = time.monotonic()
+          ingest.publish_params(jax.device_get(state.params))
 
       now = time.monotonic()
       if now - last_summary >= config.summary_secs:
@@ -375,6 +418,11 @@ def train(config: Config, max_steps: Optional[int] = None,
         # late policy collapse).
         writer.histogram('actions', action_counts_acc, step_now)
         action_counts_acc = np.zeros_like(action_counts_acc)
+        if ingest is not None:
+          ing = ingest.stats()
+          writer.scalar('remote_unrolls', ing['unrolls'], step_now)
+          writer.scalar('remote_connections', ing['connections'],
+                        step_now)
       # Checkpoint cadence: Orbax saves are collective across hosts;
       # clocks differ, so all hosts act on PROCESS 0's decision (a
       # host-local clock here would desync the barrier and deadlock).
@@ -402,6 +450,8 @@ def train(config: Config, max_steps: Optional[int] = None,
     fleet.stop()
     prefetcher.close()
     server.close()
+    if ingest is not None:
+      ingest.close()
     try:
       # The final save is a COLLECTIVE. On a clean exit every host
       # reaches it in lockstep (termination is a deterministic
@@ -467,18 +517,9 @@ def evaluate(config: Config,
   buffer = ring_buffer.TrajectoryBuffer(
       max(2 * len(test_levels), 2))
 
-  def make_actor(i):
-    spec = factory.make_env_spec(config, test_levels[i],
-                                 seed=config.seed + i, is_test=True)
-    env, process = factory.build_environment(
-        spec, use_py_process=config.use_py_process)
-    actor = Actor(env, server.policy, agent.initial_state(1),
-                  unroll_length=config.unroll_length,
-                  num_action_repeats=config.num_action_repeats,
-                  level_name_id=i)
-    return env, process, actor
-
-  fleet = ActorFleet(make_actor, buffer, len(test_levels))
+  fleet = make_fleet(config, agent, server.policy, buffer, test_levels,
+                     seed_base=config.seed - 1, is_test=True,
+                     num_actors=len(test_levels))
   level_returns: Dict[str, List[float]] = {
       name: [] for name in train_levels}
 

@@ -1,0 +1,364 @@
+"""Remote actors: actor-only hosts feeding a learner over TCP.
+
+The reference runs dedicated actor processes/machines against the
+learner through the TF1 gRPC runtime: actors hold their own env +
+inference graph, fetch the learner-pinned weights per run, and their
+`queue.enqueue` is a remote op into the learner-hosted FIFOQueue
+(reference: experiment.py ≈L435–460 ClusterSpec/Server wiring, ≈L625
+actor loop; SURVEY §3.4 — paper configs used 150–500 actor CPUs per
+learner). A TPU host cannot step enough DMLab envs by itself to feed
+200k frames/sec, so this scale-out path is load-bearing for the north
+star.
+
+TPU-native re-design (SURVEY §5.8 "shared memory / RPC to actor
+processes"):
+
+- The learner host runs a `TrajectoryIngestServer` next to its
+  `TrajectoryBuffer`: remote unrolls land in the SAME buffer the local
+  fleet feeds, so the learner pipeline (batcher → prefetcher → sharded
+  step) is oblivious to where trajectories come from.
+- Each actor-only host runs `run_remote_actor()`: a normal `ActorFleet`
+  + CPU `InferenceServer` (inference on the actor host, exactly like
+  the reference's distributed mode — NOT request/response inference
+  against the learner), a local buffer, and a pump thread that ships
+  unrolls to the learner and pulls fresh params when the learner's
+  version advances.
+- Weights flow learner → actor piggybacked on the unroll acks: each ack
+  carries the learner's current params version; a stale client fetches
+  the new snapshot. This is the gRPC variable-read replaced by an
+  explicit snapshot protocol, with the same staleness story (actions
+  within one unroll may span weight versions).
+
+Wire protocol: length-prefixed pickled messages over one TCP connection
+per actor process, strict request→reply lockstep (no concurrent writes
+per socket). Backpressure is end-to-end: a full learner buffer blocks
+the server's `put`, which delays the ack, which blocks the actor's pump
+— the reference's capacity-1 remote enqueue semantics.
+
+Trust model: pickle over cluster-internal sockets — identical trust to
+the reference's unauthenticated TF gRPC runtime. Never expose the
+ingest port outside the job's network.
+"""
+
+import logging
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from scalable_agent_tpu.runtime import ring_buffer
+
+log = logging.getLogger('scalable_agent_tpu')
+
+_LEN = struct.Struct('>Q')
+_MAX_MSG = 1 << 32  # 4 GiB sanity bound
+# Remote-actor seed namespace: far above any learner host's
+# process_index * max(num_actors, 1000) base (a 16M+ learner stride
+# would need thousands of processes), so cross-role streams never
+# collide.
+_REMOTE_SEED_SPACE = 1 << 24
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+  payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+  sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+  buf = bytearray()
+  while len(buf) < n:
+    chunk = sock.recv(n - len(buf))
+    if not chunk:
+      return None  # clean EOF
+    buf.extend(chunk)
+  return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket):
+  """One message, or None on clean EOF."""
+  header = _recv_exact(sock, _LEN.size)
+  if header is None:
+    return None
+  (length,) = _LEN.unpack(header)
+  if length > _MAX_MSG:
+    raise ValueError(f'message length {length} exceeds bound')
+  payload = _recv_exact(sock, length)
+  if payload is None:
+    raise ConnectionError('EOF mid-message')
+  return pickle.loads(payload)
+
+
+class TrajectoryIngestServer:
+  """Learner-side: accepts remote-actor connections, lands their
+  unrolls in the shared TrajectoryBuffer, serves param snapshots.
+
+  Args:
+    buffer: the learner's TrajectoryBuffer (shared with the local
+      fleet).
+    params: initial host (numpy) param pytree; version 1.
+    host/port: bind address; port 0 picks a free port (see `.port`).
+  """
+
+  def __init__(self, buffer, params, host: str = '0.0.0.0',
+               port: int = 0):
+    self._buffer = buffer
+    self._params_lock = threading.Lock()
+    self._params = params
+    self._version = 1
+    self._stats_lock = threading.Lock()
+    self._unrolls = 0
+    self._connections = 0
+    self._closed = threading.Event()
+    # Threads/conns are appended by the accept loop, pruned as peers
+    # disconnect, snapshotted by close() — all under one lock (flapping
+    # actor hosts over a long run must not accumulate dead entries).
+    self._threads: List[threading.Thread] = []
+    self._conns: List[socket.socket] = []
+    self._conns_lock = threading.Lock()
+    self._listener = socket.create_server((host, port))
+    self.port = self._listener.getsockname()[1]
+    self._accept_thread = threading.Thread(
+        target=self._accept_loop, name='ingest-accept', daemon=True)
+    self._accept_thread.start()
+
+  def publish_params(self, params) -> int:
+    """Swap in a new host param snapshot; returns the new version.
+    Call with numpy trees (device_get first) — snapshots are pickled
+    on handler threads."""
+    with self._params_lock:
+      self._params = params
+      self._version += 1
+      return self._version
+
+  def stats(self):
+    with self._conns_lock:
+      live = len(self._conns)
+    with self._stats_lock:
+      return {'unrolls': self._unrolls,
+              'connections': self._connections,  # cumulative
+              'live': live}
+
+  def _accept_loop(self):
+    while not self._closed.is_set():
+      try:
+        conn, addr = self._listener.accept()
+      except OSError:
+        return  # listener closed
+      conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+      t = threading.Thread(target=self._serve, args=(conn, addr),
+                           name=f'ingest-{addr}', daemon=True)
+      with self._conns_lock:
+        if self._closed.is_set():
+          conn.close()
+          return
+        self._conns.append(conn)
+        self._threads = [x for x in self._threads if x.is_alive()]
+        self._threads.append(t)
+      with self._stats_lock:
+        self._connections += 1
+      t.start()
+
+  def _snapshot(self):
+    with self._params_lock:
+      return self._version, self._params
+
+  def _serve(self, conn: socket.socket, addr):
+    log.info('remote actor connected from %s', addr)
+    try:
+      while not self._closed.is_set():
+        msg = _recv_msg(conn)
+        if msg is None:
+          return  # client went away
+        kind = msg[0]
+        if kind in ('hello', 'get_params'):
+          version, params = self._snapshot()
+          _send_msg(conn, ('params', version, params))
+        elif kind == 'unroll':
+          # Blocking put IS the backpressure: the delayed ack holds the
+          # remote pump exactly like the reference's remote enqueue
+          # into the capacity-1 queue. Poll so close() can interrupt.
+          while True:
+            try:
+              self._buffer.put(msg[1], timeout=1.0)
+              break
+            except TimeoutError:
+              if self._closed.is_set():
+                return
+          with self._stats_lock:
+            self._unrolls += 1
+          with self._params_lock:
+            version = self._version
+          _send_msg(conn, ('ack', version))
+        else:
+          _send_msg(conn, ('error', f'unknown message kind {kind!r}'))
+    except ring_buffer.Closed:
+      pass  # learner shut down; dropping the conn tells the actor
+    except (ConnectionError, OSError) as e:
+      if not self._closed.is_set():
+        log.warning('remote actor %s dropped: %s', addr, e)
+    finally:
+      conn.close()
+      with self._conns_lock:
+        if conn in self._conns:
+          self._conns.remove(conn)
+      log.info('remote actor %s disconnected', addr)
+
+  def close(self):
+    self._closed.set()
+    try:
+      self._listener.close()
+    except OSError:
+      pass
+    with self._conns_lock:
+      conns = list(self._conns)
+      threads = list(self._threads)
+    for conn in conns:
+      try:
+        conn.shutdown(socket.SHUT_RDWR)
+      except OSError:
+        pass
+      conn.close()
+    for t in threads:
+      t.join(timeout=2.0)
+    self._accept_thread.join(timeout=2.0)
+
+
+class RemoteActorClient:
+  """Actor-side connection to the learner's ingest server.
+
+  Strict request→reply; NOT thread-safe — one pump thread owns it.
+  """
+
+  def __init__(self, address: str, connect_timeout_secs: float = 60.0):
+    host, port = address.rsplit(':', 1)
+    deadline = time.monotonic() + connect_timeout_secs
+    last_err = None
+    while True:
+      try:
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=10.0)
+        break
+      except OSError as e:  # learner may not be up yet: retry
+        last_err = e
+        if time.monotonic() > deadline:
+          raise ConnectionError(
+              f'could not reach learner at {address}: {e}') from e
+        time.sleep(0.3)
+    self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    self._sock.settimeout(None)
+    log.info('connected to learner at %s (after %s)', address, last_err)
+
+  def _rpc(self, msg):
+    _send_msg(self._sock, msg)
+    reply = _recv_msg(self._sock)
+    if reply is None:
+      raise ConnectionError('learner closed the connection')
+    if reply[0] == 'error':
+      raise RuntimeError(f'learner rejected request: {reply[1]}')
+    return reply
+
+  def fetch_params(self) -> Tuple[int, object]:
+    """(version, host param pytree) — the current learner snapshot."""
+    reply = self._rpc(('get_params',))
+    return reply[1], reply[2]
+
+  def send_unroll(self, unroll) -> int:
+    """Ship one ActorOutput; returns the learner's params version."""
+    reply = self._rpc(('unroll', unroll))
+    return reply[1]
+
+  def close(self):
+    try:
+      self._sock.close()
+    except OSError:
+      pass
+
+
+def run_remote_actor(config, learner_address: str, task: int = 0,
+                     stop_after_unrolls: Optional[int] = None,
+                     platform: Optional[str] = 'cpu',
+                     connect_timeout_secs: float = 120.0) -> int:
+  """Actor-only host main loop (reference --job_name=actor --task=N).
+
+  Builds a CPU inference server + actor fleet against params fetched
+  from the learner, pumps unrolls to the learner's ingest server, and
+  refreshes params whenever an ack reports a newer version. Returns the
+  number of unrolls shipped. Runs until the learner closes the
+  connection (normal end of training) or `stop_after_unrolls`.
+
+  Args:
+    config: the SAME Config the learner runs with (env/model knobs must
+      agree — the reference shares one flag set across jobs too).
+    learner_address: host:port of the learner's ingest server.
+    task: this actor host's index; offsets env seeds so hosts explore
+      independently (reference --task).
+    stop_after_unrolls: optional unroll budget (tests).
+    platform: force this jax platform BEFORE first jax use ('cpu' for
+      actor hosts — they have no accelerator; None = leave as-is).
+  """
+  if platform:
+    import jax
+    jax.config.update('jax_platforms', platform)
+
+  from scalable_agent_tpu import driver as driver_lib
+  from scalable_agent_tpu.envs import factory
+  from scalable_agent_tpu.runtime.inference import InferenceServer
+
+  levels = factory.level_names(config)
+  spec0 = factory.make_env_spec(config, levels[0], seed=1)
+  agent = driver_lib.build_agent(config, spec0.num_actions,
+                                 num_tasks=len(levels))
+
+  client = RemoteActorClient(learner_address,
+                             connect_timeout_secs=connect_timeout_secs)
+  unrolls_sent = 0
+  try:
+    version, params = client.fetch_params()
+    log.info('remote actor task=%d got params v%d', task, version)
+
+    # Seed space DISJOINT from the learner hosts' (driver.train uses
+    # process_index * max(num_actors, 1000) for env streams and
+    # config.seed + 1000/2000 + base for sampling): a mixed topology
+    # (local fleet + remote hosts) must not run bit-identical RNG
+    # streams in the same training batch.
+    seed_base = _REMOTE_SEED_SPACE + task * max(config.num_actors, 1000)
+    server = InferenceServer(agent, params, config,
+                             seed=config.seed + seed_base)
+    server.warmup(spec0.obs_spec, max_size=config.num_actors)
+    buffer = ring_buffer.TrajectoryBuffer(
+        max(2 * config.num_actors, 2))
+    fleet = driver_lib.make_fleet(
+        config, agent, server.policy, buffer, levels,
+        seed_base=seed_base, level_offset=task * config.num_actors)
+    fleet.start()
+    try:
+      while (stop_after_unrolls is None or
+             unrolls_sent < stop_after_unrolls):
+        try:
+          unroll = buffer.get(timeout=10.0)
+        except TimeoutError:
+          fleet.check_health(stall_timeout_secs=300.0)
+          errors = fleet.errors()
+          if errors:
+            raise errors[0]
+          continue
+        ack_version = client.send_unroll(unroll)
+        unrolls_sent += 1
+        if ack_version > version:
+          version, params = client.fetch_params()
+          server.update_params(params)
+          log.info('remote actor task=%d refreshed params to v%d',
+                   task, version)
+    except (ConnectionError, ring_buffer.Closed):
+      # Learner ended training (or died): either way this host is done.
+      log.info('learner connection closed; remote actor exiting')
+    finally:
+      fleet.stop()
+      server.close()
+  finally:
+    client.close()
+  log.info('remote actor task=%d shipped %d unrolls', task,
+           unrolls_sent)
+  return unrolls_sent

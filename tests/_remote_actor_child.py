@@ -1,0 +1,24 @@
+"""Subprocess body for the remote-actor tests: an actor-only host with
+NO accelerator (jax platform forced to cpu before first use) builds an
+env fleet + CPU inference and streams unrolls to the learner's ingest
+server. Run: python _remote_actor_child.py <host:port> <config-json>.
+"""
+
+import json
+import sys
+
+
+def main():
+  address = sys.argv[1]
+  overrides = json.loads(sys.argv[2])
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.runtime import remote
+  cfg = Config(**overrides)
+  sent = remote.run_remote_actor(cfg, address, task=0,
+                                 stop_after_unrolls=500,
+                                 platform='cpu')
+  print(f'CHILD_OK {sent}', flush=True)
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,166 @@
+"""Remote-actor tests (SURVEY §3.4 / VERDICT r1 Missing #2).
+
+The reference's distributed actor topology — dedicated actor machines
+streaming unrolls into the learner-hosted queue over gRPC — is tested
+here at protocol level (in-process server+client) and end-to-end: a
+SEPARATE OS process with no accelerator (cpu-forced jax) feeds a real
+training learner through the TCP ingest path. Upstream never tests its
+distributed mode at all (SURVEY §4).
+"""
+
+import dataclasses
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.runtime import remote, ring_buffer
+from scalable_agent_tpu.structs import (
+    ActorOutput, AgentOutput, StepOutput, StepOutputInfo)
+
+
+def _tiny_unroll(seed=0, t1=3, num_actions=3):
+  rng = np.random.RandomState(seed)
+  return ActorOutput(
+      level_name=np.int32(0),
+      agent_state=(np.zeros((1, 4), np.float32),
+                   np.ones((1, 4), np.float32)),
+      env_outputs=StepOutput(
+          reward=rng.randn(t1).astype(np.float32),
+          info=StepOutputInfo(np.zeros(t1, np.float32),
+                              np.zeros(t1, np.int32)),
+          done=np.zeros(t1, bool),
+          observation=(
+              rng.randint(0, 255, (t1, 4, 6, 3)).astype(np.uint8),
+              np.zeros((t1, 5), np.int32))),
+      agent_outputs=AgentOutput(
+          action=rng.randint(0, num_actions, t1).astype(np.int32),
+          policy_logits=rng.randn(t1, num_actions).astype(np.float32),
+          baseline=rng.randn(t1).astype(np.float32)))
+
+
+def _assert_trees_equal(a, b):
+  import jax
+  la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+  assert len(la) == len(lb)
+  for x, y in zip(la, lb):
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_ingest_protocol_roundtrip():
+  """Unrolls land bit-identical in the learner buffer; params flow back
+  with version bumps piggybacked on the acks."""
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  params_v1 = {'w': np.arange(6.0).reshape(2, 3)}
+  server = remote.TrajectoryIngestServer(buffer, params_v1,
+                                         host='127.0.0.1')
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    version, got = client.fetch_params()
+    assert version == 1
+    _assert_trees_equal(got, params_v1)
+
+    unroll = _tiny_unroll(7)
+    assert client.send_unroll(unroll) == 1
+    landed = buffer.get(timeout=5)
+    _assert_trees_equal(landed, unroll)
+
+    params_v2 = {'w': np.full((2, 3), 9.0)}
+    assert server.publish_params(params_v2) == 2
+    assert client.send_unroll(_tiny_unroll(8)) == 2  # ack reports bump
+    version, got = client.fetch_params()
+    assert version == 2
+    _assert_trees_equal(got, params_v2)
+    assert server.stats()['unrolls'] == 2
+    assert server.stats()['connections'] == 1
+  finally:
+    client.close()
+    server.close()
+  buffer.close()
+
+
+def test_ingest_backpressure_blocks_ack():
+  """A full learner buffer must delay the ack — the end-to-end
+  backpressure that bounds policy lag (reference capacity-1 remote
+  enqueue)."""
+  buffer = ring_buffer.TrajectoryBuffer(1)
+  server = remote.TrajectoryIngestServer(buffer, {'w': np.zeros(1)},
+                                         host='127.0.0.1')
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  done = threading.Event()
+
+  def pump():
+    client.send_unroll(_tiny_unroll(1))
+    client.send_unroll(_tiny_unroll(2))  # blocks: buffer full
+    done.set()
+
+  t = threading.Thread(target=pump, daemon=True)
+  try:
+    t.start()
+    assert not done.wait(0.6)  # second unroll is being held back
+    buffer.get(timeout=5)      # drain one slot
+    assert done.wait(10)       # ...and the ack goes through
+    buffer.get(timeout=5)
+  finally:
+    client.close()
+    server.close()
+    t.join(timeout=5)
+  buffer.close()
+
+
+def test_remote_actor_feeds_training(tmp_path):
+  """The VERDICT bar: a separate OS process with no accelerator runs
+  the actor role end-to-end (envs → CPU inference → TCP) and a real
+  learner trains exclusively on its unrolls (num_actors=0 locally)."""
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu.config import Config
+
+  with socket.create_server(('127.0.0.1', 0)) as s:
+    port = s.getsockname()[1]
+
+  base = dict(
+      env_backend='bandit', batch_size=2, unroll_length=5,
+      num_action_repeats=1, episode_length=4, height=24, width=32,
+      torso='shallow', use_py_process=False, use_instruction=False,
+      total_environment_frames=10**6, inference_timeout_ms=5,
+      checkpoint_secs=0, summary_secs=0, seed=11)
+  learner_cfg = Config(logdir=str(tmp_path), num_actors=0,
+                       remote_actor_port=port, **base)
+  child_overrides = dict(base, num_actors=2)
+
+  repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  env = dict(os.environ)
+  env.pop('XLA_FLAGS', None)  # child provisions nothing special
+  # Script-run children resolve sys.path from the script dir, not cwd.
+  existing = env.get('PYTHONPATH', '')
+  env['PYTHONPATH'] = (repo + os.pathsep + existing if existing
+                       else repo)
+  child = subprocess.Popen(
+      [sys.executable, os.path.join(repo, 'tests',
+                                    '_remote_actor_child.py'),
+       f'127.0.0.1:{port}', json.dumps(child_overrides)],
+      cwd=repo, env=env, stdout=subprocess.PIPE,
+      stderr=subprocess.STDOUT, text=True)
+  try:
+    run = driver.train(learner_cfg, max_steps=3,
+                       stall_timeout_secs=120)
+    assert int(run.state.update_steps) == 3
+    # Every consumed trajectory came over the wire.
+    assert run.ingest is not None
+    assert run.ingest.stats()['unrolls'] >= 3 * learner_cfg.batch_size
+    assert run.fleet.stats()['unrolls'] == 0
+    out, _ = child.communicate(timeout=120)
+    assert child.returncode == 0, out[-2000:]
+    assert 'CHILD_OK' in out, out[-2000:]
+  finally:
+    if child.poll() is None:
+      child.kill()
+      child.communicate()
