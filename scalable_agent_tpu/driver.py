@@ -142,7 +142,7 @@ class TrainRun:
   """All live objects of a training run (for inspection/tests)."""
 
   def __init__(self, config, agent, state, fleet, prefetcher, server,
-               checkpointer, writer, stats, fps_meter):
+               checkpointer, writer, stats, fps_meter, ingest=None):
     self.config = config
     self.agent = agent
     self.state = state
@@ -153,6 +153,7 @@ class TrainRun:
     self.writer = writer
     self.stats = stats
     self.fps_meter = fps_meter
+    self.ingest = ingest
 
   @property
   def frames(self) -> int:
@@ -161,8 +162,10 @@ class TrainRun:
 
 
 def train(config: Config, max_steps: Optional[int] = None,
-          stall_timeout_secs: Optional[float] = None) -> TrainRun:
-  """Run IMPALA training until total_environment_frames (or max_steps).
+          stall_timeout_secs: Optional[float] = None,
+          max_seconds: Optional[float] = None) -> TrainRun:
+  """Run IMPALA training until total_environment_frames (or max_steps
+  / max_seconds — timed smoke and bench runs).
 
   Returns the TrainRun with the final state (all machinery shut down).
   """
@@ -247,61 +250,76 @@ def train(config: Config, max_steps: Optional[int] = None,
         port=config.remote_actor_port)
     log.info('remote-actor ingest listening on port %d', ingest.port)
 
-  # --- Inference server (weights served host-side to actor threads). ---
-  # Per-process seed offset: params/init use config.seed IDENTICALLY on
-  # every host (multi-host device_put asserts equality), while env and
-  # action-sampling streams must NOT repeat across hosts.
-  process_index = jax.process_index()
-  process_seed_base = process_index * max(config.num_actors, 1000)
-  server = InferenceServer(agent, state.params, config,
-                           seed=config.seed + 1000 + process_seed_base)
-  server.update_params(state.params)
-  # Pre-compile inference buckets up to the fleet size: a bucket's
-  # first appearance otherwise stalls every parked actor for the TPU
-  # compile (the reference's TF graph had dynamic batch dims).
-  server.warmup(spec0.obs_spec, max_size=config.num_actors)
+  # Setup from here to the main loop's try/finally can raise (env
+  # construction, 20–40 s inference compiles): the already-listening
+  # ingest must not outlive a failed train() — a bound zombie port
+  # serving stale v1 params would break retries in the same process.
+  try:
+    # --- Inference server (weights served host-side to actor
+    # threads). Per-process seed offset: params/init use config.seed
+    # IDENTICALLY on every host (multi-host device_put asserts
+    # equality), while env and action-sampling streams must NOT repeat
+    # across hosts. ---
+    process_index = jax.process_index()
+    process_seed_base = process_index * max(config.num_actors, 1000)
+    server = InferenceServer(agent, state.params, config,
+                             seed=config.seed + 1000 + process_seed_base)
+    server.update_params(state.params)
+    # Pre-compile inference buckets up to the fleet size: a bucket's
+    # first appearance otherwise stalls every parked actor for the TPU
+    # compile (the reference's TF graph had dynamic batch dims).
+    server.warmup(spec0.obs_spec, max_size=config.num_actors)
 
-  fleet = make_fleet(config, agent, server.policy, buffer, levels,
-                     seed_base=process_seed_base)
+    fleet = make_fleet(config, agent, server.policy, buffer, levels,
+                       seed_base=process_seed_base)
 
-  def stage(host_batch):
-    """Prefetcher stage: peel off a tiny host-side stats view (done /
-    info / level ids / action counts — the batch is host numpy right
-    here) BEFORE the device transfer, so the train loop never
-    device_gets frames just to read episode stats."""
-    stats_view = _stats_only_view(
-        np.asarray(host_batch.level_name),
-        jax.tree_util.tree_map(np.asarray, host_batch.env_outputs.info),
-        np.asarray(host_batch.env_outputs.done))
-    # Action histogram source (reference build_learner's
-    # tf.summary.histogram, ≈L395): bincount of the trained-on actions
-    # ([1:] drops the overlap row, like the loss shift).
-    action_counts = np.bincount(
-        np.asarray(host_batch.agent_outputs.action)[1:].ravel(),
-        minlength=num_actions)
-    return stats_view, action_counts, place_fn(host_batch)
+    def stage(host_batch):
+      """Prefetcher stage: peel off a tiny host-side stats view (done /
+      info / level ids / action counts — the batch is host numpy right
+      here) BEFORE the device transfer, so the train loop never
+      device_gets frames just to read episode stats."""
+      stats_view = _stats_only_view(
+          np.asarray(host_batch.level_name),
+          jax.tree_util.tree_map(np.asarray,
+                                 host_batch.env_outputs.info),
+          np.asarray(host_batch.env_outputs.done))
+      # Action histogram source (reference build_learner's
+      # tf.summary.histogram, ≈L395): bincount of the trained-on
+      # actions ([1:] drops the overlap row, like the loss shift).
+      action_counts = np.bincount(
+          np.asarray(host_batch.agent_outputs.action)[1:].ravel(),
+          minlength=num_actions)
+      return stats_view, action_counts, place_fn(host_batch)
 
-  prefetcher = ring_buffer.BatchPrefetcher(
-      buffer, local_batch_size, place_fn=stage)
+    prefetcher = ring_buffer.BatchPrefetcher(
+        buffer, local_batch_size, place_fn=stage)
 
-  # Multi-host: every host logs its OWN fleet's stream; process 0 keeps
-  # the canonical filename (shared logdirs must not interleave writers).
-  summary_name = ('summaries.jsonl' if process_index == 0
-                  else f'summaries_p{process_index}.jsonl')
-  writer = observability.SummaryWriter(config.logdir,
-                                       filename=summary_name)
-  # Reproducibility: the exact config of every run lives next to its
-  # checkpoints/summaries (the reference leaves flags only in shell
-  # history).
-  if process_index == 0:
-    with open(os.path.join(config.logdir, 'config.json'), 'w') as f:
-      json.dump(dataclasses.asdict(config), f, indent=2, sort_keys=True)
-  stats = observability.EpisodeStats(
-      levels, multi_task=(config.level_name == 'dmlab30'), writer=writer)
-  fps_meter = observability.FpsMeter()
-  run = TrainRun(config, agent, state, fleet, prefetcher, server,
-                 checkpointer, writer, stats, fps_meter)
-  run.ingest = ingest
+    # Multi-host: every host logs its OWN fleet's stream; process 0
+    # keeps the canonical filename (shared logdirs must not interleave
+    # writers).
+    summary_name = ('summaries.jsonl' if process_index == 0
+                    else f'summaries_p{process_index}.jsonl')
+    writer = observability.SummaryWriter(config.logdir,
+                                         filename=summary_name)
+    # Reproducibility: the exact config of every run lives next to its
+    # checkpoints/summaries (the reference leaves flags only in shell
+    # history).
+    if process_index == 0:
+      with open(os.path.join(config.logdir, 'config.json'), 'w') as f:
+        json.dump(dataclasses.asdict(config), f, indent=2,
+                  sort_keys=True)
+    stats = observability.EpisodeStats(
+        levels, multi_task=(config.level_name == 'dmlab30'),
+        writer=writer)
+    fps_meter = observability.FpsMeter()
+    run = TrainRun(config, agent, state, fleet, prefetcher, server,
+                   checkpointer, writer, stats, fps_meter,
+                   ingest=ingest)
+  except BaseException:
+    buffer.close()
+    if ingest is not None:
+      ingest.close()
+    raise
 
   fleet.start()
   steps_done = 0
@@ -310,6 +328,7 @@ def train(config: Config, max_steps: Optional[int] = None,
   action_counts_acc = np.zeros((num_actions,), np.int64)
   last_remote_publish = float('-inf')
   last_inference_snap = {'calls': 0, 'requests': 0}
+  loop_start = time.monotonic()
   last_summary = time.monotonic()
   last_batch_time = time.monotonic()
   poll_secs = 10.0 if stall_timeout_secs is None else min(
@@ -320,6 +339,9 @@ def train(config: Config, max_steps: Optional[int] = None,
       if frames >= config.total_environment_frames:
         break
       if max_steps is not None and steps_done >= max_steps:
+        break
+      if (max_seconds is not None and
+          time.monotonic() - loop_start > max_seconds):
         break
       try:
         stats_view, action_counts, batch_device = prefetcher.get(
@@ -399,6 +421,10 @@ def train(config: Config, max_steps: Optional[int] = None,
         writer.scalar('actors_alive', fleet_stats['alive'], step_now)
         writer.scalar('actor_respawns', fleet_stats['respawns'],
                       step_now)
+        # Buffer occupancy: ~0 means the learner is starved (env/
+        # inference bound); ~capacity means actors are throttled by
+        # backpressure (learner bound).
+        writer.scalar('buffer_unrolls', len(buffer), step_now)
         # Merge telemetry over THIS summary interval (a cumulative
         # mean would hide regressions late in a long run): ≈1 means
         # the batcher is not merging — the single-machine throughput
