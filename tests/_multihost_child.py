@@ -1,9 +1,16 @@
-"""Child process for the two-process multi-host driver test.
+"""Child process for the two-process multi-host driver tests.
 
 Each process joins jax.distributed (2 procs × 2 virtual CPU devices =
-a 4-way data mesh), runs the REAL driver.train against its own actor
-fleet, and exits 0 on success. Run by test_multihost.py — not collected
-by pytest itself.
+a 4-way data mesh) and runs the REAL driver.train. Run by
+test_multihost.py — not collected by pytest itself. Modes (argv[4],
+default 'run'):
+
+- run:    3 steps, assert, exit 0 (the original two-process test).
+- drill:  train indefinitely with frequent collective checkpoints —
+          the failure-drill phase 1 body; the parent SIGKILLs one
+          process and watches the other terminate.
+- resume N: restore from the drill's checkpoints (expect step N), run
+          2 more steps, exit 0 — the failure-drill phase 2 body.
 """
 
 import os
@@ -14,6 +21,7 @@ def main():
   proc = int(sys.argv[1])
   port = sys.argv[2]
   logdir = sys.argv[3]
+  mode = sys.argv[4] if len(sys.argv) > 4 else 'run'
   os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=2'
   import jax
   jax.config.update('jax_platforms', 'cpu')
@@ -28,15 +36,32 @@ def main():
       num_actors=2, batch_size=4,          # GLOBAL batch; 2 per host
       unroll_length=5, num_action_repeats=1, episode_length=4,
       height=24, width=32, torso='shallow', use_py_process=False,
-      use_instruction=False, total_environment_frames=10**6,
+      use_instruction=False, total_environment_frames=10**9,
       inference_timeout_ms=5, checkpoint_secs=0, summary_secs=0,
       # Same seed on every process: model init must be IDENTICAL
       # across hosts (the driver diversifies env/sampling streams by
       # process internally).
       seed=3)
-  run = driver.train(cfg, max_steps=3, stall_timeout_secs=120)
-  assert int(run.state.update_steps) == 3, run.state.update_steps
-  print(f'child {proc}: ok', flush=True)
+
+  if mode == 'run':
+    run = driver.train(cfg, max_steps=3, stall_timeout_secs=120)
+    assert int(run.state.update_steps) == 3, run.state.update_steps
+    print(f'child {proc}: ok', flush=True)
+  elif mode == 'drill':
+    # Frequent collective checkpoints; runs until the parent kills this
+    # process or the runtime aborts us because the peer died.
+    cfg.checkpoint_check_every_steps = 2
+    driver.train(cfg, stall_timeout_secs=120)
+    print(f'child {proc}: train returned unexpectedly', flush=True)
+  elif mode == 'resume':
+    expect = int(sys.argv[5])
+    run = driver.train(cfg, max_steps=2, stall_timeout_secs=120)
+    got = int(run.state.update_steps)
+    assert got == expect + 2, (got, expect)
+    print(f'child {proc}: resumed from {expect} to {got} ok',
+          flush=True)
+  else:
+    raise ValueError(mode)
 
 
 if __name__ == '__main__':

@@ -13,6 +13,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 def _free_port():
   s = socket.socket()
@@ -22,30 +23,42 @@ def _free_port():
   return port
 
 
-def test_two_process_training(tmp_path):
-  # Bounded by the children's communicate(timeout=280) below.
+def _spawn_children(logdir, port, extra_args=()):
   child = os.path.join(os.path.dirname(__file__), '_multihost_child.py')
-  port = str(_free_port())
-  logdir = str(tmp_path)
   repo_root = os.path.dirname(os.path.dirname(child))
   env = {k: v for k, v in os.environ.items()
          if k not in ('XLA_FLAGS', 'JAX_PLATFORMS')}
-  # Children run a script by path, so the package root must be on
-  # PYTHONPATH (they pin the CPU backend, so the axon plugin's
-  # PYTHONPATH sensitivity doesn't apply).
   existing = os.environ.get('PYTHONPATH', '')
   env['PYTHONPATH'] = (repo_root + os.pathsep + existing if existing
                        else repo_root)
-  procs = [
-      subprocess.Popen([sys.executable, child, str(i), port, logdir],
-                       stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                       env=env, cwd=repo_root)
+  return [
+      subprocess.Popen(
+          [sys.executable, child, str(i), str(port), logdir,
+           *extra_args],
+          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+          env=env, cwd=repo_root, text=True)
       for i in range(2)]
+
+
+def _committed_steps(logdir):
+  ckdir = os.path.join(logdir, 'checkpoints')
+  if not os.path.isdir(ckdir):
+    return []
+  return sorted(
+      int(d) for d in os.listdir(ckdir)
+      if d.isdigit() and os.path.exists(
+          os.path.join(ckdir, d, '_CHECKPOINT_METADATA')))
+
+
+def test_two_process_training(tmp_path):
+  # Bounded by the children's communicate(timeout=280) below.
+  logdir = str(tmp_path)
+  procs = _spawn_children(logdir, _free_port())
   outs = []
   try:
     for p in procs:
       out, _ = p.communicate(timeout=280)
-      outs.append(out.decode())
+      outs.append(out)
   finally:
     # A child hung in a collective (e.g. its peer died) must not be
     # orphaned holding CPU and the distributed port.
@@ -63,3 +76,68 @@ def test_two_process_training(tmp_path):
   # The collective final checkpoint landed (step 3).
   ckpts = os.listdir(os.path.join(logdir, 'checkpoints'))
   assert '3' in ckpts, ckpts
+
+
+def test_kill_one_host_then_resume(tmp_path):
+  """Failure drill (VERDICT r1 W7): SIGKILL one host mid-run.
+
+  What the system must guarantee (measured empirically: the
+  coordination service detects the dead peer via heartbeat timeout and
+  terminates the survivor — there is no Python-level unwind to assert,
+  and crucially NO deadlock in the Orbax barrier):
+
+  1. the surviving process TERMINATES within bounded time (no hang in
+     a collective or the checkpoint barrier);
+  2. the last collectively-committed checkpoint survives the crash
+     (uncommitted tmp steps are ignored by restore);
+  3. a fresh two-process restart resumes from that checkpoint and
+     keeps training.
+  """
+  logdir = str(tmp_path)
+  procs = _spawn_children(logdir, _free_port(), extra_args=('drill',))
+  committed = []
+  try:
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline:
+      committed = _committed_steps(logdir)
+      if committed:
+        break
+      assert all(p.poll() is None for p in procs), \
+          'a child died before the first checkpoint'
+      time.sleep(0.5)
+    assert committed, 'no committed checkpoint within 180s'
+
+    procs[1].kill()  # SIGKILL the non-coordinator host mid-run
+    # (1) Survivor terminates within bounded time. Its exit status is
+    # the runtime's abort-on-peer-failure, not ours to assert.
+    out0, _ = procs[0].communicate(timeout=240)
+    assert procs[0].poll() is not None
+  finally:
+    for p in procs:
+      if p.poll() is None:
+        p.kill()
+        p.communicate()
+
+  # (2) The committed checkpoint survived the crash.
+  after = _committed_steps(logdir)
+  assert after, 'checkpoints vanished after the crash'
+  resume_step = max(after)
+  assert resume_step >= max(committed)
+
+  # (3) Fresh two-process restart resumes from it and trains on.
+  procs2 = _spawn_children(logdir, _free_port(),
+                           extra_args=('resume', str(resume_step)))
+  outs = []
+  try:
+    for p in procs2:
+      out, _ = p.communicate(timeout=280)
+      outs.append(out)
+  finally:
+    for p in procs2:
+      if p.poll() is None:
+        p.kill()
+        p.communicate()
+  for i, (p, out) in enumerate(zip(procs2, outs)):
+    assert p.returncode == 0, f'resume child {i} failed:\n{out[-3000:]}'
+    assert f'resumed from {resume_step} to {resume_step + 2} ok' in out, \
+        out[-2000:]
