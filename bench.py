@@ -26,32 +26,23 @@ import tempfile
 import time
 
 
-def bench_synthetic(smoke):
+def _time_step(cfg, use_instruction, smoke, h, w):
   import jax
   import jax.numpy as jnp
   from scalable_agent_tpu import learner as learner_lib
-  from scalable_agent_tpu.config import Config
   from scalable_agent_tpu.models import ImpalaAgent, init_params
   from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
   from scalable_agent_tpu.testing import make_example_batch
 
   num_actions = 9  # DMLab DEFAULT_ACTION_SET
-  cfg = Config(batch_size=32 if not smoke else 2,
-               unroll_length=100 if not smoke else 4,
-               num_action_repeats=4,
-               total_environment_frames=int(1e9),
-               torso='deep', compute_dtype='bfloat16')
   t1, b = cfg.unroll_length + 1, cfg.batch_size
-  h, w = (72, 96) if not smoke else (24, 32)
-
   agent = ImpalaAgent(num_actions=num_actions, torso=cfg.torso,
+                      use_instruction=use_instruction,
                       scan_unroll=cfg.scan_unroll, dtype=jnp.bfloat16)
   obs_spec = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
   params = init_params(agent, jax.random.PRNGKey(0), obs_spec)
-
   batch = make_example_batch(t1, b, h, w, num_actions,
                              MAX_INSTRUCTION_LEN, done_prob=0.01)
-
   state = learner_lib.make_train_state(params, cfg)
   train_step = learner_lib.make_train_step(agent, cfg)
 
@@ -70,7 +61,24 @@ def bench_synthetic(smoke):
     state, metrics = train_step(state, batch)
   float(metrics['total_loss'])
   dt = (time.perf_counter() - t0) / n
-  return cfg, cfg.frames_per_step / dt
+  return cfg.frames_per_step / dt
+
+
+def bench_synthetic(smoke):
+  from scalable_agent_tpu.config import Config
+
+  cfg = Config(batch_size=32 if not smoke else 2,
+               unroll_length=100 if not smoke else 4,
+               num_action_repeats=4,
+               total_environment_frames=int(1e9),
+               torso='deep', compute_dtype='bfloat16')
+  h, w = (72, 96) if not smoke else (24, 32)
+  # Headline: the full flagship model (language encoder ON — dmlab30
+  # parity, comparable across rounds).
+  fps = _time_step(cfg, True, smoke, h, w)
+  # Lever (docs/PERF.md): single-task levels auto-skip the encoder.
+  fps_no_instr = None if smoke else _time_step(cfg, False, smoke, h, w)
+  return cfg, fps, fps_no_instr
 
 
 def bench_e2e(smoke):
@@ -128,7 +136,7 @@ def main():
     import jax
     jax.config.update('jax_platforms', 'cpu')
 
-  cfg, fps = bench_synthetic(smoke)
+  cfg, fps, fps_no_instr = bench_synthetic(smoke)
   e2e = None
   if os.environ.get('BENCH_SKIP_E2E') != '1':
     e2e = bench_e2e(smoke)
@@ -142,6 +150,9 @@ def main():
                   ', SMOKE' if smoke else '')),
       'vs_baseline': round(fps / baseline_per_chip, 3),
   }
+  if fps_no_instr is not None:
+    # The auto-off instruction-encoder lever (single-task configs).
+    out['no_instruction_fps'] = round(fps_no_instr, 1)
   if e2e is not None:
     out['e2e'] = e2e
   print(json.dumps(out))

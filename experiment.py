@@ -95,7 +95,10 @@ flags.DEFINE_integer('model_parallelism', _DEFAULTS.model_parallelism,
 flags.DEFINE_bool('use_py_process', _DEFAULTS.use_py_process,
                   'Host each env in its own OS process.')
 flags.DEFINE_bool('use_instruction', _DEFAULTS.use_instruction,
-                  'Enable the language/instruction channel.')
+                  'Enable the language/instruction channel. Default '
+                  'auto: on for dmlab30 / language_* / psychlab_* '
+                  'levels, off otherwise (the encoder costs ~6% step '
+                  'time — docs/PERF.md).')
 flags.DEFINE_bool('use_popart', _DEFAULTS.use_popart,
                   'PopArt per-task value normalization.')
 flags.DEFINE_float('pixel_control_cost', _DEFAULTS.pixel_control_cost,

@@ -64,7 +64,15 @@ class Config:
   scan_unroll: int = 5                    # LSTM time-scan unroll factor
                                           # (measured ~7% step-time win
                                           # on v5e at T=100, B=32)
-  use_instruction: bool = True
+  # Language/instruction channel. None = auto by task: ON for
+  # multi-task dmlab30 and language_*/psychlab_* levels, OFF otherwise
+  # — the encoder costs ~6% step time (docs/PERF.md) and single-task
+  # levels emit constant/empty instructions. The reference always runs
+  # its language net; set True to match it exactly. MIGRATION: the
+  # encoder's params are part of the checkpoint structure — resuming a
+  # run trained when the default was True (pre-auto) on a non-language
+  # level needs an explicit --use_instruction=true.
+  use_instruction: Optional[bool] = None
   compute_dtype: str = 'float32'          # float32 | bfloat16
   use_associative_scan: bool = False      # parallel V-trace recursion
   use_pallas_vtrace: bool = False         # fused Pallas V-trace kernel
@@ -75,6 +83,10 @@ class Config:
   pixel_control_cell_size: int = 4
   grad_clip_norm: Optional[float] = None
   checkpoint_secs: int = 600              # reference save_checkpoint_secs
+  # Learner steps between cross-host checkpoint-cadence broadcasts
+  # (multi-host only; the broadcast is a cross-host sync, so it must
+  # not run every step).
+  checkpoint_check_every_steps: int = 20
   summary_secs: int = 30                  # reference save_summaries_secs
   # jax.profiler trace capture (SURVEY §5.1 — absent upstream):
   # non-empty dir ⇒ capture steps [profile_start, profile_start+steps).
@@ -100,6 +112,18 @@ class Config:
   @property
   def frames_per_step(self):
     return self.batch_size * self.unroll_length * self.num_action_repeats
+
+  @property
+  def resolved_use_instruction(self) -> bool:
+    """`use_instruction` with the None-auto rule applied (must be
+    deterministic in the config alone: train, evaluate, and remote
+    actors all resolve independently and the agent param structure —
+    hence checkpoints — depends on it)."""
+    if self.use_instruction is not None:
+      return self.use_instruction
+    if self.level_name == 'dmlab30':
+      return True
+    return self.level_name.startswith(('language_', 'psychlab_'))
 
 
 def apply_overrides(config: Config, **overrides) -> Config:

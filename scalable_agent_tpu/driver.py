@@ -51,9 +51,6 @@ from scalable_agent_tpu.runtime.inference import InferenceServer
 
 log = logging.getLogger('scalable_agent_tpu')
 
-# Learner steps between cross-host checkpoint-cadence broadcasts.
-_CKPT_CHECK_EVERY = 20
-
 
 def _stats_only_view(level_name, info, done):
   """ActorOutput carrying ONLY what observability.extract_episodes
@@ -73,7 +70,7 @@ def build_agent(config: Config, num_actions: int,
   dtype = (jnp.bfloat16 if config.compute_dtype == 'bfloat16'
            else jnp.float32)
   return ImpalaAgent(num_actions=num_actions, torso=config.torso,
-                     use_instruction=config.use_instruction,
+                     use_instruction=config.resolved_use_instruction,
                      num_popart_tasks=(num_tasks if config.use_popart
                                        else 0),
                      use_pixel_control=config.pixel_control_cost > 0,
@@ -169,6 +166,13 @@ def train(config: Config, max_steps: Optional[int] = None,
 
   Returns the TrainRun with the final state (all machinery shut down).
   """
+  if max_seconds is not None and jax.process_count() > 1:
+    # Wall clocks differ per host: a time-based exit is NOT a
+    # deterministic function of the shared step count, so hosts would
+    # leave the loop at different steps and deadlock the collective
+    # final checkpoint (see the finally-block contract below).
+    raise ValueError('max_seconds is single-host only; bound multi-host '
+                     'runs by max_steps/total_environment_frames')
   levels = factory.level_names(config)
   spec0 = factory.make_env_spec(config, levels[0], seed=1)
   num_actions = spec0.num_actions
@@ -253,7 +257,10 @@ def train(config: Config, max_steps: Optional[int] = None,
   # Setup from here to the main loop's try/finally can raise (env
   # construction, 20–40 s inference compiles): the already-listening
   # ingest must not outlive a failed train() — a bound zombie port
-  # serving stale v1 params would break retries in the same process.
+  # serving stale v1 params would break retries in the same process —
+  # and neither must the inference server (batcher thread + warmed
+  # params/executables resident on the chip).
+  server = None
   try:
     # --- Inference server (weights served host-side to actor
     # threads). Per-process seed offset: params/init use config.seed
@@ -317,6 +324,8 @@ def train(config: Config, max_steps: Optional[int] = None,
                    ingest=ingest)
   except BaseException:
     buffer.close()
+    if server is not None:
+      server.close()
     if ingest is not None:
       ingest.close()
     raise
@@ -453,12 +462,12 @@ def train(config: Config, max_steps: Optional[int] = None,
       # clocks differ, so all hosts act on PROCESS 0's decision (a
       # host-local clock here would desync the barrier and deadlock).
       # The broadcast is a cross-host sync, so it runs only every
-      # CKPT_CHECK_EVERY steps — the cadence check itself must not tax
-      # the hot loop (at worst the save lands that many steps late,
-      # noise against checkpoint_secs=600).
+      # checkpoint_check_every_steps — the cadence check itself must
+      # not tax the hot loop (at worst the save lands that many steps
+      # late, noise against checkpoint_secs=600).
       if num_processes == 1:
         checkpointer.maybe_save(state)
-      elif steps_done % _CKPT_CHECK_EVERY == 0:
+      elif steps_done % config.checkpoint_check_every_steps == 0:
         decision = bool(multihost_utils.broadcast_one_to_all(
             jnp.asarray(checkpointer.should_save())))
         checkpointer.maybe_save(state, decision=decision)

@@ -101,6 +101,9 @@ class ImpalaAgent(nn.Module):
     t, b = reward.shape[0], reward.shape[1]
 
     # --- Torso over merged time+batch (one big MXU batch). ---
+    # (Torso rematerialization was tried and REJECTED: +20% step time
+    # at [T=100, B=32] — XLA's remat re-reads more bytes than it
+    # saves here. Measurements in docs/PERF.md.)
     flat_frame = frame.reshape((t * b,) + frame.shape[2:])
     torso_out = TORSOS[self.torso](dtype=self.dtype)(flat_frame)
 
