@@ -5,9 +5,10 @@ in this model family; this implements the V-trace half: everything
 `vtrace.from_importance_weights` does — exp/clip of the importance
 weights, the temporal-difference deltas, the backward linear recursion
 and the policy-gradient advantages — in ONE kernel, so no intermediate
-([T, B] rhos/cs/deltas/vs) ever round-trips through HBM and the
-sequential recursion runs as a VMEM-resident loop instead of an XLA
-while-loop with per-step buffer plumbing.
+([T, B] rhos/cs/deltas/vs) ever round-trips through HBM, and the
+recursion runs as ceil(log2 T) fully-vectorized VMEM-resident
+pointer-doubling passes instead of an XLA while-loop with per-step
+buffer plumbing.
 
 Contrast with the reference, which not only materializes every
 intermediate but pins the scan to the *CPU* with a comment that XLA
@@ -19,16 +20,21 @@ time loop walks sublane rows). B is padded to the lane width; T is
 whatever the unroll is (T=100 → ~50 KB per [T, 128] f32 operand, far
 under VMEM).
 
-Numerics match vtrace.from_importance_weights bit-for-bit in f32 (same
-op order per element); vtrace_test.py's ground-truth applies.
+Numerics match vtrace.from_importance_weights to float32
+reassociation tolerance (the doubling recursion reorders the
+accumulation; ~1e-5 absolute at T=100) — vtrace_test.py's ground-truth
+applies.
 
-Measured on TPU v5e (1 chip, T=100, B=32, async-dispatch chain):
-scan 885 us, associative_scan 723 us, this kernel 1490 us per call —
-the row-at-a-time VMEM loop underuses the 8-sublane VPU, so XLA's
-fused scan wins at IMPALA sizes and `use_pallas_vtrace` defaults to
-False. The kernel remains the door to a blocked/sequence-parallel
-formulation at much larger T, and the in-repo example of the Pallas
-playbook (grid/BlockSpec/SMEM scalars/VMEM scratch/`pl.ds` loops).
+Measured on TPU v5e (1 chip, T=100, B=32, async-dispatch chain,
+round 2): XLA scan 851 µs, associative_scan 807 µs, **this kernel
+604 µs** per call — the pointer-doubling recursion (see
+`_vtrace_kernel`) keeps all operands VMEM-resident across the whole
+computation and uses the full 8-sublane VPU, beating both XLA forms.
+(Round 1's row-at-a-time `fori_loop` version measured 1490 µs; the
+fix was vectorizing the recursion, not more blocking.)
+`use_pallas_vtrace` still defaults to False only because pallas_call
+has no SPMD partitioning rule — the driver rejects it under a mesh;
+single-device runs can turn it on.
 """
 
 import jax
@@ -40,15 +46,21 @@ LANE = 128  # TPU lane width: batch block size
 
 
 def _vtrace_kernel(clips_ref, log_rhos_ref, discounts_ref, rewards_ref,
-                   values_ref, bootstrap_ref, vs_ref, pg_ref,
-                   deltas_ref, dcs_ref):
-  """One batch block: full V-trace, recursion over time in VMEM.
+                   values_ref, bootstrap_ref, vs_ref, pg_ref):
+  """One batch block: full V-trace in VMEM, recursion by doubling.
 
   clips_ref: SMEM f32 [2] = (rho-bar, pg-rho-bar); +inf encodes "no
   clipping" (min(inf, x) == x), so thresholds may be traced values.
-  deltas_ref/dcs_ref: VMEM scratch — the vectorized precompute lands
-  there so the sequential loop can read rows via `pl.ds` (Mosaic has
-  dynamic ref indexing but no dynamic_slice on materialized values).
+
+  The backward recursion acc_r = delta_r + dc_r · acc_{r+1} is a
+  composition of affine maps f_r(x) = B_r + A_r·x. Pointer-doubling
+  composes each row with the row `offset` below it (identity padding
+  past the end), doubling coverage per pass: after ceil(log2 T) fully
+  vectorized [T, LANE] passes, B_r holds the whole suffix — i.e.
+  vs_r − v_r. A first version looped `fori_loop` row-at-a-time
+  instead (1/8 sublane utilization + per-iteration overhead) and LOST
+  to the XLA scan; this form is what makes the kernel win (timings in
+  the module docstring).
   """
   t = log_rhos_ref.shape[0]
   rhos = jnp.exp(log_rhos_ref[:])                       # [T, LANE]
@@ -60,21 +72,22 @@ def _vtrace_kernel(clips_ref, log_rhos_ref, discounts_ref, rewards_ref,
   bootstrap = bootstrap_ref[:]                          # [1, LANE]
 
   values_t_plus_1 = jnp.concatenate([values[1:], bootstrap], axis=0)
-  deltas_ref[:] = clipped_rhos * (rewards +
-                                  discounts * values_t_plus_1 - values)
-  dcs_ref[:] = discounts * cs
+  b_acc = clipped_rhos * (rewards +
+                          discounts * values_t_plus_1 - values)
+  a_acc = discounts * cs
 
-  def body(i, acc):
-    # Backward over time: row = T-1-i; acc is vs_minus_v at row+1.
-    row = t - 1 - i
-    acc = (deltas_ref[pl.ds(row, 1), :] +
-           dcs_ref[pl.ds(row, 1), :] * acc)
-    vs_ref[pl.ds(row, 1), :] = acc + values_ref[pl.ds(row, 1), :]
-    return acc
+  offset = 1
+  while offset < t:  # static python loop: ceil(log2 T) passes
+    ident_a = jnp.ones((offset, LANE), a_acc.dtype)
+    ident_b = jnp.zeros((offset, LANE), b_acc.dtype)
+    a_shift = jnp.concatenate([a_acc[offset:], ident_a], axis=0)
+    b_shift = jnp.concatenate([b_acc[offset:], ident_b], axis=0)
+    b_acc = b_acc + a_acc * b_shift
+    a_acc = a_acc * a_shift
+    offset *= 2
 
-  jax.lax.fori_loop(0, t, body, jnp.zeros_like(bootstrap))
-
-  vs = vs_ref[:]
+  vs = b_acc + values
+  vs_ref[:] = vs
   vs_t_plus_1 = jnp.concatenate([vs[1:], bootstrap], axis=0)
   clipped_pg_rhos = jnp.minimum(clips_ref[1], rhos)
   pg_ref[:] = clipped_pg_rhos * (rewards + discounts * vs_t_plus_1 -
@@ -143,8 +156,6 @@ def from_importance_weights(log_rhos, discounts, rewards, values,
       out_specs=[specs, specs],
       out_shape=[jax.ShapeDtypeStruct((t, n_pad), jnp.float32),
                  jax.ShapeDtypeStruct((t, n_pad), jnp.float32)],
-      scratch_shapes=[pltpu.VMEM((t, LANE), jnp.float32),
-                      pltpu.VMEM((t, LANE), jnp.float32)],
       interpret=interpret,
   )(clips, log_rhos_f, discounts_f, rewards_f, values_f, bootstrap_f)
 

@@ -258,15 +258,19 @@ class TestVtracePallas:
         ground_truth.pg_advantages, np.asarray(output.pg_advantages),
         rtol=1e-4, atol=1e-4)
 
-  def test_matches_scan_path_exactly(self):
+  def test_matches_scan_path(self):
+    """Within f32 reassociation tolerance: the kernel's pointer-
+    doubling recursion reorders the accumulation relative to the
+    sequential scan (~1e-5 absolute at T=100 on-chip)."""
     values = _make_inputs(5)
     seq = vtrace.from_importance_weights(use_pallas=False, **values)
     fused = vtrace.from_importance_weights(use_pallas=True, **values)
     np.testing.assert_allclose(np.asarray(seq.vs),
-                               np.asarray(fused.vs), rtol=1e-6)
+                               np.asarray(fused.vs),
+                               rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(seq.pg_advantages),
                                np.asarray(fused.pg_advantages),
-                               rtol=1e-6)
+                               rtol=1e-5, atol=1e-5)
 
   def test_higher_rank_and_wide_batch(self):
     """Trailing dims flatten into lanes; >128 lanes exercises the
