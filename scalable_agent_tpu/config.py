@@ -61,9 +61,11 @@ class Config:
   publish_params_every: int = 1           # actor weight-snapshot cadence
   model_parallelism: int = 1              # TP width of the mesh
   torso: str = 'deep'                     # deep | shallow
-  scan_unroll: int = 5                    # LSTM time-scan unroll factor
-                                          # (measured ~7% step-time win
-                                          # on v5e at T=100, B=32)
+  scan_unroll: int = 10                   # LSTM time-scan unroll factor
+                                          # (v5e sweep at T=100, B=32:
+                                          # 1→40.8ms 5→40.5 10→39.3
+                                          # 25→39.1; 10 balances the
+                                          # win against compile time)
   # Language/instruction channel. None = auto by task: ON for
   # multi-task dmlab30 and language_*/psychlab_* levels, OFF otherwise
   # — the encoder costs ~6% step time (docs/PERF.md) and single-task

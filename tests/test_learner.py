@@ -149,3 +149,34 @@ class TestVtraceFormsInLearner:
       state, metrics = step(state, batch)
       losses.append(float(metrics['total_loss']))
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-4)
+
+
+def test_grad_clip_norm_bounds_update():
+  """config.grad_clip_norm wires optax.clip_by_global_norm into the
+  update chain: a near-zero clip must shrink the first-step param
+  delta by orders of magnitude vs the unclipped run."""
+  from scalable_agent_tpu.models import ImpalaAgent, init_params
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+  from scalable_agent_tpu.testing import make_example_batch
+  a, h, w = 4, 24, 32
+  obs = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+  agent = ImpalaAgent(num_actions=a, torso='shallow')
+  batch = make_example_batch(5, 2, h, w, a, MAX_INSTRUCTION_LEN,
+                             done_prob=0.1)
+
+  def delta(clip):
+    cfg = Config(batch_size=2, unroll_length=4, num_action_repeats=1,
+                 total_environment_frames=10**6, grad_clip_norm=clip)
+    params = init_params(agent, jax.random.PRNGKey(0), obs)
+    before = jax.tree_util.tree_map(jnp.copy, params)
+    state = learner_lib.make_train_state(params, cfg)
+    step = learner_lib.make_train_step(agent, cfg)
+    state, _ = step(state, batch)
+    return sum(
+        float(jnp.sum(jnp.abs(x - y)))
+        for x, y in zip(jax.tree_util.tree_leaves(state.params),
+                        jax.tree_util.tree_leaves(before)))
+
+  unclipped = delta(None)
+  clipped = delta(1e-9)
+  assert clipped < unclipped * 1e-2, (clipped, unclipped)

@@ -116,25 +116,21 @@ def test_ingest_backpressure_blocks_ack():
   buffer.close()
 
 
-def test_remote_actor_feeds_training(tmp_path):
-  """The VERDICT bar: a separate OS process with no accelerator runs
-  the actor role end-to-end (envs → CPU inference → TCP) and a real
-  learner trains exclusively on its unrolls (num_actors=0 locally)."""
+def _run_learner_with_remote_child(tmp_path, base, child_actors,
+                                   max_steps):
+  """Shared body of the end-to-end remote-actor tests: spawn the
+  no-accelerator child actor process, train the learner exclusively on
+  its unrolls (num_actors=0 locally), assert the wire fed every
+  consumed trajectory and the child exited cleanly. Returns the
+  TrainRun."""
   from scalable_agent_tpu import driver
   from scalable_agent_tpu.config import Config
 
   with socket.create_server(('127.0.0.1', 0)) as s:
     port = s.getsockname()[1]
-
-  base = dict(
-      env_backend='bandit', batch_size=2, unroll_length=5,
-      num_action_repeats=1, episode_length=4, height=24, width=32,
-      torso='shallow', use_py_process=False, use_instruction=False,
-      total_environment_frames=10**6, inference_timeout_ms=5,
-      checkpoint_secs=0, summary_secs=0, seed=11)
   learner_cfg = Config(logdir=str(tmp_path), num_actors=0,
                        remote_actor_port=port, **base)
-  child_overrides = dict(base, num_actors=2)
+  child_overrides = dict(base, num_actors=child_actors)
 
   repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
   env = dict(os.environ)
@@ -150,17 +146,49 @@ def test_remote_actor_feeds_training(tmp_path):
       cwd=repo, env=env, stdout=subprocess.PIPE,
       stderr=subprocess.STDOUT, text=True)
   try:
-    run = driver.train(learner_cfg, max_steps=3,
+    run = driver.train(learner_cfg, max_steps=max_steps,
                        stall_timeout_secs=120)
-    assert int(run.state.update_steps) == 3
+    assert int(run.state.update_steps) == max_steps
     # Every consumed trajectory came over the wire.
     assert run.ingest is not None
-    assert run.ingest.stats()['unrolls'] >= 3 * learner_cfg.batch_size
+    assert run.ingest.stats()['unrolls'] >= \
+        max_steps * learner_cfg.batch_size
     assert run.fleet.stats()['unrolls'] == 0
     out, _ = child.communicate(timeout=120)
     assert child.returncode == 0, out[-2000:]
     assert 'CHILD_OK' in out, out[-2000:]
+    return run
   finally:
     if child.poll() is None:
       child.kill()
       child.communicate()
+
+
+def test_remote_actor_feeds_training(tmp_path):
+  """The VERDICT bar: a separate OS process with no accelerator runs
+  the actor role end-to-end (envs → CPU inference → TCP) and a real
+  learner trains exclusively on its unrolls."""
+  base = dict(
+      env_backend='bandit', batch_size=2, unroll_length=5,
+      num_action_repeats=1, episode_length=4, height=24, width=32,
+      torso='shallow', use_py_process=False, use_instruction=False,
+      total_environment_frames=10**6, inference_timeout_ms=5,
+      checkpoint_secs=0, summary_secs=0, seed=11)
+  _run_learner_with_remote_child(tmp_path, base, child_actors=2,
+                                 max_steps=3)
+
+
+def test_remote_actor_feeds_sharded_training(tmp_path):
+  """Remote ingest composed with the 8-device mesh path: remote-fed
+  host unrolls flow through make_array_from_process_local_data into
+  the pjit-sharded train step (batch_size=8 triggers the mesh)."""
+  import jax
+  assert len(jax.devices()) == 8
+  base = dict(
+      env_backend='bandit', batch_size=8, unroll_length=4,
+      num_action_repeats=1, episode_length=4, height=24, width=32,
+      torso='shallow', use_py_process=False, use_instruction=False,
+      total_environment_frames=10**6, inference_timeout_ms=5,
+      checkpoint_secs=0, summary_secs=0, seed=13)
+  _run_learner_with_remote_child(tmp_path, base, child_actors=3,
+                                 max_steps=2)
