@@ -43,6 +43,11 @@ flags.DEFINE_string('learner_address', _DEFAULTS.learner_address,
 flags.DEFINE_integer('remote_actor_port', _DEFAULTS.remote_actor_port,
                      'Learner: listen for remote actor hosts on this '
                      'port (0 = disabled).')
+flags.DEFINE_float('actor_reconnect_secs',
+                   _DEFAULTS.actor_reconnect_secs,
+                   'Actor: on disconnect, retry the learner for this '
+                   'many seconds (survives a learner restart); '
+                   '0 = exit on disconnect.')
 flags.DEFINE_integer('num_actors', _DEFAULTS.num_actors,
                      'Actor (environment) count.')
 flags.DEFINE_integer('total_environment_frames',

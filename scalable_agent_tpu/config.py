@@ -110,6 +110,10 @@ class Config:
   # Min seconds between param snapshots published to remote hosts (a
   # publish is a full device_get; remote staleness ~ this value).
   remote_publish_secs: float = 2.0
+  # Actor-host elasticity: on disconnect, keep retrying the learner
+  # for this many seconds (surviving a learner restart-from-
+  # checkpoint) instead of exiting. 0 = exit on disconnect.
+  actor_reconnect_secs: float = 0.0
 
   @property
   def frames_per_step(self):

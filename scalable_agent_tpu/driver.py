@@ -473,6 +473,7 @@ def train(config: Config, max_steps: Optional[int] = None,
         checkpointer.maybe_save(state, decision=decision)
       fleet.check_health(stall_timeout_secs=stall_timeout_secs)
   finally:
+    exiting_clean = sys.exc_info()[0] is None
     if profiling:
       jax.profiler.stop_trace()
     elif (config.profile_dir and
@@ -486,7 +487,10 @@ def train(config: Config, max_steps: Optional[int] = None,
     prefetcher.close()
     server.close()
     if ingest is not None:
-      ingest.close()
+      # Clean end → 'bye' frame (remote actors exit immediately);
+      # exception unwind → crash semantics (actors keep their
+      # reconnect window for the supervisor's restart).
+      ingest.close(graceful=exiting_clean)
     try:
       # The final save is a COLLECTIVE. On a clean exit every host
       # reaches it in lockstep (termination is a deterministic
@@ -495,7 +499,6 @@ def train(config: Config, max_steps: Optional[int] = None,
       # collective train step — entering the Orbax barrier here would
       # deadlock the job instead of surfacing the error; periodic
       # checkpoints cover the tail.
-      exiting_clean = sys.exc_info()[0] is None
       if num_processes == 1 or exiting_clean:
         checkpointer.save(run.state, force=True)
       else:

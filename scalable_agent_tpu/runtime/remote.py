@@ -90,6 +90,43 @@ def _recv_msg(sock: socket.socket):
   return pickle.loads(payload)
 
 
+class LearnerShutdown(Exception):
+  """The learner announced a CLEAN shutdown ('bye' frame): end of
+  training, not a crash — actors must exit instead of reconnecting."""
+
+
+class _Conn:
+  """One actor connection: socket + send lock (the handler thread and
+  close()'s 'bye' frame must not interleave writes mid-message)."""
+
+  def __init__(self, sock: socket.socket):
+    self.sock = sock
+    self.send_lock = threading.Lock()
+
+  def send(self, obj) -> None:
+    with self.send_lock:
+      _send_msg(self.sock, obj)
+
+  def try_send(self, obj, timeout: float = 2.0) -> bool:
+    """Bounded best-effort send: never blocks shutdown behind a stuck
+    peer (a handler mid-sendall of a large snapshot holds send_lock;
+    a non-reading client stalls sendall itself)."""
+    if not self.send_lock.acquire(timeout=timeout):
+      return False
+    try:
+      self.sock.settimeout(timeout)
+      _send_msg(self.sock, obj)
+      return True
+    except OSError:
+      return False
+    finally:
+      try:
+        self.sock.settimeout(None)
+      except OSError:
+        pass
+      self.send_lock.release()
+
+
 class TrajectoryIngestServer:
   """Learner-side: accepts remote-actor connections, lands their
   unrolls in the shared TrajectoryBuffer, serves param snapshots.
@@ -115,7 +152,7 @@ class TrajectoryIngestServer:
     # disconnect, snapshotted by close() — all under one lock (flapping
     # actor hosts over a long run must not accumulate dead entries).
     self._threads: List[threading.Thread] = []
-    self._conns: List[socket.socket] = []
+    self._conns: List[_Conn] = []
     self._conns_lock = threading.Lock()
     self._listener = socket.create_server((host, port))
     self.port = self._listener.getsockname()[1]
@@ -147,13 +184,14 @@ class TrajectoryIngestServer:
       except OSError:
         return  # listener closed
       conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-      t = threading.Thread(target=self._serve, args=(conn, addr),
+      wrapped = _Conn(conn)
+      t = threading.Thread(target=self._serve, args=(wrapped, addr),
                            name=f'ingest-{addr}', daemon=True)
       with self._conns_lock:
         if self._closed.is_set():
           conn.close()
           return
-        self._conns.append(conn)
+        self._conns.append(wrapped)
         self._threads = [x for x in self._threads if x.is_alive()]
         self._threads.append(t)
       with self._stats_lock:
@@ -164,17 +202,17 @@ class TrajectoryIngestServer:
     with self._params_lock:
       return self._version, self._params
 
-  def _serve(self, conn: socket.socket, addr):
+  def _serve(self, conn: _Conn, addr):
     log.info('remote actor connected from %s', addr)
     try:
       while not self._closed.is_set():
-        msg = _recv_msg(conn)
+        msg = _recv_msg(conn.sock)
         if msg is None:
           return  # client went away
         kind = msg[0]
         if kind in ('hello', 'get_params'):
           version, params = self._snapshot()
-          _send_msg(conn, ('params', version, params))
+          conn.send(('params', version, params))
         elif kind == 'unroll':
           # Blocking put IS the backpressure: the delayed ack holds the
           # remote pump exactly like the reference's remote enqueue
@@ -190,22 +228,35 @@ class TrajectoryIngestServer:
             self._unrolls += 1
           with self._params_lock:
             version = self._version
-          _send_msg(conn, ('ack', version))
+          conn.send(('ack', version))
         else:
-          _send_msg(conn, ('error', f'unknown message kind {kind!r}'))
+          conn.send(('error', f'unknown message kind {kind!r}'))
     except ring_buffer.Closed:
       pass  # learner shut down; dropping the conn tells the actor
     except (ConnectionError, OSError) as e:
       if not self._closed.is_set():
         log.warning('remote actor %s dropped: %s', addr, e)
     finally:
-      conn.close()
+      conn.sock.close()
       with self._conns_lock:
         if conn in self._conns:
           self._conns.remove(conn)
       log.info('remote actor %s disconnected', addr)
 
-  def close(self):
+  def close(self, graceful: bool = True):
+    """Shut the server down.
+
+    graceful=True announces a CLEAN end ('bye' frame) so actors exit
+    immediately instead of burning their reconnect window against a
+    port that will never come back. Pass graceful=False when the
+    learner intends to RESTART (exception unwind before a supervisor
+    respawn) — actors then keep retrying and resume feeding.
+
+    Graceful shutdown half-closes (SHUT_WR) before the hard close so
+    the 'bye' is not discarded by an RST when the client's next
+    request races the close; every step is time-bounded (a stuck peer
+    cannot hang the learner's teardown).
+    """
     self._closed.set()
     try:
       self._listener.close()
@@ -215,13 +266,26 @@ class TrajectoryIngestServer:
       conns = list(self._conns)
       threads = list(self._threads)
     for conn in conns:
-      try:
-        conn.shutdown(socket.SHUT_RDWR)
-      except OSError:
-        pass
-      conn.close()
+      if graceful:
+        conn.try_send(('bye',))
+        try:
+          # FIN only: the client still reads the buffered 'bye' even
+          # if it was mid-send; a full RDWR shutdown + close here can
+          # turn into an RST that discards it.
+          conn.sock.shutdown(socket.SHUT_WR)
+        except OSError:
+          pass
+      else:
+        try:
+          conn.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+          pass
+        conn.sock.close()
     for t in threads:
       t.join(timeout=2.0)
+    if graceful:
+      for conn in conns:
+        conn.sock.close()
     self._accept_thread.join(timeout=2.0)
 
 
@@ -255,6 +319,8 @@ class RemoteActorClient:
     reply = _recv_msg(self._sock)
     if reply is None:
       raise ConnectionError('learner closed the connection')
+    if reply[0] == 'bye':
+      raise LearnerShutdown('learner finished training')
     if reply[0] == 'error':
       raise RuntimeError(f'learner rejected request: {reply[1]}')
     return reply
@@ -279,7 +345,8 @@ class RemoteActorClient:
 def run_remote_actor(config, learner_address: str, task: int = 0,
                      stop_after_unrolls: Optional[int] = None,
                      platform: Optional[str] = 'cpu',
-                     connect_timeout_secs: float = 120.0) -> int:
+                     connect_timeout_secs: float = 120.0,
+                     reconnect_secs: Optional[float] = None) -> int:
   """Actor-only host main loop (reference --job_name=actor --task=N).
 
   Builds a CPU inference server + actor fleet against params fetched
@@ -297,6 +364,17 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
     stop_after_unrolls: optional unroll budget (tests).
     platform: force this jax platform BEFORE first jax use ('cpu' for
       actor hosts — they have no accelerator; None = leave as-is).
+    reconnect_secs: elasticity (defaults to
+      config.actor_reconnect_secs): when > 0 and the connection drops,
+      keep retrying the learner for this many seconds — the fleet
+      pauses on buffer backpressure meanwhile — then resume feeding
+      with freshly fetched params. This is how actor hosts survive a
+      learner restart-from-checkpoint (SURVEY §5.3 is greenfield; the
+      reference's actors just die). 0 = exit on disconnect.
+      Delivery is at-least-once: an unroll whose ack was lost in the
+      drop is resent on the new connection — a duplicate trajectory at
+      the learner, harmless to the off-policy math (same class as any
+      stale in-flight unroll).
   """
   if platform:
     import jax
@@ -306,6 +384,8 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
   from scalable_agent_tpu.envs import factory
   from scalable_agent_tpu.runtime.inference import InferenceServer
 
+  if reconnect_secs is None:
+    reconnect_secs = getattr(config, 'actor_reconnect_secs', 0.0)
   levels = factory.level_names(config)
   spec0 = factory.make_env_spec(config, levels[0], seed=1)
   agent = driver_lib.build_agent(config, spec0.num_actions,
@@ -315,7 +395,13 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
                              connect_timeout_secs=connect_timeout_secs)
   unrolls_sent = 0
   try:
-    version, params = client.fetch_params()
+    try:
+      version, params = client.fetch_params()
+    except LearnerShutdown:
+      # Connected just as training ended: a clean no-op, not a crash.
+      log.info('learner already finished training; remote actor '
+               'exiting')
+      return 0
     log.info('remote actor task=%d got params v%d', task, version)
 
     # Seed space DISJOINT from the learner hosts' (driver.train uses
@@ -333,27 +419,88 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
         config, agent, server.policy, buffer, levels,
         seed_base=seed_base, level_offset=task * config.num_actors)
     fleet.start()
+
+    def reconnect():
+      """New client + fresh params after a drop; False = gave up.
+
+      Retries the WHOLE connect+fetch cycle until the deadline: a
+      connection that resets right after connecting (learner mid-
+      restart, listener backlog races) must not end the actor."""
+      nonlocal client, version
+      client.close()
+      deadline = time.monotonic() + reconnect_secs
+      while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+          log.info('remote actor task=%d gave up reconnecting', task)
+          return False
+        try:
+          new_client = RemoteActorClient(learner_address,
+                                         connect_timeout_secs=remaining)
+        except ConnectionError:
+          continue  # connect window exhausted → loop exits above
+        try:
+          v, new_params = new_client.fetch_params()
+        except (OSError, RuntimeError):
+          new_client.close()
+          time.sleep(0.3)
+          continue
+        client = new_client
+        version = v
+        server.update_params(new_params)
+        log.info('remote actor task=%d reconnected, params v%d',
+                 task, version)
+        return True
+
+    elastic = bool(reconnect_secs) and reconnect_secs > 0
+
+    def resume_after_drop():
+      """True to keep going after a dropped connection (crash path);
+      False = give up and exit."""
+      if elastic and reconnect():
+        return True
+      log.info('learner connection closed; remote actor exiting')
+      return False
+
     try:
+      unroll = None  # a drop mid-send must not lose the unroll
       while (stop_after_unrolls is None or
              unrolls_sent < stop_after_unrolls):
+        if unroll is None:
+          try:
+            unroll = buffer.get(timeout=10.0)
+          except TimeoutError:
+            fleet.check_health(stall_timeout_secs=300.0)
+            errors = fleet.errors()
+            if errors:
+              raise errors[0]
+            continue
         try:
-          unroll = buffer.get(timeout=10.0)
-        except TimeoutError:
-          fleet.check_health(stall_timeout_secs=300.0)
-          errors = fleet.errors()
-          if errors:
-            raise errors[0]
-          continue
-        ack_version = client.send_unroll(unroll)
+          ack_version = client.send_unroll(unroll)
+        except OSError:
+          # OSError, not just ConnectionError: a blackholed learner
+          # host surfaces as ETIMEDOUT, which must also trigger the
+          # reconnect window.
+          if resume_after_drop():
+            continue  # resend the SAME unroll on the new connection
+          break
+        unroll = None
         unrolls_sent += 1
         if ack_version > version:
-          version, params = client.fetch_params()
-          server.update_params(params)
-          log.info('remote actor task=%d refreshed params to v%d',
-                   task, version)
-    except (ConnectionError, ring_buffer.Closed):
-      # Learner ended training (or died): either way this host is done.
-      log.info('learner connection closed; remote actor exiting')
+          try:
+            version, params = client.fetch_params()
+            server.update_params(params)
+            log.info('remote actor task=%d refreshed params to v%d',
+                     task, version)
+          except OSError:
+            # Dropped between ack and refresh; reconnect() refetches.
+            if not resume_after_drop():
+              break
+    except LearnerShutdown:
+      # Clean end of training ('bye'): no reconnect window to burn.
+      log.info('learner finished training; remote actor exiting')
+    except ring_buffer.Closed:
+      log.info('local buffer closed; remote actor exiting')
     finally:
       fleet.stop()
       server.close()

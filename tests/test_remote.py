@@ -192,3 +192,88 @@ def test_remote_actor_feeds_sharded_training(tmp_path):
       checkpoint_secs=0, summary_secs=0, seed=13)
   _run_learner_with_remote_child(tmp_path, base, child_actors=3,
                                  max_steps=2)
+
+
+def test_remote_actor_reconnects_after_learner_restart():
+  """Elasticity: when the learner (ingest server) CRASHES and comes
+  back on the same port, an actor host with actor_reconnect_secs > 0
+  keeps its envs alive, reconnects, refetches params, and resumes
+  feeding. Delivery is at-least-once: the in-flight unroll is resent
+  (an acked unroll sitting in the dead learner's buffer is lost with
+  it, like any consumed-but-untrained batch)."""
+  import threading as th
+  import jax
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.models import init_params
+
+  cfg = Config(env_backend='bandit', num_actors=1, batch_size=1,
+               unroll_length=3, num_action_repeats=1, episode_length=4,
+               height=24, width=32, torso='shallow',
+               use_py_process=False, use_instruction=False,
+               inference_timeout_ms=5, seed=21,
+               actor_reconnect_secs=30.0)
+  # The server must hold REAL agent params (the actor runs inference
+  # with whatever it fetches) — same construction as the actor's.
+  from scalable_agent_tpu.envs import factory
+  spec0 = factory.make_env_spec(cfg, factory.level_names(cfg)[0],
+                                seed=1)
+  agent = driver.build_agent(cfg, spec0.num_actions)
+  params = jax.device_get(
+      init_params(agent, jax.random.PRNGKey(cfg.seed), spec0.obs_spec))
+
+  # Bind on port 0 (no pick-then-close race); the restart reuses A's
+  # actual port so the actor's reconnect target stays valid.
+  buffer_a = ring_buffer.TrajectoryBuffer(2)
+  server_a = remote.TrajectoryIngestServer(
+      buffer_a, params, host='127.0.0.1')
+  port = server_a.port
+
+  result = {}
+
+  def actor_main():
+    result['sent'] = remote.run_remote_actor(
+        cfg, f'127.0.0.1:{port}', task=0, stop_after_unrolls=6)
+
+  t = th.Thread(target=actor_main, daemon=True)
+  t.start()
+  try:
+    got_a = [buffer_a.get(timeout=120) for _ in range(2)]
+    assert len(got_a) == 2
+    # Crash, not clean shutdown: no 'bye' frame, so the actor enters
+    # its reconnect window instead of exiting.
+    server_a.close(graceful=False)
+    buffer_a.close()
+
+    # Learner restarts on the SAME port with a fresh buffer/params.
+    buffer_b = ring_buffer.TrajectoryBuffer(8)
+    server_b = remote.TrajectoryIngestServer(
+        buffer_b, params, host='127.0.0.1', port=port)
+    try:
+      # The actor stops after 6 ACKED unrolls. Server A may have acked
+      # up to 2 extra unrolls in the close race (they died with
+      # buffer_a), so B receives 2–4: drain until the actor exits and
+      # assert its own ledger completed and the reconnect fed B.
+      got_b = []
+      deadline = time.time() + 120
+      while t.is_alive() and time.time() < deadline:
+        try:
+          got_b.append(buffer_b.get(timeout=2))
+        except TimeoutError:
+          pass
+      t.join(timeout=10)
+      assert not t.is_alive()
+      # Drain whatever the actor parked before exiting (the alive-
+      # gated loop above may stop with items still buffered).
+      while True:
+        try:
+          got_b.append(buffer_b.get(timeout=0.5))
+        except TimeoutError:
+          break
+      assert result['sent'] == 6
+      assert len(got_b) >= 2, len(got_b)
+    finally:
+      server_b.close()
+      buffer_b.close()
+  finally:
+    t.join(timeout=10)
