@@ -175,6 +175,9 @@ def main(argv):
     # learner's ingest server (reference ≈L625 actor loop).
     if not cfg.learner_address:
       raise app.UsageError('--job_name=actor needs --learner_address')
+    if cfg.mode != 'train':
+      raise app.UsageError('--job_name=actor only makes sense with '
+                           '--mode=train (eval runs its own envs)')
     from scalable_agent_tpu.runtime import remote
     remote.run_remote_actor(cfg, cfg.learner_address,
                             task=max(cfg.task, 0))
