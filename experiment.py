@@ -177,7 +177,8 @@ def main(argv):
       raise app.UsageError('--job_name=actor needs --learner_address')
     if cfg.mode != 'train':
       raise app.UsageError('--job_name=actor only makes sense with '
-                           '--mode=train (eval runs its own envs)')
+                           '--mode=train (--mode=test runs its own '
+                           'envs)')
     from scalable_agent_tpu.runtime import remote
     remote.run_remote_actor(cfg, cfg.learner_address,
                             task=max(cfg.task, 0))

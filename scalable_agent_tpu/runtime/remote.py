@@ -303,6 +303,14 @@ class RemoteActorClient:
       try:
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=10.0)
+        if self._sock.getsockname() == self._sock.getpeername():
+          # Localhost self-connect: while the learner's port is down,
+          # the kernel can hand our outbound socket that very port as
+          # its ephemeral source, and TCP simultaneous-open "succeeds"
+          # against ourselves — a phantom learner that both occupies
+          # the port and never replies. Drop it and retry.
+          self._sock.close()
+          raise OSError('self-connect while learner port is down')
         break
       except OSError as e:  # learner may not be up yet: retry
         last_err = e

@@ -11,10 +11,29 @@ default 'run'):
           process and watches the other terminate.
 - resume N: restore from the drill's checkpoints (expect step N), run
           2 more steps, exit 0 — the failure-drill phase 2 body.
+- mixed P: mixed trajectory sources across the SAME mesh — process 0
+          opens a remote-actor ingest on port P and runs NO local
+          actors (its batch shard arrives over TCP) while process 1
+          keeps a local fleet; 3 steps, assert, exit 0.
 """
 
 import os
 import sys
+
+# The env/model knobs every mode (and the mixed test's remote actor
+# host) must share — the remote protocol requires learner and actor
+# configs to agree exactly.
+CHILD_CONFIG = dict(
+    env_backend='bandit', level_name='bandit',
+    num_actors=2, batch_size=4,          # GLOBAL batch; 2 per host
+    unroll_length=5, num_action_repeats=1, episode_length=4,
+    height=24, width=32, torso='shallow', use_py_process=False,
+    use_instruction=False, total_environment_frames=10**9,
+    inference_timeout_ms=5, checkpoint_secs=0, summary_secs=0,
+    # Same seed on every process: model init must be IDENTICAL across
+    # hosts (the driver diversifies env/sampling streams by process
+    # internally).
+    seed=3)
 
 
 def main():
@@ -31,22 +50,26 @@ def main():
 
   from scalable_agent_tpu import driver
   from scalable_agent_tpu.config import Config
-  cfg = Config(
-      logdir=logdir, env_backend='bandit', level_name='bandit',
-      num_actors=2, batch_size=4,          # GLOBAL batch; 2 per host
-      unroll_length=5, num_action_repeats=1, episode_length=4,
-      height=24, width=32, torso='shallow', use_py_process=False,
-      use_instruction=False, total_environment_frames=10**9,
-      inference_timeout_ms=5, checkpoint_secs=0, summary_secs=0,
-      # Same seed on every process: model init must be IDENTICAL
-      # across hosts (the driver diversifies env/sampling streams by
-      # process internally).
-      seed=3)
+  cfg = Config(logdir=logdir, **CHILD_CONFIG)
 
   if mode == 'run':
     run = driver.train(cfg, max_steps=3, stall_timeout_secs=120)
     assert int(run.state.update_steps) == 3, run.state.update_steps
     print(f'child {proc}: ok', flush=True)
+  elif mode == 'mixed':
+    ingest_port = int(sys.argv[5])
+    if proc == 0:
+      cfg.remote_actor_port = ingest_port
+      cfg.num_actors = 0
+    run = driver.train(cfg, max_steps=3, stall_timeout_secs=180)
+    assert int(run.state.update_steps) == 3, run.state.update_steps
+    if proc == 0:
+      stats = run.ingest.stats()
+      assert stats['unrolls'] >= 3 * (cfg.batch_size // 2), stats
+      assert run.fleet.stats()['unrolls'] == 0
+    else:
+      assert run.fleet.stats()['unrolls'] >= 3 * (cfg.batch_size // 2)
+    print(f'child {proc}: mixed ok', flush=True)
   elif mode == 'drill':
     # Frequent collective checkpoints; runs until the parent kills this
     # process or the runtime aborts us because the peer died.

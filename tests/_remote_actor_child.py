@@ -1,11 +1,35 @@
 """Subprocess body for the remote-actor tests: an actor-only host with
 NO accelerator (jax platform forced to cpu before first use) builds an
 env fleet + CPU inference and streams unrolls to the learner's ingest
-server. Run: python _remote_actor_child.py <host:port> <config-json>.
+server. Run: python _remote_actor_child.py <host:port> <config-json>,
+or use `spawn()` (the one child-launch helper shared by the test
+files).
 """
 
 import json
+import os
+import subprocess
 import sys
+
+
+def spawn(address, overrides):
+  """Popen this script as a no-accelerator actor child.
+
+  The single place that knows how to launch it (script-run children
+  resolve sys.path from the script dir, so the package root must be on
+  PYTHONPATH; XLA_FLAGS/JAX_PLATFORMS are stripped — the child
+  provisions itself)."""
+  repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  env = {k: v for k, v in os.environ.items()
+         if k not in ('XLA_FLAGS', 'JAX_PLATFORMS')}
+  existing = env.get('PYTHONPATH', '')
+  env['PYTHONPATH'] = (repo + os.pathsep + existing if existing
+                       else repo)
+  return subprocess.Popen(
+      [sys.executable, os.path.abspath(__file__), address,
+       json.dumps(overrides)],
+      cwd=repo, env=env, stdout=subprocess.PIPE,
+      stderr=subprocess.STDOUT, text=True)
 
 
 def main():

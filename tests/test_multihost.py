@@ -16,11 +16,19 @@ import sys
 import time
 
 def _free_port():
-  s = socket.socket()
-  s.bind(('localhost', 0))
-  port = s.getsockname()[1]
-  s.close()
-  return port
+  return _free_ports(1)[0]
+
+
+def _free_ports(n):
+  """n DISTINCT free ports: all bound concurrently before any closes,
+  so the kernel cannot hand the same port out twice."""
+  socks = [socket.socket() for _ in range(n)]
+  for s in socks:
+    s.bind(('localhost', 0))
+  ports = [s.getsockname()[1] for s in socks]
+  for s in socks:
+    s.close()
+  return ports
 
 
 def _spawn_children(logdir, port, extra_args=()):
@@ -76,6 +84,44 @@ def test_two_process_training(tmp_path):
   # The collective final checkpoint landed (step 3).
   ckpts = os.listdir(os.path.join(logdir, 'checkpoints'))
   assert '3' in ckpts, ckpts
+
+
+def test_mixed_remote_and_local_sources(tmp_path):
+  """Mixed topology over ONE mesh: learner process 0 is fed entirely
+  by a remote actor host over TCP while process 1 runs a local fleet —
+  both shards meet in the same collective train step. This is the
+  production v5e-pod shape: TPU hosts that cannot step enough envs
+  themselves take remote feeds; others (or a mix) stay local."""
+  import _multihost_child
+  import _remote_actor_child
+
+  logdir = str(tmp_path)
+  coord_port, ingest_port = _free_ports(2)
+  procs = _spawn_children(logdir, coord_port,
+                          extra_args=('mixed', str(ingest_port)))
+
+  # The remote actor host (separate OS process, cpu-forced jax): the
+  # SAME shared config as the learner children (the remote protocol
+  # requires env/model knobs to agree exactly).
+  actor = _remote_actor_child.spawn(
+      f'127.0.0.1:{ingest_port}', _multihost_child.CHILD_CONFIG)
+
+  outs = []
+  try:
+    for p in procs:
+      out, _ = p.communicate(timeout=280)
+      outs.append(out)
+    actor_out, _ = actor.communicate(timeout=120)
+  finally:
+    for p in procs + [actor]:
+      if p.poll() is None:
+        p.kill()
+        p.communicate()
+  for i, (p, out) in enumerate(zip(procs, outs)):
+    assert p.returncode == 0, f'child {i} failed:\n{out[-3000:]}'
+    assert f'child {i}: mixed ok' in out
+  assert actor.returncode == 0, actor_out[-2000:]
+  assert 'CHILD_OK' in actor_out, actor_out[-2000:]
 
 
 def test_kill_one_host_then_resume(tmp_path):

@@ -8,17 +8,11 @@ training learner through the TCP ingest path. Upstream never tests its
 distributed mode at all (SURVEY §4).
 """
 
-import dataclasses
-import json
-import os
 import socket
-import subprocess
-import sys
 import threading
 import time
 
 import numpy as np
-import pytest
 
 from scalable_agent_tpu.runtime import remote, ring_buffer
 from scalable_agent_tpu.structs import (
@@ -123,6 +117,7 @@ def _run_learner_with_remote_child(tmp_path, base, child_actors,
   its unrolls (num_actors=0 locally), assert the wire fed every
   consumed trajectory and the child exited cleanly. Returns the
   TrainRun."""
+  import _remote_actor_child
   from scalable_agent_tpu import driver
   from scalable_agent_tpu.config import Config
 
@@ -130,21 +125,8 @@ def _run_learner_with_remote_child(tmp_path, base, child_actors,
     port = s.getsockname()[1]
   learner_cfg = Config(logdir=str(tmp_path), num_actors=0,
                        remote_actor_port=port, **base)
-  child_overrides = dict(base, num_actors=child_actors)
-
-  repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-  env = dict(os.environ)
-  env.pop('XLA_FLAGS', None)  # child provisions nothing special
-  # Script-run children resolve sys.path from the script dir, not cwd.
-  existing = env.get('PYTHONPATH', '')
-  env['PYTHONPATH'] = (repo + os.pathsep + existing if existing
-                       else repo)
-  child = subprocess.Popen(
-      [sys.executable, os.path.join(repo, 'tests',
-                                    '_remote_actor_child.py'),
-       f'127.0.0.1:{port}', json.dumps(child_overrides)],
-      cwd=repo, env=env, stdout=subprocess.PIPE,
-      stderr=subprocess.STDOUT, text=True)
+  child = _remote_actor_child.spawn(f'127.0.0.1:{port}',
+                                    dict(base, num_actors=child_actors))
   try:
     run = driver.train(learner_cfg, max_steps=max_steps,
                        stall_timeout_secs=120)
@@ -246,9 +228,19 @@ def test_remote_actor_reconnects_after_learner_restart():
     buffer_a.close()
 
     # Learner restarts on the SAME port with a fresh buffer/params.
+    # Bind-retry: the actor's reconnect attempts can transiently hold
+    # the port (ephemeral-source reuse / TIME_WAIT) right after A's
+    # close.
     buffer_b = ring_buffer.TrajectoryBuffer(8)
-    server_b = remote.TrajectoryIngestServer(
-        buffer_b, params, host='127.0.0.1', port=port)
+    deadline_b = time.time() + 60
+    while True:
+      try:
+        server_b = remote.TrajectoryIngestServer(
+            buffer_b, params, host='127.0.0.1', port=port)
+        break
+      except OSError:
+        assert time.time() < deadline_b, 'port never freed'
+        time.sleep(0.5)
     try:
       # The actor stops after 6 ACKED unrolls. Server A may have acked
       # up to 2 extra unrolls in the close race (they died with
