@@ -30,7 +30,8 @@ WORKDIR /app
 COPY scalable_agent_tpu/ scalable_agent_tpu/
 COPY tests/ tests/
 COPY scripts/ scripts/
-COPY experiment.py bench.py __graft_entry__.py README.md ./
+COPY docs/ docs/
+COPY experiment.py bench.py __graft_entry__.py README.md LICENSE ./
 
 # Native host batcher (ctypes; no TF/pybind dependency).
 RUN make -C scalable_agent_tpu/ops/batcher
