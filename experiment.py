@@ -90,6 +90,10 @@ flags.DEFINE_enum('env_backend', _DEFAULTS.env_backend,
                   ['dmlab', 'atari', 'fake', 'bandit', 'cue_memory'],
                   'Environment backend (fake/bandit/cue_memory are '
                   'simulator-free smoke tasks).')
+flags.DEFINE_float('sticky_action_prob', _DEFAULTS.sticky_action_prob,
+                   'Atari: per-frame previous-action repeat '
+                   'probability (0.25 = Machado et al. evaluation '
+                   'protocol).', lower_bound=0.0, upper_bound=1.0)
 flags.DEFINE_enum('torso', _DEFAULTS.torso, ['deep', 'shallow'],
                   'Agent torso: deep ResNet (reference) or the '
                   "paper's shallow CNN.")

@@ -55,6 +55,11 @@ class Config:
   env_backend: str = 'dmlab'              # dmlab | atari | fake |
                                           # bandit | cue_memory
   num_actions: Optional[int] = None       # backend default when None
+  sticky_action_prob: float = 0.0         # Atari: per-frame previous-
+                                          # action repeat prob (0.25 =
+                                          # Machado et al. eval
+                                          # protocol; 0 = reference-era
+                                          # deterministic)
   episode_length: int = 100               # fake/bandit only (cue_memory
                                           # is fixed two-step episodes)
   use_py_process: bool = True             # host each env in its own process

@@ -56,11 +56,28 @@ class AtariEnv(base.Environment):
                noop_max: int = DEFAULT_NOOP_MAX,
                full_action_set: bool = True, is_test: bool = False,
                num_actions: Optional[int] = None,
+               sticky_action_prob: float = 0.0,
                ale: Optional[object] = None):
-    """`ale` injects a backend (testing); otherwise ale_py/gymnasium."""
+    """`ale` injects a backend (testing); otherwise ale_py/gymnasium.
+
+    sticky_action_prob: per-FRAME probability that the previous
+    executed action repeats instead of the policy's (Machado et al.
+    2018 evaluation protocol, ς = 0.25). Implemented host-side in the
+    adapter — backends run with their own stochastic repeat disabled —
+    so it is deterministic under the env seed and testable without
+    ALE. 0.0 (default) matches the reference-era deterministic
+    protocol.
+    """
     self._h, self._w = height, width
     self._num_action_repeats = num_action_repeats
     self._noop_max = 0 if is_test else noop_max
+    self._sticky_prob = float(sticky_action_prob)
+    if not 0.0 <= self._sticky_prob <= 1.0:
+      # Fail fast: e.g. 25 meant-as-percent would otherwise make
+      # every frame repeat NOOP forever, silently degenerate training.
+      raise ValueError(
+          f'sticky_action_prob={sticky_action_prob} not in [0, 1]')
+    self._prev_exec_action = 0  # NOOP until the first step
     self._rng = np.random.RandomState(seed)
     self._instr = empty_instruction()
     self._ale = ale if ale is not None else _make_ale(
@@ -79,6 +96,7 @@ class AtariEnv(base.Environment):
 
   def _reset(self):
     self._ale.reset()
+    self._prev_exec_action = 0  # stickiness does not cross episodes
     for _ in range(self._rng.randint(self._noop_max + 1)
                    if self._noop_max else 0):
       self._ale.act(0)  # NOOP
@@ -105,7 +123,13 @@ class AtariEnv(base.Environment):
     raw_action = self._actions[a]
     reward = 0.0
     for _ in range(self._num_action_repeats):
-      reward += self._ale.act(raw_action)
+      if (self._sticky_prob and
+          self._rng.random_sample() < self._sticky_prob):
+        exec_action = self._prev_exec_action
+      else:
+        exec_action = raw_action
+      self._prev_exec_action = exec_action
+      reward += self._ale.act(exec_action)
       self._prev_raw = self._raw
       self._raw = self._ale.screen_rgb()
       if self._ale.game_over():

@@ -89,6 +89,7 @@ def make_env_spec(config: Config, level_name: str, seed: int,
                   height=config.height, width=config.width,
                   num_action_repeats=config.num_action_repeats,
                   is_test=is_test, num_actions=num_actions,
+                  sticky_action_prob=config.sticky_action_prob,
                   full_action_set=(
                       num_actions == atari.DEFAULT_NUM_ACTIONS))
     frame_shape = (config.height, config.width, 3)

@@ -164,6 +164,37 @@ def test_atari_num_actions_mismatch_fails_fast():
                  num_actions=4, noop_max=0, ale=FakeAle())
 
 
+def test_atari_sticky_actions():
+  """Machado et al. sticky actions, host-side: with prob 1.0 every
+  frame repeats the previous EXECUTED action — after a reset that is
+  NOOP(0) forever, regardless of the policy's choice; with prob 0.0
+  the policy's action always executes."""
+
+  class RecordingAle(FakeAle):
+    def __init__(self):
+      super().__init__(episode_len=10**6)
+      self.acts = []
+
+    def act(self, action):
+      self.acts.append(action)
+      return super().act(action)
+
+  ale = RecordingAle()
+  env = atari.AtariEnv('pong', seed=0, height=24, width=32,
+                       num_action_repeats=4, noop_max=0,
+                       sticky_action_prob=1.0, ale=ale)
+  env.step(2)
+  env.step(3)
+  assert ale.acts == [0] * 8  # fully sticky: NOOP carried from reset
+
+  ale2 = RecordingAle()
+  env2 = atari.AtariEnv('pong', seed=0, height=24, width=32,
+                        num_action_repeats=4, noop_max=0,
+                        sticky_action_prob=0.0, ale=ale2)
+  env2.step(2)
+  assert ale2.acts == [2] * 4
+
+
 def test_atari_noop_starts_bounded():
   ale = FakeAle(episode_len=1000)
   atari.AtariEnv('pong', seed=123, height=24, width=32,

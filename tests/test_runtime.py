@@ -176,6 +176,58 @@ class TestInferenceServer:
     finally:
       server.close()
 
+  def test_concurrent_param_updates_under_load(self):
+    """Publisher hammering update_params while actor threads infer:
+    the params pointer swap, the PRNG key lock, and the batcher must
+    hold up under churn (the production cadence is one publish per
+    learner step against ~48 inferring actors)."""
+    agent, params, cfg = _mk(
+        batch_size=4, unroll_length=8, num_action_repeats=1,
+        inference_min_batch=1, inference_max_batch=8,
+        inference_timeout_ms=5)
+    server = InferenceServer(agent, params, cfg, seed=5)
+    stop = threading.Event()
+    try:
+      actors = [
+          Actor(FakeEnv(height=H, width=W, num_actions=A, seed=i),
+                server.policy, agent.initial_state(1), 8)
+          for i in range(3)]
+
+      def publisher():
+        i = 0
+        while not stop.is_set():
+          scale = 1.0 + (i % 5) * 0.1
+          server.update_params(jax.tree_util.tree_map(
+              lambda x: x * scale, params))
+          i += 1
+          time.sleep(0.005)
+
+      pub = threading.Thread(target=publisher, daemon=True)
+      pub.start()
+      unrolls = [[] for _ in actors]
+
+      def run(i):
+        for _ in range(3):
+          unrolls[i].append(actors[i].unroll())
+
+      threads = [threading.Thread(target=run, args=(i,))
+                 for i in range(3)]
+      for t in threads:
+        t.start()
+      for t in threads:
+        t.join(timeout=120)
+      stop.set()
+      pub.join(timeout=10)
+      for lst in unrolls:
+        assert len(lst) == 3
+        for u in lst:
+          assert np.isfinite(
+              np.asarray(u.agent_outputs.policy_logits)).all()
+      assert server.stats()['params_version'] > 3
+    finally:
+      stop.set()
+      server.close()
+
   def test_update_params_is_picked_up(self):
     agent, params, cfg = _mk(inference_timeout_ms=5)
     server = InferenceServer(agent, params, cfg)
