@@ -43,6 +43,11 @@ flags.DEFINE_string('learner_address', _DEFAULTS.learner_address,
 flags.DEFINE_integer('remote_actor_port', _DEFAULTS.remote_actor_port,
                      'Learner: listen for remote actor hosts on this '
                      'port (0 = disabled).')
+flags.DEFINE_string('remote_actor_bind_host',
+                    _DEFAULTS.remote_actor_bind_host,
+                    'Learner: interface the ingest server binds. The '
+                    'wire is unauthenticated pickle — bind a cluster-'
+                    'internal interface in any shared network.')
 flags.DEFINE_float('actor_reconnect_secs',
                    _DEFAULTS.actor_reconnect_secs,
                    'Actor: on disconnect, retry the learner for this '

@@ -111,6 +111,12 @@ class Config:
   # §3.4): learner listens on this port for actor-host connections
   # (0 = disabled); actor hosts point learner_address at it.
   remote_actor_port: int = 0
+  # Interface the ingest server binds. The wire is pickle (arbitrary
+  # code execution for anyone who can reach the port — same trust
+  # model as the reference's unauthenticated TF gRPC runtime), so
+  # operators should bind a cluster-internal interface rather than
+  # the all-interfaces default.
+  remote_actor_bind_host: str = '0.0.0.0'
   learner_address: str = ''
   # Min seconds between param snapshots published to remote hosts (a
   # publish is a full device_get; remote staleness ~ this value).

@@ -251,16 +251,22 @@ def train(config: Config, max_steps: Optional[int] = None,
     from scalable_agent_tpu.runtime import remote
     ingest = remote.TrajectoryIngestServer(
         buffer, jax.device_get(state.params),
+        host=config.remote_actor_bind_host,
         port=config.remote_actor_port)
     log.info('remote-actor ingest listening on port %d', ingest.port)
 
   # Setup from here to the main loop's try/finally can raise (env
-  # construction, 20–40 s inference compiles): the already-listening
+  # construction, 20–40 s inference compiles, fleet.start's make_actor
+  # spawning env processes on this thread): the already-listening
   # ingest must not outlive a failed train() — a bound zombie port
   # serving stale v1 params would break retries in the same process —
   # and neither must the inference server (batcher thread + warmed
-  # params/executables resident on the chip).
+  # params/executables resident on the chip), the prefetcher thread,
+  # or a half-started fleet's env processes.
   server = None
+  fleet = None
+  prefetcher = None
+  writer = None
   try:
     # --- Inference server (weights served host-side to actor
     # threads). Per-process seed offset: params/init use config.seed
@@ -322,15 +328,25 @@ def train(config: Config, max_steps: Optional[int] = None,
     run = TrainRun(config, agent, state, fleet, prefetcher, server,
                    checkpointer, writer, stats, fps_meter,
                    ingest=ingest)
+    fleet.start()
   except BaseException:
+    if fleet is not None:
+      fleet.stop(timeout=2.0)
     buffer.close()
+    if prefetcher is not None:
+      prefetcher.close()
     if server is not None:
       server.close()
     if ingest is not None:
-      ingest.close()
+      # Setup failure = crash semantics: remote actors keep their
+      # reconnect window for the supervisor's retry (graceful=True
+      # would 'bye' them into permanent exit — see the main finally).
+      ingest.close(graceful=False)
+    if writer is not None:
+      writer.close()
+    checkpointer.close()
     raise
 
-  fleet.start()
   steps_done = 0
   profiling = False
   errors: List[BaseException] = []

@@ -41,7 +41,8 @@ def step_output_specs(height, width, instr_len):
 
 
 class Environment:
-  """Base class; subclasses implement reset_episode/step_episode."""
+  """Base class; subclasses implement `initial`/`step` (action-repeat
+  and auto-reset live inside `step`) and declare `_tensor_specs`."""
 
   def initial(self):
     raise NotImplementedError
