@@ -27,6 +27,32 @@ import orbax.checkpoint as ocp
 from scalable_agent_tpu.learner import TrainState
 
 
+class CheckpointStructureError(ValueError):
+  """The latest checkpoint's tree structure does not match the state
+  built from the current config (see the message for likely flags)."""
+
+
+def _wrap_structure_error(e, directory, step):
+  """Re-raise a restore failure with the likely config-flag causes.
+
+  The agent's param-tree STRUCTURE is a function of the config
+  (VERDICT r2 W7): the raw Orbax mismatch error names neither the flag
+  nor the fix, so operators hitting the documented migration footgun
+  (`config.use_instruction` None-auto) got a dead end."""
+  raise CheckpointStructureError(
+      f'could not restore checkpoint step {step} from {directory}: '
+      f'{e}\n'
+      'If this is a tree-structure mismatch, the param tree is a '
+      'function of the config. Usual cause: --use_instruction '
+      '(default None = auto by level name — a checkpoint trained with '
+      'the instruction encoder needs an explicit '
+      '--use_instruction=true when resumed/evaluated on a '
+      'non-language level, and vice versa). Also structure-changing: '
+      '--torso, --use_popart, --pixel_control_cost. Compare your '
+      "flags against the run's config.json saved next to the "
+      'checkpoints.') from e
+
+
 class Checkpointer:
   """Thin lifecycle wrapper over an Orbax CheckpointManager.
 
@@ -116,8 +142,11 @@ class Checkpointer:
       return ocp.utils.to_shape_dtype_struct(x)
 
     abstract = jax.tree_util.tree_map(to_abstract, target)
-    return self._manager.restore(
-        step, args=ocp.args.StandardRestore(abstract))
+    try:
+      return self._manager.restore(
+          step, args=ocp.args.StandardRestore(abstract))
+    except (ValueError, KeyError, TypeError) as e:
+      _wrap_structure_error(e, self._directory, step)
 
   def restore_latest_params(self, params, make_state):
     """Restore ONLY params (+ the update_steps counter) from the latest
@@ -160,8 +189,11 @@ class Checkpointer:
     # layout stays Orbax's concern, not ours.
     manager = ocp.CheckpointManager(self._directory)
     try:
-      restored = manager.restore(step,
-                                 args=ocp.args.PyTreeRestore(target))
+      try:
+        restored = manager.restore(step,
+                                   args=ocp.args.PyTreeRestore(target))
+      except (ValueError, KeyError, TypeError) as e:
+        _wrap_structure_error(e, self._directory, step)
     finally:
       manager.close()
     return restored.params, int(jax.device_get(restored.update_steps))

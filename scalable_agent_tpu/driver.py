@@ -227,7 +227,13 @@ def train(config: Config, max_steps: Optional[int] = None,
   checkpointer = checkpoint_lib.Checkpointer(
       config.logdir + '/checkpoints',
       save_interval_secs=config.checkpoint_secs)
-  restored = checkpointer.restore_latest(state)
+  try:
+    restored = checkpointer.restore_latest(state)
+  except BaseException:
+    # A structure-mismatch raise must not leak the manager (its
+    # background threads survive a same-process retry).
+    checkpointer.close()
+    raise
   if restored is not None:
     state = restored
     log.info('restored checkpoint at step %d',
@@ -330,21 +336,30 @@ def train(config: Config, max_steps: Optional[int] = None,
                    ingest=ingest)
     fleet.start()
   except BaseException:
-    if fleet is not None:
-      fleet.stop(timeout=2.0)
-    buffer.close()
-    if prefetcher is not None:
-      prefetcher.close()
-    if server is not None:
-      server.close()
+    # Best-effort bounded teardown, most-critical-first: the ingest
+    # port release leads (a second interrupt landing mid-cleanup must
+    # not leave the bound zombie port), slow thread joins go last, and
+    # one failing step must not skip the rest.
+    def _try(fn):
+      try:
+        fn()
+      except Exception:
+        log.exception('train() setup-failure cleanup step failed')
     if ingest is not None:
       # Setup failure = crash semantics: remote actors keep their
       # reconnect window for the supervisor's retry (graceful=True
       # would 'bye' them into permanent exit — see the main finally).
-      ingest.close(graceful=False)
+      _try(lambda: ingest.close(graceful=False))
+    _try(buffer.close)
+    if prefetcher is not None:
+      _try(prefetcher.close)
+    if server is not None:
+      _try(server.close)
+    if fleet is not None:
+      _try(lambda: fleet.stop(timeout=2.0))
     if writer is not None:
-      writer.close()
-    checkpointer.close()
+      _try(writer.close)
+    _try(checkpointer.close)
     raise
 
   steps_done = 0
@@ -554,26 +569,39 @@ def evaluate(config: Config,
   checkpointer = checkpoint_lib.Checkpointer(
       config.logdir + '/checkpoints')
   # Params-only restore: eval never materializes the RMSProp moments
-  # (≈2× params) — see Checkpointer.restore_latest_params.
-  restored = checkpointer.restore_latest_params(
-      params,
-      lambda p: learner_lib.make_train_state(
-          p, config, len(train_levels) if config.use_popart else 0))
+  # (≈2× params) — see Checkpointer.restore_latest_params. The manager
+  # closes on the raise path too (structure-mismatch guidance lives in
+  # checkpoint._wrap_structure_error).
+  try:
+    restored = checkpointer.restore_latest_params(
+        params,
+        lambda p: learner_lib.make_train_state(
+            p, config, len(train_levels) if config.use_popart else 0))
+  finally:
+    checkpointer.close()
   if restored is None:
     raise FileNotFoundError(
         f'no checkpoint under {config.logdir}/checkpoints')
   params, restored_steps = restored
-  checkpointer.close()
 
-  server = InferenceServer(agent, params, config,
-                           seed=config.seed + 2000)
-  server.warmup(spec0.obs_spec, max_size=len(test_levels))
-  buffer = ring_buffer.TrajectoryBuffer(
-      max(2 * len(test_levels), 2))
+  # Same setup-failure guard as train(): a make_fleet raise (env
+  # construction) must not leak the warmed inference server.
+  server = None
+  fleet = None
+  try:
+    server = InferenceServer(agent, params, config,
+                             seed=config.seed + 2000)
+    server.warmup(spec0.obs_spec, max_size=len(test_levels))
+    buffer = ring_buffer.TrajectoryBuffer(
+        max(2 * len(test_levels), 2))
 
-  fleet = make_fleet(config, agent, server.policy, buffer, test_levels,
-                     seed_base=config.seed - 1, is_test=True,
-                     num_actors=len(test_levels))
+    fleet = make_fleet(config, agent, server.policy, buffer,
+                       test_levels, seed_base=config.seed - 1,
+                       is_test=True, num_actors=len(test_levels))
+  except BaseException:
+    if server is not None:
+      server.close()
+    raise
   level_returns: Dict[str, List[float]] = {
       name: [] for name in train_levels}
 
