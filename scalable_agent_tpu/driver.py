@@ -243,37 +243,41 @@ def train(config: Config, max_steps: Optional[int] = None,
   # dispatch pipeline each step).
   _initial_steps = int(jax.device_get(state.update_steps))
 
-  # --- Trajectory buffer + remote ingest, BEFORE inference warmup:
-  # remote actor hosts connect and fetch params while this host spends
-  # its 20–40 s compiling, instead of timing out against a closed port
-  # (reference's learner-hosted shared FIFOQueue that remote actors
-  # enqueue into, ≈L470/SURVEY §3.4 — remote unrolls land in the SAME
-  # buffer as the local fleet's, so downstream is source-oblivious). ---
-  capacity = max(config.queue_capacity_batches * config.batch_size,
-                 config.batch_size)
-  buffer = ring_buffer.TrajectoryBuffer(capacity)
+  # Setup from here to the main loop's try/finally can raise (port
+  # binds, env construction, 20–40 s inference compiles, fleet.start's
+  # make_actor spawning env processes on this thread): the
+  # already-listening ingest must not outlive a failed train() — a
+  # bound zombie port serving stale v1 params would break retries in
+  # the same process — and neither must the inference server (batcher
+  # thread + warmed params/executables resident on the chip), the
+  # prefetcher thread, a half-started fleet's env processes, or the
+  # checkpoint manager's background threads.
+  buffer = None
   ingest = None
-  if config.remote_actor_port:
-    from scalable_agent_tpu.runtime import remote
-    ingest = remote.TrajectoryIngestServer(
-        buffer, jax.device_get(state.params),
-        host=config.remote_actor_bind_host,
-        port=config.remote_actor_port)
-    log.info('remote-actor ingest listening on port %d', ingest.port)
-
-  # Setup from here to the main loop's try/finally can raise (env
-  # construction, 20–40 s inference compiles, fleet.start's make_actor
-  # spawning env processes on this thread): the already-listening
-  # ingest must not outlive a failed train() — a bound zombie port
-  # serving stale v1 params would break retries in the same process —
-  # and neither must the inference server (batcher thread + warmed
-  # params/executables resident on the chip), the prefetcher thread,
-  # or a half-started fleet's env processes.
   server = None
   fleet = None
   prefetcher = None
   writer = None
   try:
+    # --- Trajectory buffer + remote ingest, BEFORE inference warmup:
+    # remote actor hosts connect and fetch params while this host
+    # spends its 20–40 s compiling, instead of timing out against a
+    # closed port (reference's learner-hosted shared FIFOQueue that
+    # remote actors enqueue into, ≈L470/SURVEY §3.4 — remote unrolls
+    # land in the SAME buffer as the local fleet's, so downstream is
+    # source-oblivious). ---
+    capacity = max(config.queue_capacity_batches * config.batch_size,
+                   config.batch_size)
+    buffer = ring_buffer.TrajectoryBuffer(capacity)
+    if config.remote_actor_port:
+      from scalable_agent_tpu.runtime import remote
+      ingest = remote.TrajectoryIngestServer(
+          buffer, jax.device_get(state.params),
+          host=config.remote_actor_bind_host,
+          port=config.remote_actor_port,
+          contract=remote.trajectory_contract(config, agent,
+                                              num_actions))
+      log.info('remote-actor ingest listening on port %d', ingest.port)
     # --- Inference server (weights served host-side to actor
     # threads). Per-process seed offset: params/init use config.seed
     # IDENTICALLY on every host (multi-host device_put asserts
@@ -350,7 +354,8 @@ def train(config: Config, max_steps: Optional[int] = None,
       # reconnect window for the supervisor's retry (graceful=True
       # would 'bye' them into permanent exit — see the main finally).
       _try(lambda: ingest.close(graceful=False))
-    _try(buffer.close)
+    if buffer is not None:
+      _try(buffer.close)
     if prefetcher is not None:
       _try(prefetcher.close)
     if server is not None:
