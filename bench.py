@@ -159,4 +159,9 @@ def main():
 
 
 if __name__ == '__main__':
+  # Before any JAX initialization, but inside the main guard: the
+  # forkserver preloads __main__, so a module-level call would
+  # recursively spawn a second server (see runtime/py_process.py).
+  from scalable_agent_tpu.runtime.py_process import warm_forkserver
+  warm_forkserver()
   main()

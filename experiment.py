@@ -159,6 +159,11 @@ def main(argv):
   logging.basicConfig(
       level=logging.INFO,
       format='%(asctime)s %(name)s %(levelname)s %(message)s')
+  # Before the driver/JAX imports below: the one-time fork creating
+  # the forkserver (default env-process start method) must happen
+  # while this process is still quiet — see runtime/py_process.py.
+  from scalable_agent_tpu.runtime.py_process import warm_forkserver
+  warm_forkserver()
   # Preemption safety: SIGTERM (k8s eviction, TPU-VM maintenance)
   # must run driver.train's finally block — final checkpoint save and
   # clean fleet/batcher shutdown — not kill the process mid-step. The

@@ -24,10 +24,18 @@ to wrap because on the TPU build env stepping is host Python already
 - `start_all` / `close_all` start/stop fleets via a thread pool — the
   reference's `PyProcessHook.begin/end` (≈L190–230) without the session.
 
-Start method: `fork` by default (the reference's multiprocessing
-default on Linux) — workers are numpy-only, so they never touch the
-parent's JAX/TPU state; `spawn` is available for classes that need a
-pristine interpreter.
+Start method: `forkserver` by default. The driver builds env processes
+AFTER JAX's inference warmup, i.e. from a parent already running JAX
+thread pools — a plain `fork` there copies whatever mutexes happen to
+be locked (Python 3.12 warns exactly about this), the classic
+once-a-week CI hang. With forkserver, children are forked from the
+clean single-threaded server process instead; call `warm_forkserver()`
+as early as possible (before JAX spins up) so the one-time fork that
+creates the server itself happens from a still-quiet parent.
+Constructor kwargs and the hosted class must be picklable (module
+level). `fork` remains available as an explicit opt-in for
+unpicklable fixtures; `spawn` for classes needing a pristine
+interpreter.
 """
 
 import multiprocessing
@@ -36,6 +44,15 @@ import traceback
 from multiprocessing.pool import ThreadPool
 
 import numpy as np
+
+DEFAULT_START_METHOD = 'forkserver'
+
+
+def warm_forkserver():
+  """Start the forkserver process now (idempotent). Best called before
+  any JAX import/initialization — see the module docstring."""
+  from multiprocessing import forkserver
+  forkserver.ensure_running()
 
 
 class ProcessClosed(Exception):
@@ -147,16 +164,19 @@ class PyProcess:
     type_: class to instantiate in the child. If it defines
       `_tensor_specs(method_name, kwargs, constructor_kwargs)` (static),
       replies are validated against the returned spec pytree.
-    constructor_kwargs: kwargs for the child-side constructor.
-    context: multiprocessing start method ('fork' default, or 'spawn').
+    constructor_kwargs: kwargs for the child-side constructor (must be
+      picklable under the default start method).
+    context: multiprocessing start method (None = the module default,
+      'forkserver'; 'fork'/'spawn' as explicit opt-ins).
     validate_specs: disable to skip reply validation (hot-path opt-out).
   """
 
-  def __init__(self, type_, constructor_kwargs=None, context='fork',
+  def __init__(self, type_, constructor_kwargs=None, context=None,
                validate_specs=True):
     self._type = type_
     self._constructor_kwargs = dict(constructor_kwargs or {})
-    self._ctx = multiprocessing.get_context(context)
+    self._ctx = multiprocessing.get_context(
+        context or DEFAULT_START_METHOD)
     self._validate = validate_specs and hasattr(type_, '_tensor_specs')
     self._conn = None
     self._process = None

@@ -345,11 +345,17 @@ class TrajectoryIngestServer:
     """Swap in a new host param snapshot; returns the new version.
     Call with numpy trees (device_get first). Serializes ONCE, here
     on the caller (learner-loop) thread — handler threads only ship
-    the cached bytes."""
+    the cached bytes. The pickle runs OUTSIDE the lock (handlers'
+    acks/get_params must not stall behind it); a handler reading the
+    previous blob between the version bump and the swap just triggers
+    one redundant client refetch."""
     with self._params_lock:
       self._version += 1
-      self._params_blob = self._make_blob(self._version, params)
-      return self._version
+      version = self._version
+    blob = self._make_blob(version, params)
+    with self._params_lock:
+      self._params_blob = blob
+    return version
 
   @property
   def serializations(self) -> int:
@@ -419,10 +425,14 @@ class TrajectoryIngestServer:
           conn.send_bytes(self._snapshot_blob())
         elif kind == 'unroll':
           if not handshaken:
-            conn.send(('reject',
+            # 'error', not 'reject': legacy (protocol-1) clients only
+            # special-case 'bye'/'error' — a 'reject' here would parse
+            # as a successful ack and they would silently drop every
+            # unroll forever instead of failing loudly.
+            conn.send(('error',
                        'unroll before a successful hello handshake — '
                        'upgrade/fix the actor host'))
-            return
+            continue
           if self._contract is not None:
             problems = unroll_violations(msg[1], self._contract)
             if problems:

@@ -17,6 +17,12 @@ if '--xla_force_host_platform_device_count' not in _flags:
       _flags + ' --xla_force_host_platform_device_count=8').strip()
 os.environ['JAX_PLATFORMS'] = 'cpu'
 
+# Warm the forkserver (default PyProcess start method) while this
+# process is still single-threaded — before jax exists.
+from scalable_agent_tpu.runtime.py_process import warm_forkserver  # noqa: E402
+
+warm_forkserver()
+
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')

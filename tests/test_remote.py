@@ -175,9 +175,15 @@ def test_unroll_before_handshake_rejected():
   client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
                                     connect_timeout_secs=10)
   try:
-    with pytest.raises(remote.ContractMismatch, match='handshake'):
+    # Plain 'error' frame (RuntimeError), NOT 'reject': legacy clients
+    # only special-case 'bye'/'error' — they must fail loudly too.
+    with pytest.raises(RuntimeError, match='handshake'):
       client.send_unroll(_conforming_unroll(cfg, agent, 3))
     assert len(buffer) == 0
+    # The connection survives; a handshake afterwards unblocks it.
+    client.handshake(contract)
+    assert client.send_unroll(_conforming_unroll(cfg, agent, 3)) == 1
+    assert len(buffer) == 1
   finally:
     client.close()
     server.close()
