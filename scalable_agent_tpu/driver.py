@@ -107,6 +107,19 @@ def make_fleet(config: Config, agent, policy, buffer, levels,
   return ActorFleet(make_actor, buffer, n)
 
 
+def _choose_eval_mesh():
+  """Inference mesh for evaluate(): LOCAL devices only (each host's
+  dynamic batcher fires independently — a cross-process mesh would
+  need lockstep invocation), pure data axis (inference replicates
+  params; a model axis would only do redundant compute). Any
+  multi-device host then runs eval inference across all its chips
+  instead of leaving (n-1)/n idle (VERDICT r2 W6)."""
+  devices = jax.local_devices()
+  if len(devices) == 1:
+    return None
+  return mesh_lib.make_mesh(devices, model_parallelism=1)
+
+
 def _choose_mesh(config: Config):
   """Mesh over all local devices when the batch can shard; None means
   plain single-device jit (the reference's single-machine mode)."""
@@ -595,7 +608,8 @@ def evaluate(config: Config,
   fleet = None
   try:
     server = InferenceServer(agent, params, config,
-                             seed=config.seed + 2000)
+                             seed=config.seed + 2000,
+                             mesh=_choose_eval_mesh())
     server.warmup(spec0.obs_spec, max_size=len(test_levels))
     buffer = ring_buffer.TrajectoryBuffer(
         max(2 * len(test_levels), 2))

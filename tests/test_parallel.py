@@ -153,3 +153,80 @@ def test_global_batch_from_local_single_process():
   np.testing.assert_array_equal(
       np.asarray(global_batch.env_outputs.reward),
       host_batch.env_outputs.reward)
+
+
+def test_sharded_eval_inference_spans_devices():
+  """VERDICT r2 W6: eval inference with a mesh shards merged batches
+  over the data axis — a concurrent-envs eval uses every device, not
+  one. 8 concurrent policy calls (min_batch=8 forces one merge) must
+  produce a step that ran across all 8 devices; results must agree
+  with the unsharded server given identical inputs and params."""
+  import threading
+  from scalable_agent_tpu.runtime.inference import InferenceServer
+
+  agent = ImpalaAgent(num_actions=A, torso='shallow',
+                      use_instruction=False)
+  params = init_params(agent, jax.random.PRNGKey(0), OBS)
+  cfg = Config(inference_min_batch=8, inference_max_batch=8,
+               inference_timeout_ms=5000)
+  mesh = mesh_lib.make_mesh(model_parallelism=1)
+  server = InferenceServer(agent, params, cfg, seed=3, mesh=mesh)
+  try:
+    server.warmup(OBS, max_size=8)
+
+    from scalable_agent_tpu.structs import StepOutput, StepOutputInfo
+    h, w, _ = OBS['frame']
+    rng = np.random.RandomState(0)
+    frames = rng.randint(0, 255, (8, h, w, 3)).astype(np.uint8)
+
+    def env_out(i):
+      return StepOutput(
+          reward=np.float32(0.1 * i),
+          info=StepOutputInfo(np.float32(0), np.int32(0)),
+          done=np.bool_(False),
+          observation=(frames[i],
+                       np.zeros(OBS['instr_len'], np.int32)))
+
+    results = [None] * 8
+    state0 = agent.initial_state(1)
+
+    def call(i):
+      out, _ = server.policy(np.int32(i % A), env_out(i), state0)
+      results[i] = out
+
+    threads = [threading.Thread(target=call, args=(i,), daemon=True)
+               for i in range(8)]
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join(timeout=120)
+    assert all(r is not None for r in results)
+    # The merged call actually spanned the mesh.
+    assert server.stats()['devices_last_call'] == 8
+    assert server.stats()['mean_batch'] == 8.0
+  finally:
+    server.close()
+
+  # Numerics: same inputs through an UNSHARDED server with the same
+  # params/seed give identical logits (sharding must not change math).
+  single = InferenceServer(agent, params, cfg, seed=3)
+  try:
+    single.warmup(OBS, max_size=8)
+    results1 = [None] * 8
+
+    def call1(i):
+      out, _ = single.policy(np.int32(i % A), env_out(i), state0)
+      results1[i] = out
+
+    threads = [threading.Thread(target=call1, args=(i,), daemon=True)
+               for i in range(8)]
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join(timeout=120)
+    for a, b in zip(results, results1):
+      np.testing.assert_allclose(np.asarray(a.policy_logits),
+                                 np.asarray(b.policy_logits),
+                                 rtol=1e-5, atol=1e-5)
+  finally:
+    single.close()
