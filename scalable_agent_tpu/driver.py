@@ -131,19 +131,25 @@ def _choose_mesh(config: Config):
     raise ValueError(
         f'model_parallelism={mp} does not divide the device count '
         f'{len(devices)}')
-  dp = len(devices) // mp
-  if config.batch_size % dp != 0:
+  # Multi-host TP shards the batch over BOTH mesh axes (see
+  # mesh.batch_shardings), so the batch must divide the full device
+  # count there; otherwise only the data width.
+  if mesh_lib.shard_batch_over_model(config):
+    batch_width = len(devices)
+  else:
+    batch_width = len(devices) // mp
+  if config.batch_size % batch_width != 0:
     if jax.process_count() > 1:
       # Multi-host: the fallback would leave every host training an
       # independent, never-synchronized replica against a shared
       # logdir — silently wrong training. Refuse.
       raise ValueError(
           f'batch_size={config.batch_size} not divisible by '
-          f'data-parallel width {dp}; single-device fallback is only '
-          'safe single-host')
-    log.warning('batch_size %d not divisible by data-parallel width %d;'
-                ' falling back to single-device training',
-                config.batch_size, dp)
+          f'batch-sharding width {batch_width}; single-device '
+          'fallback is only safe single-host')
+    log.warning('batch_size %d not divisible by batch-sharding width '
+                '%d; falling back to single-device training',
+                config.batch_size, batch_width)
     return None
   return mesh_lib.make_mesh(devices, model_parallelism=mp)
 

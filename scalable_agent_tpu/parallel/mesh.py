@@ -35,6 +35,19 @@ DATA_AXIS = 'data'
 MODEL_AXIS = 'model'
 
 
+def shard_batch_over_model(config) -> bool:
+  """Whether the learner batch must shard over the model axis too.
+
+  True exactly when TP spans hosts: trajectory transport is host-local
+  (each process supplies only its own fleet's rows), so model-axis
+  batch replication would demand bit-identical batches from different
+  hosts. The ONE predicate both the batch-divisibility check
+  (driver._choose_mesh) and the actual sharding choice
+  (train_parallel.make_sharded_train_step) consult — they must never
+  drift."""
+  return config.model_parallelism > 1 and jax.process_count() > 1
+
+
 def make_mesh(devices=None, model_parallelism: int = 1) -> Mesh:
   """Build a (data, model) mesh over the given (default: all) devices."""
   devices = devices if devices is not None else jax.devices()
@@ -103,20 +116,34 @@ def param_shardings(params, mesh: Mesh, enable_tp: bool = False):
   return jax.tree_util.tree_map_with_path(to_sharding, params)
 
 
-def batch_shardings(batch_pytree, mesh: Mesh):
+def batch_shardings(batch_pytree, mesh: Mesh,
+                    shard_over_model: bool = False):
   """Shard the learner batch over the data axis.
 
   Trajectory tensors are time-major [T+1, B, ...] → shard dim 1;
   level_name/agent_state are [B, ...] → shard dim 0. We key on rank
   via the structural position: ActorOutput(level_name, agent_state,
-  env_outputs, agent_outputs)."""
+  env_outputs, agent_outputs).
+
+  shard_over_model: shard the batch dim over BOTH axes instead of
+  replicating it across the model axis. Required when TP spans hosts:
+  trajectory transport is host-local (each process supplies only its
+  own fleet's rows to `make_array_from_process_local_data`), and
+  model-axis replication would demand bit-identical batches from
+  different hosts. With the batch fully sharded, every host feeds
+  distinct rows and GSPMD inserts the model-axis all-gather where the
+  TP matmuls need the full data shard — the collective rides
+  ICI/DCN, placed by the compiler (SURVEY §5.8)."""
   from scalable_agent_tpu.structs import ActorOutput
 
+  batch_axes = ((DATA_AXIS, MODEL_AXIS) if shard_over_model
+                else DATA_AXIS)
+
   def traj(x):
-    return NamedSharding(mesh, P(None, DATA_AXIS))
+    return NamedSharding(mesh, P(None, batch_axes))
 
   def lead(x):
-    return NamedSharding(mesh, P(DATA_AXIS))
+    return NamedSharding(mesh, P(batch_axes))
 
   return ActorOutput(
       level_name=lead(None),

@@ -52,7 +52,9 @@ def make_sharded_train_step(agent, config: Config, mesh: Mesh,
   trajectory transport (the reference's StagingArea role).
   """
   train_step = learner_lib.make_train_step_fn(agent, config)
-  batch_shard = mesh_lib.batch_shardings(example_batch, mesh)
+  batch_shard = mesh_lib.batch_shardings(
+      example_batch, mesh,
+      shard_over_model=mesh_lib.shard_batch_over_model(config))
   replicated = NamedSharding(mesh, P())
 
   jitted = jax.jit(

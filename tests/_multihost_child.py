@@ -15,6 +15,15 @@ default 'run'):
           opens a remote-actor ingest on port P and runs NO local
           actors (its batch shard arrives over TCP) while process 1
           keeps a local fleet; 3 steps, assert, exit 0.
+- tp4:    4 processes × 1 device, model_parallelism=2 — the model
+          axis PAIRS DEVICES FROM DIFFERENT PROCESSES (mesh rows
+          [[p0,p1],[p2,p3]]), so TP matmul collectives cross the
+          process boundary; 3 sharded steps on a deterministic batch
+          must match a single-device reference numerically.
+
+Topology knobs via env (the parent test sets them): MH_NPROCS
+(default 2), MH_NDEV devices per process (default 2), MH_BATCH
+(default 4).
 """
 
 import os
@@ -41,16 +50,21 @@ def main():
   port = sys.argv[2]
   logdir = sys.argv[3]
   mode = sys.argv[4] if len(sys.argv) > 4 else 'run'
-  os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=2'
+  nprocs = int(os.environ.get('MH_NPROCS', '2'))
+  ndev = int(os.environ.get('MH_NDEV', '2'))
+  batch = int(os.environ.get('MH_BATCH', '4'))
+  os.environ['XLA_FLAGS'] = (
+      f'--xla_force_host_platform_device_count={ndev}')
   import jax
   jax.config.update('jax_platforms', 'cpu')
-  jax.distributed.initialize(f'localhost:{port}', num_processes=2,
+  jax.distributed.initialize(f'localhost:{port}', num_processes=nprocs,
                              process_id=proc)
-  assert jax.device_count() == 4 and jax.local_device_count() == 2
+  assert jax.device_count() == nprocs * ndev
+  assert jax.local_device_count() == ndev
 
   from scalable_agent_tpu import driver
   from scalable_agent_tpu.config import Config
-  cfg = Config(logdir=logdir, **CHILD_CONFIG)
+  cfg = Config(logdir=logdir, **dict(CHILD_CONFIG, batch_size=batch))
 
   if mode == 'run':
     run = driver.train(cfg, max_steps=3, stall_timeout_secs=120)
@@ -70,6 +84,84 @@ def main():
     else:
       assert run.fleet.stats()['unrolls'] >= 3 * (cfg.batch_size // 2)
     print(f'child {proc}: mixed ok', flush=True)
+  elif mode == 'tp4':
+    import dataclasses
+    import numpy as np
+    import jax.numpy as jnp
+    from scalable_agent_tpu import learner as learner_lib
+    from scalable_agent_tpu.models import init_params
+    from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+    from scalable_agent_tpu.parallel import mesh as mesh_lib
+    from scalable_agent_tpu.parallel import train_parallel
+    from scalable_agent_tpu.testing import make_example_batch
+
+    assert nprocs == 4 and ndev == 1
+    cfg = dataclasses.replace(cfg, batch_size=4, model_parallelism=2)
+    num_actions = 3
+    agent = driver.build_agent(cfg, num_actions)
+    obs = {'frame': (cfg.height, cfg.width, 3),
+           'instr_len': MAX_INSTRUCTION_LEN}
+    params = init_params(agent, jax.random.PRNGKey(cfg.seed), obs)
+    mesh = mesh_lib.make_mesh(model_parallelism=2)  # [[p0,p1],[p2,p3]]
+    # The model pair (row of the mesh) must CROSS the process
+    # boundary — that is the point of this mode.
+    assert (mesh.devices[0, 0].process_index !=
+            mesh.devices[0, 1].process_index)
+
+    t1 = cfg.unroll_length + 1
+    batch = make_example_batch(t1, cfg.batch_size, cfg.height,
+                               cfg.width, num_actions,
+                               MAX_INSTRUCTION_LEN, seed=7,
+                               done_prob=0.1)
+    state = train_parallel.make_sharded_train_state(
+        params, cfg, mesh, enable_tp=True)
+    # TP placements are real: some kernel shards over the model axis.
+    tp_leaves = [x for x in jax.tree_util.tree_leaves(state.params)
+                 if 'model' in str(getattr(x.sharding, 'spec', ''))]
+    assert tp_leaves, 'no TP-sharded parameter found'
+    step, place = train_parallel.make_sharded_train_step(
+        agent, cfg, mesh, batch)
+
+    # This process's single row of the global batch (batch dim sharded
+    # over (data, model): shard index = data*mp + model = proc here).
+    host = jax.tree_util.tree_map(np.asarray, batch)
+    local = host._replace(
+        level_name=host.level_name[proc:proc + 1],
+        agent_state=jax.tree_util.tree_map(
+            lambda x: x[proc:proc + 1], host.agent_state),
+        env_outputs=jax.tree_util.tree_map(
+            lambda x: x[:, proc:proc + 1], host.env_outputs),
+        agent_outputs=jax.tree_util.tree_map(
+            lambda x: x[:, proc:proc + 1], host.agent_outputs))
+    dev_batch = place(local)
+    losses = []
+    for _ in range(3):
+      state, metrics = step(state, dev_batch)
+      losses.append(float(jax.device_get(metrics['total_loss'])))
+
+    @jax.jit
+    def checksum(p):
+      return jax.tree_util.tree_reduce(
+          lambda a, x: a + jnp.sum(jnp.abs(x.astype(jnp.float32))),
+          p, jnp.float32(0))
+
+    got_sum = float(jax.device_get(checksum(state.params)))
+
+    # Single-device reference on the same (deterministic) batch: the
+    # cross-process TP math must reproduce it.
+    params_ref = init_params(agent, jax.random.PRNGKey(cfg.seed), obs)
+    ref = learner_lib.make_train_state(params_ref, cfg)
+    ref_step = learner_lib.make_train_step(agent, cfg)
+    ref_losses = []
+    for _ in range(3):
+      ref, ref_metrics = ref_step(ref, batch)
+      ref_losses.append(float(jax.device_get(
+          ref_metrics['total_loss'])))
+    ref_sum = float(jax.device_get(checksum(ref.params)))
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(got_sum, ref_sum, rtol=2e-4)
+    print(f'child {proc}: tp4 ok', flush=True)
   elif mode == 'drill':
     # Frequent collective checkpoints; runs until the parent kills this
     # process or the runtime aborts us because the peer died.

@@ -31,7 +31,8 @@ def _free_ports(n):
   return ports
 
 
-def _spawn_children(logdir, port, extra_args=()):
+def _spawn_children(logdir, port, extra_args=(), nprocs=2,
+                    env_overrides=None):
   child = os.path.join(os.path.dirname(__file__), '_multihost_child.py')
   repo_root = os.path.dirname(os.path.dirname(child))
   env = {k: v for k, v in os.environ.items()
@@ -39,13 +40,15 @@ def _spawn_children(logdir, port, extra_args=()):
   existing = os.environ.get('PYTHONPATH', '')
   env['PYTHONPATH'] = (repo_root + os.pathsep + existing if existing
                        else repo_root)
+  env['MH_NPROCS'] = str(nprocs)
+  env.update(env_overrides or {})
   return [
       subprocess.Popen(
           [sys.executable, child, str(i), str(port), logdir,
            *extra_args],
           stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
           env=env, cwd=repo_root, text=True)
-      for i in range(2)]
+      for i in range(nprocs)]
 
 
 def _committed_steps(logdir):
@@ -124,26 +127,27 @@ def test_mixed_remote_and_local_sources(tmp_path):
   assert 'CHILD_OK' in actor_out, actor_out[-2000:]
 
 
-def test_kill_one_host_then_resume(tmp_path):
+def _kill_drill(tmp_path, nprocs, env_overrides=None):
   """Failure drill (VERDICT r1 W7): SIGKILL one host mid-run.
 
   What the system must guarantee (measured empirically: the
   coordination service detects the dead peer via heartbeat timeout and
-  terminates the survivor — there is no Python-level unwind to assert,
+  terminates the survivors — there is no Python-level unwind to assert,
   and crucially NO deadlock in the Orbax barrier):
 
-  1. the surviving process TERMINATES within bounded time (no hang in
+  1. the surviving processes TERMINATE within bounded time (no hang in
      a collective or the checkpoint barrier);
   2. the last collectively-committed checkpoint survives the crash
      (uncommitted tmp steps are ignored by restore);
-  3. a fresh two-process restart resumes from that checkpoint and
+  3. a fresh same-topology restart resumes from that checkpoint and
      keeps training.
   """
   logdir = str(tmp_path)
-  procs = _spawn_children(logdir, _free_port(), extra_args=('drill',))
+  procs = _spawn_children(logdir, _free_port(), extra_args=('drill',),
+                          nprocs=nprocs, env_overrides=env_overrides)
   committed = []
   try:
-    deadline = time.monotonic() + 180
+    deadline = time.monotonic() + 240
     while time.monotonic() < deadline:
       committed = _committed_steps(logdir)
       if committed:
@@ -151,13 +155,14 @@ def test_kill_one_host_then_resume(tmp_path):
       assert all(p.poll() is None for p in procs), \
           'a child died before the first checkpoint'
       time.sleep(0.5)
-    assert committed, 'no committed checkpoint within 180s'
+    assert committed, 'no committed checkpoint within 240s'
 
-    procs[1].kill()  # SIGKILL the non-coordinator host mid-run
-    # (1) Survivor terminates within bounded time. Its exit status is
-    # the runtime's abort-on-peer-failure, not ours to assert.
-    out0, _ = procs[0].communicate(timeout=240)
-    assert procs[0].poll() is not None
+    procs[-1].kill()  # SIGKILL a non-coordinator host mid-run
+    # (1) Survivors terminate within bounded time. Exit status is the
+    # runtime's abort-on-peer-failure, not ours to assert.
+    for p in procs[:-1]:
+      p.communicate(timeout=240)
+      assert p.poll() is not None
   finally:
     for p in procs:
       if p.poll() is None:
@@ -170,9 +175,10 @@ def test_kill_one_host_then_resume(tmp_path):
   resume_step = max(after)
   assert resume_step >= max(committed)
 
-  # (3) Fresh two-process restart resumes from it and trains on.
+  # (3) Fresh same-topology restart resumes from it and trains on.
   procs2 = _spawn_children(logdir, _free_port(),
-                           extra_args=('resume', str(resume_step)))
+                           extra_args=('resume', str(resume_step)),
+                           nprocs=nprocs, env_overrides=env_overrides)
   outs = []
   try:
     for p in procs2:
@@ -187,3 +193,40 @@ def test_kill_one_host_then_resume(tmp_path):
     assert p.returncode == 0, f'resume child {i} failed:\n{out[-3000:]}'
     assert f'resumed from {resume_step} to {resume_step + 2} ok' in out, \
         out[-2000:]
+
+
+def test_kill_one_host_then_resume(tmp_path):
+  _kill_drill(tmp_path, nprocs=2)
+
+
+def test_kill_one_host_then_resume_four_processes(tmp_path):
+  """The drill at 4 processes (VERDICT r2 W3: the matrix stopped at 2):
+  one dead host of four, three survivors terminate, 4-way restart
+  resumes. Global batch 8 → 1 row per device on the 8-device mesh."""
+  _kill_drill(tmp_path, nprocs=4, env_overrides={'MH_BATCH': '8'})
+
+
+def test_tp_across_process_boundary(tmp_path):
+  """VERDICT r2 W3: TP with the model axis CROSSING the process
+  boundary — 4 processes × 1 device, model_parallelism=2 pairs devices
+  of different processes, so the TP matmul all-gathers and gradient
+  psums ride cross-process collectives. The children assert the mesh
+  really crosses processes, that kernels are model-sharded, and that 3
+  sharded steps on a deterministic batch match a single-device
+  reference numerically."""
+  logdir = str(tmp_path)
+  procs = _spawn_children(logdir, _free_port(), extra_args=('tp4',),
+                          nprocs=4, env_overrides={'MH_NDEV': '1'})
+  outs = []
+  try:
+    for p in procs:
+      out, _ = p.communicate(timeout=280)
+      outs.append(out)
+  finally:
+    for p in procs:
+      if p.poll() is None:
+        p.kill()
+        p.communicate()
+  for i, (p, out) in enumerate(zip(procs, outs)):
+    assert p.returncode == 0, f'child {i} failed:\n{out[-3000:]}'
+    assert f'child {i}: tp4 ok' in out
