@@ -128,6 +128,181 @@ def bench_e2e(smoke):
   }
 
 
+def _transport_unroll(t1, h, w, num_actions=9):
+  """One realistic host-side unroll (numpy, flagship shapes)."""
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+  from scalable_agent_tpu.testing import make_example_unroll
+  return make_example_unroll(t1, h, w, num_actions,
+                             MAX_INSTRUCTION_LEN)
+
+
+def bench_transport(smoke):
+  """Host-transport ceiling with the TPU tunnel and the envs OUT of
+  the loop (VERDICT r2 Missing #1 / W4): what the host-side pipeline
+  pieces can sustain by themselves, at flagship row sizes (72x96x3
+  frames, T+1=101). Three stages, measured independently:
+
+  a) synthetic producer threads → TrajectoryBuffer → BatchPrefetcher
+     with a no-op place_fn (batch assembly/stacking included);
+  b) the C++ dynamic batcher standalone: concurrent batch-1 callers
+     through merge/split with a no-op computation, vs thread count;
+  c) TrajectoryIngestServer loopback: pickle TCP ingest, 1 and 4
+     connections.
+
+  All numbers are for THIS host (the docs' scaling arithmetic divides
+  by them); on the 1-core sandbox GIL contention is part of the
+  measurement, deliberately — that is the per-core constant.
+  """
+  import threading
+  import numpy as np
+  from scalable_agent_tpu.ops import dynamic_batching
+  from scalable_agent_tpu.runtime import remote, ring_buffer
+
+  t1 = 101 if not smoke else 6
+  h, w = (72, 96) if not smoke else (24, 32)
+  dur = 6.0 if not smoke else 0.8
+  unroll = _transport_unroll(t1, h, w)
+  import jax
+  unroll_mb = sum(x.nbytes for x in jax.tree_util.tree_leaves(unroll)
+                  ) / 1e6
+  results = {'unroll_mb': round(unroll_mb, 2)}
+
+  # --- (a) buffer → prefetcher (batch assembly + staging thread). ---
+  batch_size = 4
+  buffer = ring_buffer.TrajectoryBuffer(2 * batch_size)
+  stop = threading.Event()
+
+  def produce():
+    while not stop.is_set():
+      try:
+        buffer.put(unroll, timeout=0.2)
+      except (TimeoutError, ring_buffer.Closed):
+        continue
+
+  producers = [threading.Thread(target=produce, daemon=True)
+               for _ in range(4)]
+  for p in producers:
+    p.start()
+  prefetcher = ring_buffer.BatchPrefetcher(buffer, batch_size,
+                                           place_fn=lambda b: b)
+  prefetcher.get(timeout=30)  # warm
+  n = 0
+  t0 = time.perf_counter()
+  while time.perf_counter() - t0 < dur:
+    prefetcher.get(timeout=30)
+    n += 1
+  dt = time.perf_counter() - t0
+  stop.set()
+  prefetcher.close()
+  for p in producers:
+    p.join(timeout=2)
+  results['buffer_prefetcher'] = {
+      'batches_per_sec': round(n / dt, 1),
+      'unrolls_per_sec': round(n * batch_size / dt, 1),
+      'mb_per_sec': round(n * batch_size * unroll_mb / dt, 1),
+  }
+
+  # --- (b) C++ batcher standalone (merge/split machinery only). ---
+  frame_row = np.zeros((1, h, w, 3), np.uint8)
+  action_row = np.zeros((1,), np.int32)
+  batcher_results = {}
+  for nthreads in ((4, 16, 48) if not smoke else (4,)):
+    fn = dynamic_batching.batch_fn_with_options(
+        maximum_batch_size=1024, timeout_ms=2)(
+            lambda frame, action: action)
+    counts = [0] * nthreads
+    stop_b = threading.Event()
+
+    def worker(i):
+      while not stop_b.is_set():
+        fn(frame_row, action_row)
+        counts[i] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(nthreads)]
+    for t in threads:
+      t.start()
+    time.sleep(0.3)  # warm
+    base = sum(counts)
+    t0 = time.perf_counter()
+    time.sleep(dur / 2)
+    dt = time.perf_counter() - t0
+    got = sum(counts) - base
+    # Join BEFORE close: close() cancels in-flight requests, which
+    # raises BatcherCancelled out of any worker still inside fn().
+    stop_b.set()
+    for t in threads:
+      t.join(timeout=2)
+    fn.close()
+    batcher_results[f'threads_{nthreads}'] = round(got / dt, 1)
+  results['batcher_requests_per_sec'] = batcher_results
+
+  # --- (c) ingest loopback (pickle TCP wire), with the production
+  # contract: the measured constant must include the handshake and the
+  # per-unroll signature/action-range validation every real ingest
+  # pays (driver.train always passes a contract). ---
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.models import ImpalaAgent
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+  ingest_cfg = Config(env_backend='fake', num_actions=9,
+                      unroll_length=t1 - 1, height=h, width=w,
+                      use_instruction=False)
+  ingest_agent = ImpalaAgent(num_actions=9, use_instruction=False)
+  contract = remote.trajectory_contract(ingest_cfg, ingest_agent, 9)
+  for nclients in ((1, 4) if not smoke else (1,)):
+    buf = ring_buffer.TrajectoryBuffer(16)
+    server = remote.TrajectoryIngestServer(buf, {'w': np.zeros(1)},
+                                           host='127.0.0.1',
+                                           contract=contract)
+    stop_c = threading.Event()
+
+    def drain():
+      while not stop_c.is_set():
+        try:
+          buf.get(timeout=0.2)
+        except (TimeoutError, ring_buffer.Closed):
+          continue
+
+    drainer = threading.Thread(target=drain, daemon=True)
+    drainer.start()
+    counts = [0] * nclients
+
+    def pump(i):
+      client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                        connect_timeout_secs=10)
+      try:
+        client.handshake(contract)
+        while not stop_c.is_set():
+          client.send_unroll(unroll)
+          counts[i] += 1
+      except (OSError, RuntimeError, remote.LearnerShutdown):
+        pass
+      finally:
+        client.close()
+
+    pumps = [threading.Thread(target=pump, args=(i,), daemon=True)
+             for i in range(nclients)]
+    for t in pumps:
+      t.start()
+    time.sleep(0.3)  # warm/connect
+    base = sum(counts)
+    t0 = time.perf_counter()
+    time.sleep(dur / 2)
+    dt = time.perf_counter() - t0
+    got = sum(counts) - base
+    stop_c.set()
+    for t in pumps:
+      t.join(timeout=3)
+    server.close()
+    buf.close()
+    drainer.join(timeout=2)
+    results[f'ingest_{nclients}conn'] = {
+        'unrolls_per_sec': round(got / dt, 1),
+        'mb_per_sec': round(got * unroll_mb / dt, 1),
+    }
+  return results
+
+
 def main():
   # BENCH_SMOKE=1: tiny shapes on CPU — validates bench mechanics in CI
   # without the chip. The driver runs the real thing (no env var, TPU).
@@ -140,6 +315,9 @@ def main():
   e2e = None
   if os.environ.get('BENCH_SKIP_E2E') != '1':
     e2e = bench_e2e(smoke)
+  transport = None
+  if os.environ.get('BENCH_SKIP_TRANSPORT') != '1':
+    transport = bench_transport(smoke)
 
   baseline_per_chip = 200_000.0 / 16.0  # north star / v5e-16 chips
   out = {
@@ -155,6 +333,8 @@ def main():
     out['no_instruction_fps'] = round(fps_no_instr, 1)
   if e2e is not None:
     out['e2e'] = e2e
+  if transport is not None:
+    out['transport'] = transport
   print(json.dumps(out))
 
 

@@ -13,6 +13,29 @@ from scalable_agent_tpu.structs import (
     ActorOutput, AgentOutput, StepOutput, StepOutputInfo)
 
 
+def make_example_unroll(t1, h, w, num_actions, instr_len, seed=0,
+                        hidden_size=256):
+  """One random host-side ActorOutput unroll ([T+1] numpy, batch dim 1
+  on the core state) — what a single actor ships over the wire."""
+  rng = np.random.RandomState(seed)
+  return ActorOutput(
+      level_name=np.int32(0),
+      agent_state=(np.zeros((1, hidden_size), np.float32),
+                   np.zeros((1, hidden_size), np.float32)),
+      env_outputs=StepOutput(
+          reward=rng.randn(t1).astype(np.float32),
+          info=StepOutputInfo(np.zeros(t1, np.float32),
+                              np.zeros(t1, np.int32)),
+          done=np.zeros(t1, bool),
+          observation=(
+              rng.randint(0, 255, (t1, h, w, 3)).astype(np.uint8),
+              np.zeros((t1, instr_len), np.int32))),
+      agent_outputs=AgentOutput(
+          action=rng.randint(0, num_actions, t1).astype(np.int32),
+          policy_logits=rng.randn(t1, num_actions).astype(np.float32),
+          baseline=rng.randn(t1).astype(np.float32)))
+
+
 def make_example_batch(t1, b, h, w, num_actions, instr_len, seed=0,
                        done_prob=0.05, hidden_size=256):
   """Random ActorOutput batch: [T+1=t1, B=b] time-major trajectory."""

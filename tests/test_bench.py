@@ -1,0 +1,20 @@
+"""Bench mechanics smoke: the transport-ceiling bench must keep
+working on CPU (its numbers feed docs/PERF.md's scaling arithmetic).
+The full-size run is the driver's job (`python bench.py` on the real
+chip); here we only pin the contract: all stages run, report the
+expected keys, and produce positive rates.
+"""
+
+import bench
+
+
+def test_transport_bench_smoke():
+  results = bench.bench_transport(smoke=True)
+  assert results['unroll_mb'] > 0
+  bp = results['buffer_prefetcher']
+  assert bp['batches_per_sec'] > 0
+  assert bp['unrolls_per_sec'] > 0
+  assert results['batcher_requests_per_sec']['threads_4'] > 0
+  ingest = results['ingest_1conn']
+  assert ingest['unrolls_per_sec'] > 0
+  assert ingest['mb_per_sec'] > 0
