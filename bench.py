@@ -266,6 +266,7 @@ def bench_transport(smoke):
     drainer = threading.Thread(target=drain, daemon=True)
     drainer.start()
     counts = [0] * nclients
+    pump_errors = []
 
     def pump(i):
       client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
@@ -275,8 +276,11 @@ def bench_transport(smoke):
         while not stop_c.is_set():
           client.send_unroll(unroll)
           counts[i] += 1
-      except (OSError, RuntimeError, remote.LearnerShutdown):
-        pass
+      except (OSError, RuntimeError, remote.LearnerShutdown) as e:
+        # Recorded, not swallowed: a rejection here (e.g. the example
+        # unroll drifting behind the contract) must not silently
+        # publish 0.0 rates into the scaling arithmetic.
+        pump_errors.append(e)
       finally:
         client.close()
 
@@ -296,6 +300,12 @@ def bench_transport(smoke):
     server.close()
     buf.close()
     drainer.join(timeout=2)
+    if got == 0:
+      raise RuntimeError(
+          f'ingest bench moved no unrolls ({nclients} conns); first '
+          f'pump error: {pump_errors[0]!r}' if pump_errors else
+          f'ingest bench moved no unrolls ({nclients} conns), no '
+          'pump error recorded')
     results[f'ingest_{nclients}conn'] = {
         'unrolls_per_sec': round(got / dt, 1),
         'mb_per_sec': round(got * unroll_mb / dt, 1),

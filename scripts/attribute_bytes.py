@@ -1,0 +1,141 @@
+"""Per-component byte attribution of the flagship train step.
+
+docs/PERF.md's roofline says the step is HBM-bound (23.6 GB accessed);
+this script breaks that aggregate down by op class via XLA
+`cost_analysis()` on independently jitted sub-functions (VERDICT r2
+W5: "memory-bound, accept it" is only a conclusion once we know WHICH
+tensors account for the bytes). Run on the real chip:
+
+    python scripts/attribute_bytes.py            # flagship shapes
+    SMOKE=1 python scripts/attribute_bytes.py    # mechanics check, CPU
+
+Sub-functions overlap (the full step contains all of them); the point
+is attribution, not a partition: fwd-vs-bwd splits, the conv torso's
+share, and the sizes of the V-trace/optimizer/host-visible pieces.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def cost(fn, *args):
+  import jax
+  compiled = jax.jit(fn).lower(*args).compile()
+  analysis = compiled.cost_analysis()
+  if isinstance(analysis, list):  # some jax versions return [dict]
+    analysis = analysis[0]
+  return (analysis.get('bytes accessed', float('nan')),
+          analysis.get('flops', float('nan')))
+
+
+def main():
+  smoke = os.environ.get('SMOKE') == '1'
+  if smoke:
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+  import jax
+  import jax.numpy as jnp
+  from scalable_agent_tpu import learner as learner_lib
+  from scalable_agent_tpu import vtrace
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.models import ImpalaAgent, init_params
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+  from scalable_agent_tpu.models.torsos import DeepResNetTorso
+  from scalable_agent_tpu.testing import make_example_batch
+
+  t, b = (100, 32) if not smoke else (4, 2)
+  h, w = (72, 96) if not smoke else (24, 32)
+  num_actions = 9
+  cfg = Config(batch_size=b, unroll_length=t, num_action_repeats=4,
+               torso='deep', compute_dtype='bfloat16',
+               total_environment_frames=int(1e9))
+  agent = ImpalaAgent(num_actions=num_actions, torso='deep',
+                      use_instruction=True, scan_unroll=cfg.scan_unroll,
+                      dtype=jnp.bfloat16)
+  obs = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+  params = init_params(agent, jax.random.PRNGKey(0), obs)
+  batch = make_example_batch(t + 1, b, h, w, num_actions,
+                             MAX_INSTRUCTION_LEN, done_prob=0.01)
+  state = learner_lib.make_train_state(params, cfg)
+
+  rows = []
+
+  # Full step (the aggregate being attributed).
+  step = learner_lib.make_train_step_fn(agent, cfg)
+  rows.append(('full train step (fwd+bwd+V-trace+RMSProp)',
+               *cost(step, state, batch)))
+
+  # Forward only (loss_fn without grad): unroll + V-trace + losses.
+  def fwd(params, batch):
+    return learner_lib.loss_fn(params, agent, batch, cfg)[0]
+
+  rows.append(('forward loss (unroll+V-trace+losses)',
+               *cost(fwd, params, batch)))
+
+  # Forward + backward (no optimizer).
+  rows.append(('forward+backward (value_and_grad, no opt)',
+               *cost(jax.value_and_grad(fwd), params, batch)))
+
+  # Optimizer update alone (RMSProp moments + apply): param-sized.
+  import optax
+  optimizer = learner_lib.make_optimizer(cfg)
+  grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+  def opt_update(grads, opt_state, params):
+    updates, new_opt = optimizer.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), new_opt
+
+  rows.append(('RMSProp update (moments + apply)',
+               *cost(opt_update, grads, state.opt_state, params)))
+
+  # V-trace alone at [T, B, A].
+  rng = np.random.RandomState(0)
+  logits = jnp.asarray(rng.randn(t, b, num_actions), jnp.float32)
+  actions = jnp.asarray(rng.randint(0, num_actions, (t, b)), jnp.int32)
+  scalars = jnp.asarray(rng.rand(t, b), jnp.float32)
+
+  def vtrace_only(bl, tl, a, d, r, v, bv):
+    return vtrace.from_logits(
+        behaviour_policy_logits=bl, target_policy_logits=tl, actions=a,
+        discounts=d, rewards=r, values=v, bootstrap_value=bv)
+
+  rows.append(('V-trace standalone [T,B]',
+               *cost(vtrace_only, logits, logits, actions,
+                     scalars * 0.99, scalars, scalars, scalars[0])))
+
+  # Conv torso alone on the merged [T+1 * B] frame batch (the MXU-heavy
+  # slice; frames normalized exactly as the agent does).
+  torso = DeepResNetTorso(dtype=jnp.bfloat16)
+  torso_params = {'params': params['params']['DeepResNetTorso_0']}
+  frames = jnp.asarray(
+      np.asarray(batch.env_outputs.observation[0]).reshape(
+          (t + 1) * b, h, w, 3))
+
+  def torso_fwd(p, frames):
+    x = frames.astype(jnp.bfloat16) / 255.0
+    return torso.apply(p, x)
+
+  rows.append(('conv torso forward [T+1*B merged]',
+               *cost(torso_fwd, torso_params, frames)))
+
+  def torso_loss(p, frames):
+    return jnp.sum(torso_fwd(p, frames).astype(jnp.float32))
+
+  rows.append(('conv torso forward+backward',
+               *cost(jax.value_and_grad(torso_loss), torso_params,
+                     frames)))
+
+  print('| component | bytes accessed | GB | TFLOP |')
+  print('|---|---|---|---|')
+  for name, bytes_, flops in rows:
+    print(f'| {name} | {bytes_:.3e} | {bytes_ / 1e9:.2f} | '
+          f'{flops / 1e12:.3f} |')
+
+
+if __name__ == '__main__':
+  main()

@@ -61,27 +61,14 @@ def _contract_setup(num_actions=3, **overrides):
 
 
 def _conforming_unroll(cfg, agent, num_actions, seed=0):
-  """An unroll matching `trajectory_contract(cfg, agent, ...)`."""
+  """An unroll matching `trajectory_contract(cfg, agent, ...)` — the
+  one canonical constructor, so tests can't drift from the bench."""
   from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
-  rng = np.random.RandomState(seed)
-  t1 = cfg.unroll_length + 1
-  h, w = cfg.height, cfg.width
-  return ActorOutput(
-      level_name=np.int32(0),
-      agent_state=(np.zeros((1, agent.hidden_size), np.float32),
-                   np.zeros((1, agent.hidden_size), np.float32)),
-      env_outputs=StepOutput(
-          reward=rng.randn(t1).astype(np.float32),
-          info=StepOutputInfo(np.zeros(t1, np.float32),
-                              np.zeros(t1, np.int32)),
-          done=np.zeros(t1, bool),
-          observation=(
-              rng.randint(0, 255, (t1, h, w, 3)).astype(np.uint8),
-              np.zeros((t1, MAX_INSTRUCTION_LEN), np.int32))),
-      agent_outputs=AgentOutput(
-          action=rng.randint(0, num_actions, t1).astype(np.int32),
-          policy_logits=rng.randn(t1, num_actions).astype(np.float32),
-          baseline=rng.randn(t1).astype(np.float32)))
+  from scalable_agent_tpu.testing import make_example_unroll
+  return make_example_unroll(cfg.unroll_length + 1, cfg.height,
+                             cfg.width, num_actions,
+                             MAX_INSTRUCTION_LEN, seed=seed,
+                             hidden_size=agent.hidden_size)
 
 
 def test_handshake_rejects_skewed_config():
