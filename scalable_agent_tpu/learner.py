@@ -242,6 +242,11 @@ def make_train_step_fn(agent, config: Config):
           beta=config.popart_beta)
       new_params = popart_lib.apply_preservation(
           new_params, state.popart, new_popart)
+      # Stability observability (the soak asserts these stay bounded):
+      # a diverging value scale shows up here long before NaNs.
+      sig = popart_lib.sigma(new_popart)
+      metrics['popart_sigma_min'] = jnp.min(sig)
+      metrics['popart_sigma_max'] = jnp.max(sig)
     new_state = TrainState(new_params, new_opt_state,
                            state.update_steps + 1, new_popart)
     metrics['learning_rate'] = schedule(state.update_steps)

@@ -1,0 +1,173 @@
+"""Flagship stability soak (VERDICT r2 item 6).
+
+The composition test proves the full extension stack RUNS; this proves
+it is STABLE AND LEARNING over a sustained run: the real driver
+pipeline (process-hosted envs → C++ batcher → buffer → prefetcher →
+chip) with every flagship feature on at once — deep ResNet, 72×96
+frames, bfloat16 compute, instruction encoder, PopArt, UNREAL pixel
+control — on the contextual-bandit task, asserting over the whole run:
+
+  - every logged total_loss is finite,
+  - PopArt σ stays inside its clip bounds (a diverging value scale
+    shows up there long before NaNs),
+  - episode return IMPROVES (last-third mean > first-third mean) and
+    beats the random baseline (~1/3 on 3-arm bandit).
+
+Writes SOAK_r03.json at the repo root. Invocation (real chip, ~10 min):
+
+    python scripts/soak.py                 # SOAK_SECONDS=600 default
+    SOAK_SECONDS=120 python scripts/soak.py
+    SOAK_SMOKE=1 python scripts/soak.py    # CPU mechanics check, ~40 s
+
+Learning hyperparameters: lr 5e-4 (≈ the paper's tuned 4.8e-4),
+entropy 3e-3, γ=0 (the task is one-step). The smoke test's hotter
+lr 2e-3 works for the SHALLOW torso but drives the deep ResNet into
+a premature near-deterministic policy that solves only 2 of the 3
+cues (measured: plateau at ~0.66 reward/step vs 1.0 at 5e-4) — the
+flagship stack is what is under test, and at the paper-ish lr it
+learns to optimal.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+  smoke = os.environ.get('SOAK_SMOKE') == '1'
+  seconds = float(os.environ.get('SOAK_SECONDS', '600' if not smoke
+                                 else '40'))
+  if smoke:
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+  import numpy as np
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu import popart as popart_lib
+  from scalable_agent_tpu.config import Config
+
+  logdir = tempfile.mkdtemp(prefix='soak_')
+  cfg = Config(
+      logdir=logdir,
+      env_backend='bandit',
+      num_actors=8 if not smoke else 2,
+      batch_size=4 if not smoke else 2,
+      unroll_length=20 if not smoke else 5,
+      num_action_repeats=1,
+      episode_length=5,
+      height=72 if not smoke else 24,
+      width=96 if not smoke else 32,
+      torso='deep' if not smoke else 'shallow',
+      compute_dtype='bfloat16' if not smoke else 'float32',
+      use_py_process=not smoke,
+      use_instruction=True,
+      use_popart=True,
+      pixel_control_cost=0.01,
+      learning_rate=0.0005,
+      entropy_cost=0.003,
+      discounting=0.0,
+      reward_clipping='abs_one',
+      total_environment_frames=int(1e9),
+      inference_timeout_ms=20,
+      checkpoint_secs=10**6,
+      summary_secs=10 if not smoke else 2,
+      seed=7)
+  run = driver.train(cfg, max_seconds=seconds, stall_timeout_secs=180)
+
+  losses, sigmas_min, sigmas_max, returns = [], [], [], []
+  with open(os.path.join(logdir, 'summaries.jsonl')) as f:
+    for line in f:
+      e = json.loads(line)
+      if 'value' not in e:
+        continue
+      if e['tag'] == 'total_loss':
+        losses.append(e['value'])
+      elif e['tag'] == 'popart_sigma_min':
+        sigmas_min.append(e['value'])
+      elif e['tag'] == 'popart_sigma_max':
+        sigmas_max.append(e['value'])
+      elif e['tag'].endswith('/episode_return'):
+        returns.append(e['value'])
+
+  steps = int(run.state.update_steps)
+  problems = []
+  if steps < (20 if not smoke else 2):
+    problems.append(f'only {steps} learner steps in {seconds:.0f}s')
+  if not losses or not np.all(np.isfinite(losses)):
+    problems.append(f'non-finite or missing losses: {losses[-3:]}')
+  # σ is clipped to [DEFAULT_SIGMA_MIN, DEFAULT_SIGMA_MAX] by design:
+  # LANDING ON either bound means the value scale collapsed/diverged
+  # (×1.01/÷1.01 so the check can actually fire at the clip).
+  sigma_lo = float(popart_lib.DEFAULT_SIGMA_MIN)
+  sigma_hi = float(popart_lib.DEFAULT_SIGMA_MAX)
+  if not sigmas_max or not np.all(np.isfinite(sigmas_max)):
+    problems.append('missing/non-finite popart sigma')
+  elif (max(sigmas_max) >= sigma_hi / 1.01 or
+        min(sigmas_min) <= sigma_lo * 1.01):
+    problems.append(
+        f'popart sigma hit its clip bounds: [{min(sigmas_min)}, '
+        f'{max(sigmas_max)}]')
+  third = max(len(returns) // 3, 1)
+  early = float(np.mean(returns[:third])) if returns else float('nan')
+  late = float(np.mean(returns[-third:])) if returns else float('nan')
+  # Random play on the 3-arm bandit: 5-step episodes × 1/3 ≈ 1.67.
+  random_baseline = cfg.episode_length / 3.0
+  if not smoke:
+    if len(returns) < 12:
+      problems.append(f'only {len(returns)} episode returns logged')
+    elif not (late > early):
+      problems.append(f'return did not improve: early={early:.3f} '
+                      f'late={late:.3f}')
+    elif late <= 1.5 * random_baseline:
+      problems.append(
+          f'return does not clear the random baseline '
+          f'({random_baseline:.2f}): late={late:.3f}')
+
+  n_chunks = 8
+  chunk = max(len(returns) // n_chunks, 1)
+  curve = [round(float(np.mean(returns[i:i + chunk])), 3)
+           for i in range(0, len(returns), chunk)]
+  artifact = {
+      'ok': not problems,
+      'problems': problems,
+      'seconds': seconds,
+      'steps': steps,
+      'frames': int(run.frames),
+      'episodes_logged': len(returns),
+      'return_early_third': round(early, 3),
+      'return_late_third': round(late, 3),
+      'return_curve': curve,
+      'loss_first': round(float(losses[0]), 4) if losses else None,
+      'loss_last': round(float(losses[-1]), 4) if losses else None,
+      'popart_sigma_range': ([round(float(min(sigmas_min)), 5),
+                              round(float(max(sigmas_max)), 5)]
+                             if sigmas_max else None),
+      'stack': {
+          'torso': cfg.torso, 'compute_dtype': cfg.compute_dtype,
+          'frames': [cfg.height, cfg.width],
+          'use_instruction': True, 'use_popart': True,
+          'pixel_control_cost': cfg.pixel_control_cost,
+          'unroll_length': cfg.unroll_length,
+          'batch_size': cfg.batch_size, 'num_actors': cfg.num_actors,
+          'use_py_process': cfg.use_py_process,
+      },
+      'smoke': smoke,
+  }
+  out_path = os.path.join(os.path.dirname(os.path.dirname(
+      os.path.abspath(__file__))), 'SOAK_r03.json')
+  if smoke:
+    out_path = os.path.join(logdir, 'SOAK_smoke.json')
+  with open(out_path, 'w') as f:
+    json.dump(artifact, f, indent=1)
+  print(json.dumps(artifact))
+  if problems:
+    sys.exit(1)
+
+
+if __name__ == '__main__':
+  from scalable_agent_tpu.runtime.py_process import warm_forkserver
+  warm_forkserver()
+  main()
