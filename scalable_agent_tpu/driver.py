@@ -262,6 +262,22 @@ def train(config: Config, max_steps: Optional[int] = None,
   # dispatch pipeline each step).
   _initial_steps = int(jax.device_get(state.update_steps))
 
+  # Multi-host TP: state.params are sharded ACROSS processes, so a
+  # jit over them (the inference step) is a collective SPMD program —
+  # and the batcher's computation thread invokes inference at
+  # unsynchronized times per host, which deadlocks in the collective
+  # (measured: device_get never returns). Actors must run on a FULL
+  # host-local copy instead. process_allgather is itself a
+  # collective, so every call site must be on the lockstep path
+  # (same step, every host) — which publish_params_every is.
+  localize_actor_params = (mesh is not None and
+                           mesh_lib.shard_batch_over_model(config))
+
+  def actor_params(params):
+    if localize_actor_params:
+      return multihost_utils.process_allgather(params, tiled=True)
+    return params
+
   # Setup from here to the main loop's try/finally can raise (port
   # binds, env construction, 20–40 s inference compiles, fleet.start's
   # make_actor spawning env processes on this thread): the
@@ -290,8 +306,13 @@ def train(config: Config, max_steps: Optional[int] = None,
     buffer = ring_buffer.TrajectoryBuffer(capacity)
     if config.remote_actor_port:
       from scalable_agent_tpu.runtime import remote
+      # actor_params: in multi-host-TP mode a raw device_get of the
+      # cross-process-sharded params would raise (non-addressable
+      # shards); the localization collective is safe here — setup is
+      # lockstep and the config (hence this branch) is identical on
+      # every host.
       ingest = remote.TrajectoryIngestServer(
-          buffer, jax.device_get(state.params),
+          buffer, jax.device_get(actor_params(state.params)),
           host=config.remote_actor_bind_host,
           port=config.remote_actor_port,
           contract=remote.trajectory_contract(config, agent,
@@ -304,9 +325,15 @@ def train(config: Config, max_steps: Optional[int] = None,
     # across hosts. ---
     process_index = jax.process_index()
     process_seed_base = process_index * max(config.num_actors, 1000)
-    server = InferenceServer(agent, state.params, config,
+    initial_pub = actor_params(state.params)
+    server = InferenceServer(agent, initial_pub, config,
                              seed=config.seed + 1000 + process_seed_base)
-    server.update_params(state.params)
+    # update_params COPIES: the constructor stores its argument by
+    # reference, and in the non-localized path that is state.params
+    # itself — which the first train step DONATES. Without this copy,
+    # actors would run inference on deleted buffers (real on TPU;
+    # invisible on CPU tests, where jit ignores donation).
+    server.update_params(initial_pub)
     # Pre-compile inference buckets up to the fleet size: a bucket's
     # first appearance otherwise stalls every parked actor for the TPU
     # compile (the reference's TF graph had dynamic batch dims).
@@ -460,7 +487,11 @@ def train(config: Config, max_steps: Optional[int] = None,
                  ep_frames)
 
       if steps_done % config.publish_params_every == 0:
-        server.update_params(state.params)
+        # actor_params is a cross-host collective in multi-host-TP
+        # mode: it must run UNCONDITIONALLY here (lockstep branch),
+        # never inside the per-host time-gated ingest publish below.
+        published = actor_params(state.params)
+        server.update_params(published)
         if (ingest is not None and
             time.monotonic() - last_remote_publish >=
             config.remote_publish_secs and
@@ -470,9 +501,11 @@ def train(config: Config, max_steps: Optional[int] = None,
           # fetch, as an explicit snapshot). Unlike the local pointer
           # swap above, this is a blocking device_get of the whole
           # param tree — hence the wall-clock throttle and the
-          # nobody-connected gate.
+          # nobody-connected gate. (Already host numpy when the
+          # multi-host-TP localization ran; device_get is then a
+          # pass-through.)
           last_remote_publish = time.monotonic()
-          ingest.publish_params(jax.device_get(state.params))
+          ingest.publish_params(jax.device_get(published))
 
       now = time.monotonic()
       if now - last_summary >= config.summary_secs:

@@ -488,6 +488,15 @@ class TrajectoryIngestServer:
     cannot hang the learner's teardown).
     """
     self._closed.set()
+    # shutdown() BEFORE close(): a thread blocked in accept() holds
+    # the open file description, so close() alone leaves the port
+    # LISTENing (owner-less) until some stray connection completes
+    # the accept — shutdown wakes the blocked accept immediately and
+    # releases the port deterministically.
+    try:
+      self._listener.shutdown(socket.SHUT_RDWR)
+    except OSError:
+      pass
     try:
       self._listener.close()
     except OSError:

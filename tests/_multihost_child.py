@@ -67,8 +67,22 @@ def main():
   cfg = Config(logdir=logdir, **dict(CHILD_CONFIG, batch_size=batch))
 
   if mode == 'run':
+    # MH_MP>1 runs the FULL driver with TP (with nprocs>ndev*mp the
+    # model axis crosses the process boundary — the tp4 mode proves
+    # the numerics at step level; this proves driver.train end to end:
+    # mesh choice, batch-width check, fleets, place_batch, train).
+    mp = int(os.environ.get('MH_MP', '1'))
+    if mp > 1:
+      import dataclasses
+      cfg = dataclasses.replace(cfg, model_parallelism=mp)
     run = driver.train(cfg, max_steps=3, stall_timeout_secs=120)
     assert int(run.state.update_steps) == 3, run.state.update_steps
+    if mp > 1:
+      import jax as _jax
+      tp_leaves = [
+          x for x in _jax.tree_util.tree_leaves(run.state.params)
+          if 'model' in str(getattr(x.sharding, 'spec', ''))]
+      assert tp_leaves, 'driver TP run produced no model-sharded param'
     print(f'child {proc}: ok', flush=True)
   elif mode == 'mixed':
     ingest_port = int(sys.argv[5])

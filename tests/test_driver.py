@@ -102,6 +102,48 @@ def test_evaluate_without_checkpoint_raises(tmp_path):
     driver.evaluate(cfg)
 
 
+def test_setup_failure_releases_everything_and_retry_works(tmp_path):
+  """The setup guard's contract (ADVICE r2 medium): a make_actor
+  failure during fleet.start() — after the ingest port is already
+  bound and inference is warmed — must release the port and every
+  background resource, and a same-process retry on the SAME port must
+  then succeed (the 'bound zombie port serving stale v1 params'
+  scenario the guard's comment describes)."""
+  import socket
+  import threading
+  from scalable_agent_tpu.envs import factory
+
+  with socket.create_server(('127.0.0.1', 0)) as s:
+    port = s.getsockname()[1]
+  cfg = _config(tmp_path, remote_actor_port=port,
+                remote_actor_bind_host='127.0.0.1')
+
+  real_build = factory.build_environment
+  calls = {'n': 0}
+
+  def failing_build(spec, use_py_process=False):
+    calls['n'] += 1
+    raise RuntimeError('injected env-construction failure')
+
+  factory.build_environment = failing_build
+  try:
+    with pytest.raises(RuntimeError, match='injected'):
+      driver.train(cfg, max_steps=1, stall_timeout_secs=30)
+  finally:
+    factory.build_environment = real_build
+  assert calls['n'] >= 1
+  # The ingest port was released (a leaked listener would EADDRINUSE).
+  probe = socket.create_server(('127.0.0.1', port))
+  probe.close()
+  # No stray non-daemon machinery keeping the process alive.
+  assert all(t.daemon or t is threading.main_thread() or
+             not t.is_alive() for t in threading.enumerate())
+
+  # Same-process retry on the SAME port trains fine.
+  run = driver.train(cfg, max_steps=1, stall_timeout_secs=60)
+  assert int(run.state.update_steps) == 1
+
+
 def test_train_with_popart_and_pixel_control(tmp_path):
   """The extension stack end-to-end through the driver: PopArt state
   lives in the TrainState, checkpoints, and restores; the aux loss

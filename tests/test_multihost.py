@@ -206,6 +206,35 @@ def test_kill_one_host_then_resume_four_processes(tmp_path):
   _kill_drill(tmp_path, nprocs=4, env_overrides={'MH_BATCH': '8'})
 
 
+def test_driver_tp_across_process_boundary(tmp_path):
+  """The FULL driver (fleets, local transport, mesh choice,
+  place_batch, inference-param localization) at 2 processes × 1
+  device with model_parallelism=2: the mesh is [[p0, p1]] — the model
+  axis IS the process boundary — and the batch shards over both mesh
+  axes. Complements test_tp_across_process_boundary, which proves the
+  step-level numerics but bypasses driver.train. This is the test
+  that caught the inference-over-sharded-params deadlock (the batcher
+  thread invoking a collective program unsynchronized): actors must
+  run on a localized full copy (driver.actor_params)."""
+  logdir = str(tmp_path)
+  procs = _spawn_children(
+      logdir, _free_port(), nprocs=2,
+      env_overrides={'MH_NDEV': '1', 'MH_MP': '2', 'MH_BATCH': '4'})
+  outs = []
+  try:
+    for p in procs:
+      out, _ = p.communicate(timeout=280)
+      outs.append(out)
+  finally:
+    for p in procs:
+      if p.poll() is None:
+        p.kill()
+        p.communicate()
+  for i, (p, out) in enumerate(zip(procs, outs)):
+    assert p.returncode == 0, f'child {i} failed:\n{out[-3000:]}'
+    assert f'child {i}: ok' in out
+
+
 def test_tp_across_process_boundary(tmp_path):
   """VERDICT r2 W3: TP with the model axis CROSSING the process
   boundary — 4 processes × 1 device, model_parallelism=2 pairs devices
