@@ -304,15 +304,21 @@ def train(config: Config, max_steps: Optional[int] = None,
     capacity = max(config.queue_capacity_batches * config.batch_size,
                    config.batch_size)
     buffer = ring_buffer.TrajectoryBuffer(capacity)
+    # ONE localization for both the ingest snapshot and the inference
+    # server, UNCONDITIONALLY before the ingest branch: actor_params
+    # is a cross-host collective in multi-host-TP mode, and
+    # remote_actor_port legitimately differs per host (mixed
+    # topologies enable ingest on some hosts only) — a collective
+    # inside that branch would desync the hosts' collective sequences
+    # and hang the job at startup.
+    initial_pub = actor_params(state.params)
     if config.remote_actor_port:
       from scalable_agent_tpu.runtime import remote
-      # actor_params: in multi-host-TP mode a raw device_get of the
-      # cross-process-sharded params would raise (non-addressable
-      # shards); the localization collective is safe here — setup is
-      # lockstep and the config (hence this branch) is identical on
-      # every host.
+      # device_get of the LOCALIZED copy (a raw device_get of
+      # cross-process-sharded params would raise on non-addressable
+      # shards; on the plain path this is the ordinary host copy).
       ingest = remote.TrajectoryIngestServer(
-          buffer, jax.device_get(actor_params(state.params)),
+          buffer, jax.device_get(initial_pub),
           host=config.remote_actor_bind_host,
           port=config.remote_actor_port,
           contract=remote.trajectory_contract(config, agent,
@@ -325,7 +331,6 @@ def train(config: Config, max_steps: Optional[int] = None,
     # across hosts. ---
     process_index = jax.process_index()
     process_seed_base = process_index * max(config.num_actors, 1000)
-    initial_pub = actor_params(state.params)
     server = InferenceServer(agent, initial_pub, config,
                              seed=config.seed + 1000 + process_seed_base)
     # update_params COPIES: the constructor stores its argument by

@@ -147,7 +147,10 @@ class InferenceServer:
   def _padded_size(self, n):
     """Bucket size for a merged batch of n: next power of two (capped
     at max_batch), rounded up to a multiple of the mesh's data width
-    so every shard is non-empty."""
+    so every shard is non-empty. Note the rounding can EXCEED
+    max_batch when the data width doesn't divide it: max_batch caps
+    how many real requests merge (the batcher enforces that); the
+    padded compute shape must still be shardable."""
     padded = min(_next_power_of_two(n), self._max_batch)
     if self._dp > 1:
       padded = ((padded + self._dp - 1) // self._dp) * self._dp
