@@ -551,6 +551,10 @@ def train(config: Config, max_steps: Optional[int] = None,
           writer.scalar('remote_unrolls', ing['unrolls'], step_now)
           writer.scalar('remote_connections', ing['connections'],
                         step_now)
+          # Rejected unrolls keep their connection alive (the actor
+          # decides severity), so without this counter a host whose
+          # every unroll is being refused is invisible here.
+          writer.scalar('remote_rejected', ing['rejected'], step_now)
       # Checkpoint cadence: Orbax saves are collective across hosts;
       # clocks differ, so all hosts act on PROCESS 0's decision (a
       # host-local clock here would desync the barrier and deadlock).
