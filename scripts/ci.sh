@@ -20,4 +20,11 @@ python __graft_entry__.py
 echo '== bench smoke (mechanics only, tiny shapes) =='
 BENCH_SMOKE=1 python bench.py
 
+echo '== soak smoke (mechanics only: popart/pc stack runs, tiny shapes;'
+echo '   the real flagship soak is scripts/soak.py on the chip) =='
+SOAK_SMOKE=1 python scripts/soak.py
+
+echo '== byte-attribution smoke (cost_analysis mechanics) =='
+SMOKE=1 python scripts/attribute_bytes.py
+
 echo 'CI OK'
