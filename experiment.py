@@ -45,9 +45,11 @@ flags.DEFINE_integer('remote_actor_port', _DEFAULTS.remote_actor_port,
                      'port (0 = disabled).')
 flags.DEFINE_string('remote_actor_bind_host',
                     _DEFAULTS.remote_actor_bind_host,
-                    'Learner: interface the ingest server binds. The '
-                    'wire is unauthenticated pickle — bind a cluster-'
-                    'internal interface in any shared network.')
+                    'Learner: interface the ingest server binds '
+                    '(default loopback-only). The wire is '
+                    'unauthenticated pickle — for real actor hosts, '
+                    'explicitly bind a cluster-internal interface; '
+                    'never expose the port publicly.')
 flags.DEFINE_float('actor_reconnect_secs',
                    _DEFAULTS.actor_reconnect_secs,
                    'Actor: on disconnect, retry the learner for this '

@@ -114,9 +114,10 @@ class Config:
   # Interface the ingest server binds. The wire is pickle (arbitrary
   # code execution for anyone who can reach the port — same trust
   # model as the reference's unauthenticated TF gRPC runtime), so
-  # operators should bind a cluster-internal interface rather than
-  # the all-interfaces default.
-  remote_actor_bind_host: str = '0.0.0.0'
+  # exposure is OPT-IN: the default is loopback-only, and a real
+  # multi-host topology must explicitly bind the cluster-internal
+  # interface (or '0.0.0.0' inside a trusted network) — ADVICE r3.
+  remote_actor_bind_host: str = '127.0.0.1'
   learner_address: str = ''
   # Min seconds between param snapshots published to remote hosts (a
   # publish is a full device_get; remote staleness ~ this value).

@@ -200,6 +200,37 @@ def test_structure_mismatch_names_the_flag(setup, tmp_path):
   ckpt.close()
 
 
+def test_wrap_error_sniffs_structure_vs_corruption():
+  """ADVICE r3: flag guidance only on failures that look like tree-
+  structure mismatches; corrupt/partial-file failures get the
+  corruption wording instead of a misleading --use_instruction hunt."""
+  from scalable_agent_tpu import checkpoint as ckpt_lib
+
+  structural = [
+      ValueError('User-provided restore item and on-disk value '
+                 'metadata tree structures do not match.'),
+      KeyError('params/instruction/embed/kernel'),  # bare key str
+      TypeError('Custom PyTree node mismatch'),
+  ]
+  for e in structural:
+    with pytest.raises(ckpt_lib.CheckpointStructureError,
+                       match='use_instruction'):
+      ckpt_lib._wrap_structure_error(e, '/ckpts', 7)
+
+  corrupt_cases = [
+      ValueError('zarr array data truncated at offset 18238'),
+      # 'missing'/'key' alone must NOT count as structural — they
+      # also appear in partial-save messages like this one.
+      ValueError('checkpoint incomplete: missing commit file for key'),
+  ]
+  for e in corrupt_cases:
+    with pytest.raises(ckpt_lib.CheckpointStructureError) as exc_info:
+      ckpt_lib._wrap_structure_error(e, '/ckpts', 7)
+    msg = str(exc_info.value)
+    assert 'use_instruction' not in msg
+    assert 'corrupt' in msg and 'previous retained step' in msg
+
+
 def test_sharded_state_roundtrip(setup, tmp_path):
   """The docstring's multi-chip claim: a DP-sharded TrainState saves
   and restores onto the same mesh placements (SURVEY §5.4 → Orbax)."""
