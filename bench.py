@@ -5,14 +5,19 @@ Two measurements, one JSON line:
 1. `value` (headline, reference unit): the jitted IMPALA train step on
    a synthetic resident batch (deep ResNet, T=100, B=32, DMLab 72x96
    frames, bfloat16) — the chip's ceiling, comparable across rounds.
-2. `e2e`: the REAL pipeline sustained for ~1 min — process-hosted fake
-   envs at 72x96 → C++ dynamic batcher → TrajectoryBuffer →
-   BatchPrefetcher → learner on chip — reporting the learner
-   consumption rate (the reference's unit, SURVEY §6), the batcher's
-   mean merged batch, and buffer occupancy. The gap between (1) and
-   (2) is the tuning target; in THIS sandbox (1 host core, TPU behind
-   a ~2 ms/dispatch tunnel) the e2e number is host/tunnel-bound, not
-   chip-bound.
+2. `e2e`: the REAL pipeline — process-hosted fake envs at 72x96 → C++
+   dynamic batcher → TrajectoryBuffer → BatchPrefetcher → learner on
+   chip — reporting the learner consumption rate (the reference's
+   unit, SURVEY §6) as median/min/max over 3 independent ~45 s
+   windows, with per-window pipeline telemetry. The gap between (1)
+   and (2) is the tuning target; in THIS sandbox (1 host core, TPU
+   behind a ~2 ms/dispatch tunnel) the e2e number is host/tunnel-
+   bound, not chip-bound.
+
+Plus two host-transport stages feeding docs/PERF.md's scaling
+arithmetic: `transport` (buffer→prefetcher, C++ batcher, TCP unroll
+ingest) and `param_fanout` (the learner's param-snapshot egress to
+actor hosts — the reverse direction).
 
 vs_baseline: BASELINE.json's north star is >=200k env-frames/sec on a
 v5e-16 ⇒ 12,500 frames/sec/chip. vs_baseline = value / 12500.
@@ -83,48 +88,67 @@ def bench_synthetic(smoke):
 
 def bench_e2e(smoke):
   """Sustained FPS through the full real pipeline (driver.train on
-  process-hosted fake envs), read back from the run's own summaries."""
+  process-hosted fake envs), read back from each run's own summaries.
+
+  ≥3 independent windows with median/min/max (VERDICT r3 W1): a single
+  window made round-over-round movement indistinguishable from noise —
+  the r2→r3 "regression" (160 → 106.7) had no error bars. Each window
+  is a fresh driver.train (fresh envs/compile); the reported fps is
+  the run's LAST summary sample (a 5 s FpsMeter window, i.e. steady
+  state past compile/warmup). Per-window pipeline telemetry
+  (buffer_unrolls, inference_mean_batch) is kept alongside so a moved
+  median can be attributed, not guessed at."""
   from scalable_agent_tpu import driver
   from scalable_agent_tpu.config import Config
 
-  logdir = tempfile.mkdtemp(prefix='bench_e2e_')
-  cfg = Config(
-      logdir=logdir,
-      env_backend='fake',
-      num_actions=9,
-      num_actors=4 if not smoke else 2,
-      batch_size=4 if not smoke else 2,
-      unroll_length=100 if not smoke else 5,
-      num_action_repeats=4,
-      episode_length=50,
-      height=72 if not smoke else 24,
-      width=96 if not smoke else 32,
-      torso='deep' if not smoke else 'shallow',
-      compute_dtype='bfloat16' if not smoke else 'float32',
-      use_py_process=not smoke,     # smoke: in-process envs (CI speed)
-      use_instruction=False,
-      total_environment_frames=int(1e9),
-      inference_timeout_ms=20,
-      checkpoint_secs=10**6,       # no checkpoint traffic in the window
-      summary_secs=5 if not smoke else 1,
-      seed=1)
-  run = driver.train(cfg, max_seconds=65 if not smoke else 8,
-                     stall_timeout_secs=120)
+  windows = []
+  num_windows = 3 if not smoke else 1
+  for i in range(num_windows):
+    logdir = tempfile.mkdtemp(prefix='bench_e2e_')
+    cfg = Config(
+        logdir=logdir,
+        env_backend='fake',
+        num_actions=9,
+        num_actors=4 if not smoke else 2,
+        batch_size=4 if not smoke else 2,
+        unroll_length=100 if not smoke else 5,
+        num_action_repeats=4,
+        episode_length=50,
+        height=72 if not smoke else 24,
+        width=96 if not smoke else 32,
+        torso='deep' if not smoke else 'shallow',
+        compute_dtype='bfloat16' if not smoke else 'float32',
+        use_py_process=not smoke,   # smoke: in-process envs (CI speed)
+        use_instruction=False,
+        total_environment_frames=int(1e9),
+        inference_timeout_ms=20,
+        checkpoint_secs=10**6,     # no checkpoint traffic in the window
+        summary_secs=5 if not smoke else 1,
+        seed=1 + i)
+    run = driver.train(cfg, max_seconds=45 if not smoke else 8,
+                       stall_timeout_secs=120)
+    last = {}
+    with open(os.path.join(logdir, 'summaries.jsonl')) as f:
+      for line in f:
+        e = json.loads(line)
+        if 'value' in e:
+          last[e['tag']] = e['value']  # keep the latest per tag
+    windows.append({
+        'fps': round(last.get('env_frames_per_sec', 0.0), 1),
+        'inference_mean_batch': round(
+            last.get('inference_mean_batch', 0.0), 2),
+        'buffer_unrolls': last.get('buffer_unrolls', 0.0),
+        'frames': int(run.frames),
+    })
 
-  last = {}
-  with open(os.path.join(logdir, 'summaries.jsonl')) as f:
-    for line in f:
-      e = json.loads(line)
-      if 'value' in e:
-        last[e['tag']] = e['value']  # keep the latest per tag
+  fps_sorted = sorted(w['fps'] for w in windows)
   return {
-      'fps': round(last.get('env_frames_per_sec', 0.0), 1),
-      'inference_mean_batch': round(
-          last.get('inference_mean_batch', 0.0), 2),
-      'buffer_unrolls': last.get('buffer_unrolls', 0.0),
+      'fps_median': fps_sorted[len(fps_sorted) // 2],
+      'fps_min': fps_sorted[0],
+      'fps_max': fps_sorted[-1],
+      'windows': windows,
       'actors': cfg.num_actors,
       'batch_size': cfg.batch_size,
-      'frames': int(run.frames),
   }
 
 
@@ -249,11 +273,12 @@ def bench_transport(smoke):
                       use_instruction=False)
   ingest_agent = ImpalaAgent(num_actions=9, use_instruction=False)
   contract = remote.trajectory_contract(ingest_cfg, ingest_agent, 9)
-  for nclients in ((1, 4) if not smoke else (1,)):
+
+  def run_ingest(nclients, validate):
     buf = ring_buffer.TrajectoryBuffer(16)
-    server = remote.TrajectoryIngestServer(buf, {'w': np.zeros(1)},
-                                           host='127.0.0.1',
-                                           contract=contract)
+    server = remote.TrajectoryIngestServer(
+        buf, {'w': np.zeros(1)}, host='127.0.0.1',
+        contract=contract if validate else None)
     stop_c = threading.Event()
 
     def drain():
@@ -272,7 +297,8 @@ def bench_transport(smoke):
       client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
                                         connect_timeout_secs=10)
       try:
-        client.handshake(contract)
+        if validate:
+          client.handshake(contract)
         while not stop_c.is_set():
           client.send_unroll(unroll)
           counts[i] += 1
@@ -306,10 +332,194 @@ def bench_transport(smoke):
           f'pump error: {pump_errors[0]!r}' if pump_errors else
           f'ingest bench moved no unrolls ({nclients} conns), no '
           'pump error recorded')
-    results[f'ingest_{nclients}conn'] = {
+    return {
         'unrolls_per_sec': round(got / dt, 1),
         'mb_per_sec': round(got * unroll_mb / dt, 1),
     }
+
+  for nclients in ((1, 4) if not smoke else (1,)):
+    results[f'ingest_{nclients}conn'] = run_ingest(nclients, True)
+  # The validation-cost delta (VERDICT r3 W4): production always
+  # validates, so the headline ingest numbers above include it; this
+  # pair quantifies what the precompiled fast path left on the table.
+  results['ingest_1conn_novalidate'] = run_ingest(1, False)
+  return results
+
+
+def bench_param_fanout(smoke):
+  """Learner param-snapshot EGRESS ceiling (VERDICT r3 Missing #1).
+
+  The other half of the reference's scaling story: weights served to
+  150–500 actor machines (reference: experiment.py ≈L415–455
+  `pin_global_variables` — variables pinned to the learner CPU because
+  serving them is a real cost; SURVEY §5.8). Every connected actor
+  host refetches the snapshot once per version bump, so worst-case
+  learner egress is hosts × blob_bytes / remote_publish_secs — this
+  stage measures the serving side of that term with the REAL flagship
+  blob (deep ResNet + instruction encoder, the tree every dmlab30
+  actor host fetches):
+
+  a) serving ceiling: N loopback clients looping get_params —
+     aggregate blobs/s and MB/s vs N. Clients unpickle on the SAME
+     core here, so this UNDERSTATES a real learner whose actor hosts
+     decode on their own CPUs; it is the per-core constant the PERF.md
+     arithmetic divides by, same methodology as the ingest stage.
+  b) ack-latency impact: one unroll pump (the hot ingest path) alone
+     vs sharing the server with 8 param fetchers — the blob shares
+     each connection's request-reply channel and the pump's acks queue
+     behind 6.5 MB sendalls on the others.
+  c) wire-shrink levers, measured one-off on the real blob: zlib-1
+     compression (ratio + CPU cost) and a bfloat16 cast (exactly
+     halves the float32 payload) — PERF.md takes or rejects each with
+     these numbers.
+  """
+  import pickle
+  import threading
+  import zlib
+  import numpy as np
+  import jax
+  from scalable_agent_tpu.models import ImpalaAgent, init_params
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+  from scalable_agent_tpu.runtime import remote, ring_buffer
+
+  h, w = (72, 96) if not smoke else (24, 32)
+  dur = 6.0 if not smoke else 0.8
+  agent = ImpalaAgent(num_actions=9,
+                      torso='deep' if not smoke else 'shallow',
+                      use_instruction=not smoke)
+  params = jax.device_get(init_params(
+      agent, jax.random.PRNGKey(0),
+      {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}))
+  blob = pickle.dumps(('params', 1, params),
+                      protocol=pickle.HIGHEST_PROTOCOL)
+  blob_mb = len(blob) / 1e6
+  results = {
+      'blob_mb': round(blob_mb, 2),
+      'num_params': int(sum(
+          x.size for x in jax.tree_util.tree_leaves(params))),
+  }
+
+  def run_fanout(nfetchers, with_pump):
+    """nfetchers get_params loops (+ optionally one unroll pump) against
+    one server; returns (blobs/s, pump stats or None)."""
+    buf = ring_buffer.TrajectoryBuffer(16)
+    server = remote.TrajectoryIngestServer(buf, params,
+                                           host='127.0.0.1')
+    stop = threading.Event()
+    fetch_counts = [0] * max(nfetchers, 1)
+    pump_count = [0]
+    pump_latencies = []
+    errors = []
+
+    def drain():
+      while not stop.is_set():
+        try:
+          buf.get(timeout=0.2)
+        except (TimeoutError, ring_buffer.Closed):
+          continue
+
+    def fetch(i):
+      client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                        connect_timeout_secs=10)
+      try:
+        while not stop.is_set():
+          client.fetch_params()
+          fetch_counts[i] += 1
+      except (OSError, RuntimeError, remote.LearnerShutdown) as e:
+        errors.append(e)
+      finally:
+        client.close()
+
+    t1 = (101 if not smoke else 6)
+    unroll = _transport_unroll(t1, h, w)
+
+    def pump():
+      client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                        connect_timeout_secs=10)
+      try:
+        while not stop.is_set():
+          t0 = time.perf_counter()
+          client.send_unroll(unroll)
+          pump_latencies.append(time.perf_counter() - t0)
+          pump_count[0] += 1
+      except (OSError, RuntimeError, remote.LearnerShutdown) as e:
+        errors.append(e)
+      finally:
+        client.close()
+
+    threads = [threading.Thread(target=drain, daemon=True)]
+    threads += [threading.Thread(target=fetch, args=(i,), daemon=True)
+                for i in range(nfetchers)]
+    if with_pump:
+      threads.append(threading.Thread(target=pump, daemon=True))
+    for t in threads:
+      t.start()
+    time.sleep(0.5)  # warm/connect
+    fetch_base, pump_base = sum(fetch_counts), pump_count[0]
+    lat_base = len(pump_latencies)
+    t0 = time.perf_counter()
+    time.sleep(dur / 2)
+    dt = time.perf_counter() - t0
+    fetched = sum(fetch_counts) - fetch_base
+    pumped = pump_count[0] - pump_base
+    window_lat = sorted(pump_latencies[lat_base:])
+    stop.set()
+    for t in threads[1:]:
+      t.join(timeout=5)
+    server.close()
+    buf.close()
+    threads[0].join(timeout=2)
+    if nfetchers and fetched == 0:
+      raise RuntimeError(
+          f'param fan-out moved no blobs ({nfetchers} fetchers); '
+          f'first error: {errors[0]!r}' if errors else
+          f'param fan-out moved no blobs ({nfetchers} fetchers)')
+    if with_pump and pumped == 0:
+      # Same no-silent-zero rule as the ingest stage: a dead pump must
+      # fail the bench, not publish a null latency row.
+      raise RuntimeError(
+          f'fan-out pump moved no unrolls; first error: '
+          f'{errors[0]!r}' if errors else
+          'fan-out pump moved no unrolls, no error recorded')
+    fanout = {'blobs_per_sec': round(fetched / dt, 1),
+              'mb_per_sec': round(fetched * blob_mb / dt, 1)}
+    pump_stats = None
+    if with_pump and window_lat:
+      pump_stats = {
+          'unrolls_per_sec': round(pumped / dt, 1),
+          'ack_p50_ms': round(
+              window_lat[len(window_lat) // 2] * 1e3, 2),
+          'ack_p99_ms': round(
+              window_lat[int(len(window_lat) * 0.99)
+                         if len(window_lat) > 1 else -1] * 1e3, 2),
+      }
+    return fanout, pump_stats
+
+  for nfetchers in ((1, 8, 32) if not smoke else (1,)):
+    fanout, _ = run_fanout(nfetchers, with_pump=False)
+    results[f'fanout_{nfetchers}host'] = fanout
+  _, pump_alone = run_fanout(0, with_pump=True)
+  contenders = 8 if not smoke else 1
+  _, pump_contended = run_fanout(contenders, with_pump=True)
+  results['pump_alone'] = pump_alone
+  results[f'pump_with_{contenders}_fetchers'] = pump_contended
+
+  # --- (c) wire-shrink levers, one-off on the real blob. ---
+  t0 = time.perf_counter()
+  z = zlib.compress(blob, 1)
+  z_secs = time.perf_counter() - t0
+  results['zlib1'] = {'ratio': round(len(z) / len(blob), 3),
+                      'compress_ms': round(z_secs * 1e3, 1)}
+  import ml_dtypes
+  t0 = time.perf_counter()
+  cast = jax.tree_util.tree_map(
+      lambda x: x.astype(ml_dtypes.bfloat16)
+      if x.dtype == np.float32 else x, params)
+  bblob = pickle.dumps(('params', 1, cast),
+                       protocol=pickle.HIGHEST_PROTOCOL)
+  b_secs = time.perf_counter() - t0
+  results['bf16_cast'] = {'ratio': round(len(bblob) / len(blob), 3),
+                          'cast_ms': round(b_secs * 1e3, 1)}
   return results
 
 
@@ -328,6 +538,9 @@ def main():
   transport = None
   if os.environ.get('BENCH_SKIP_TRANSPORT') != '1':
     transport = bench_transport(smoke)
+  fanout = None
+  if os.environ.get('BENCH_SKIP_FANOUT') != '1':
+    fanout = bench_param_fanout(smoke)
 
   baseline_per_chip = 200_000.0 / 16.0  # north star / v5e-16 chips
   out = {
@@ -345,6 +558,8 @@ def main():
     out['e2e'] = e2e
   if transport is not None:
     out['transport'] = transport
+  if fanout is not None:
+    out['param_fanout'] = fanout
   print(json.dumps(out))
 
 
