@@ -24,6 +24,10 @@ echo '== soak smoke (mechanics only: popart/pc stack runs, tiny shapes;'
 echo '   the real flagship soak is scripts/soak.py on the chip) =='
 SOAK_SMOKE=1 python scripts/soak.py
 
+echo '== churn-soak smoke (env kill + respawn + resource sampling'
+echo '   mechanics; the real >=20 min churn soak runs on the chip) =='
+SOAK_SMOKE=1 SOAK_CHURN=1 python scripts/soak.py
+
 echo '== byte-attribution smoke (cost_analysis mechanics) =='
 SMOKE=1 python scripts/attribute_bytes.py
 

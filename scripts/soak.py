@@ -1,4 +1,4 @@
-"""Flagship stability soak (VERDICT r2 item 6).
+"""Flagship stability soak (VERDICT r2 item 6; churn mode r3 W3).
 
 The composition test proves the full extension stack RUNS; this proves
 it is STABLE AND LEARNING over a sustained run: the real driver
@@ -13,11 +13,30 @@ control — on the contextual-bandit task, asserting over the whole run:
   - episode return IMPROVES (last-third mean > first-third mean) and
     beats the random baseline (~1/3 on 3-arm bandit).
 
-Writes SOAK_r03.json at the repo root. Invocation (real chip, ~10 min):
+SOAK_CHURN=1 additionally exercises the elasticity machinery under
+sustained failure — the greenfield feature the reference never had
+(its actors just die, SURVEY §5.3), so this is its proof of life
+(VERDICT r3 W3):
 
-    python scripts/soak.py                 # SOAK_SECONDS=600 default
-    SOAK_SECONDS=120 python scripts/soak.py
-    SOAK_SMOKE=1 python scripts/soak.py    # CPU mechanics check, ~40 s
+  - every ~60 s one env process is SIGKILLed (fleet must respawn it
+    and keep training),
+  - a remote actor host (the production `--job_name=actor` CLI)
+    feeds the learner over TCP; mid-run it is killed and a
+    replacement spawned (ingest must accept the reconnect and remote
+    unrolls must resume),
+  - RSS / thread-count / open-fd curves are sampled throughout and
+    must stay flat — a slow leak in the respawn/reconnect paths
+    would be invisible in short targeted tests.
+
+Writes SOAK_r04.json at the repo root. Invocation (real chip):
+
+    SOAK_CHURN=1 python scripts/soak.py        # ~20 min churn soak
+    python scripts/soak.py                      # 10 min steady-state
+    SOAK_SECONDS=1500 SOAK_CHURN=1 python scripts/soak.py
+    SOAK_SMOKE=1 [SOAK_CHURN=1] python scripts/soak.py  # CPU mechanics
+
+NOTE: a 600 s Bash timeout cannot fit the real runs (compiles eat
+~2 min) — run detached and poll the artifact.
 
 Learning hyperparameters: lr 5e-4 (≈ the paper's tuned 4.8e-4),
 entropy 3e-3, γ=0 (the task is one-step). The smoke test's hotter
@@ -29,18 +48,241 @@ learns to optimal.
 """
 
 import json
+import multiprocessing
 import os
+import random
+import signal
+import socket
+import subprocess
 import sys
 import tempfile
+import threading
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _file_tail(path, n):
+  """Last n bytes of a possibly large file, without slurping it."""
+  if not os.path.exists(path):
+    return ''
+  with open(path, 'rb') as f:
+    f.seek(0, os.SEEK_END)
+    size = f.tell()
+    f.seek(max(0, size - n))
+    return f.read().decode('utf-8', errors='replace')
+
+
+def _rss_mb():
+  with open('/proc/self/status') as f:
+    for line in f:
+      if line.startswith('VmRSS:'):
+        return int(line.split()[1]) / 1024.0
+  return float('nan')
+
+
+def _num_fds():
+  return len(os.listdir('/proc/self/fd'))
+
+
+def _spawn_remote_actor(cfg, port, log_path):
+  """The production actor-host CLI (`--job_name=actor`), loopback.
+  Flags cover every trajectory-contract field the soak config sets;
+  both roles then derive identical contracts. Output goes to a FILE,
+  not a PIPE: over a long soak the actor logs every param refresh and
+  an undrained 64 KB pipe buffer would eventually block it inside a
+  log write — a wedged feed misreported as an elasticity bug."""
+  cmd = [
+      sys.executable, os.path.join(REPO, 'experiment.py'),
+      '--job_name=actor', '--task=0',
+      f'--learner_address=127.0.0.1:{port}',
+      f'--logdir={cfg.logdir}',
+      '--env_backend=bandit', '--num_actors=2',
+      f'--batch_size={cfg.batch_size}',
+      f'--unroll_length={cfg.unroll_length}',
+      '--num_action_repeats=1',
+      f'--episode_length={cfg.episode_length}',
+      f'--height={cfg.height}', f'--width={cfg.width}',
+      f'--torso={cfg.torso}', f'--compute_dtype={cfg.compute_dtype}',
+      '--use_instruction=true', '--use_popart=true',
+      f'--pixel_control_cost={cfg.pixel_control_cost}',
+      '--discounting=0.0',
+      f'--inference_timeout_ms={cfg.inference_timeout_ms}',
+      '--actor_reconnect_secs=120',
+      f'--seed={cfg.seed + 50}',
+  ]
+  env = {k: v for k, v in os.environ.items()
+         if k not in ('XLA_FLAGS', 'JAX_PLATFORMS')}
+  existing = env.get('PYTHONPATH', '')
+  env['PYTHONPATH'] = (REPO + os.pathsep + existing if existing
+                       else REPO)
+  log_file = open(log_path, 'a')
+  try:
+    return subprocess.Popen(cmd, cwd=REPO, env=env, stdout=log_file,
+                            stderr=subprocess.STDOUT, text=True)
+  finally:
+    log_file.close()  # the child holds its own descriptor
+
+
+def _wait_port(port, deadline, stop):
+  """Block until the learner's ingest port accepts (it binds BEFORE
+  the 20–40 s inference compile, so this resolves early). Bails out
+  when `stop` is set — a learner that fails during setup must not
+  leave this probing for the whole run duration."""
+  while time.monotonic() < deadline and not stop.is_set():
+    try:
+      with socket.create_connection(('127.0.0.1', port), timeout=2):
+        return True
+    except OSError:
+      stop.wait(1.0)
+  return False
+
+
+class Churn:
+  """Background failure injector + resource sampler.
+
+  Runs beside driver.train in the learner process: SIGKILLs one env
+  child every `kill_every` seconds, drops and replaces the remote
+  actor host once at ~55% of the run, samples RSS/threads/fds every
+  `sample_every` seconds. `stop()` ends it and reaps the child."""
+
+  def __init__(self, cfg, port, seconds, smoke):
+    self._cfg = cfg
+    self._port = port
+    self._seconds = seconds
+    self._smoke = smoke
+    self._stop = threading.Event()
+    self.events = []
+    self.samples = []  # (t, rss_mb, threads, fds)
+    self.env_kills = 0
+    self.port_probes = 0  # each probe counts in the server's conns
+    self.actor_log = os.path.join(cfg.logdir, 'remote_actor.log')
+    self._actor = None
+    self._thread = threading.Thread(target=self._run,
+                                    name='churn', daemon=True)
+
+  def start(self):
+    self._thread.start()
+
+  def _event(self, what):
+    self.events.append({'t': round(time.monotonic() - self._t0, 1),
+                        'wall_time': round(time.time(), 3),
+                        'event': what})
+
+  def _kill_one_env(self):
+    # Env processes are the mp (forkserver) children of THIS process;
+    # the remote actor is a subprocess.Popen and so not in this list.
+    children = multiprocessing.active_children()
+    if not children:
+      self._event('no env child to kill')
+      return
+    victim = random.choice(children)
+    try:
+      os.kill(victim.pid, signal.SIGKILL)
+      self.env_kills += 1
+      self._event(f'SIGKILL env pid {victim.pid}')
+    except (OSError, AttributeError) as e:
+      self._event(f'env kill failed: {e!r}')
+
+  def _reap_actor(self):
+    if self._actor is None:
+      return
+    try:
+      self._actor.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+      self._actor.kill()
+      self._actor.wait()
+    self._actor = None
+
+  def _run(self):
+    self._t0 = time.monotonic()
+    grace = 20 if self._smoke else 120       # past compile/warmup
+    kill_every = 8 if self._smoke else 60
+    sample_every = 2 if self._smoke else 15
+    use_remote = not self._smoke            # CLI child ~2 min to boot
+    drop_at = self._seconds * 0.55
+    next_kill = grace
+    next_sample = 0.0
+    dropped = False
+    if use_remote:
+      if _wait_port(self._port, self._t0 + self._seconds, self._stop):
+        self.port_probes = 1
+        self._actor = _spawn_remote_actor(self._cfg, self._port,
+                                          self.actor_log)
+        self._event('remote actor spawned')
+      else:
+        self._event('ingest port never opened')
+    while not self._stop.wait(0.5):
+      t = time.monotonic() - self._t0
+      if t >= next_sample:
+        self.samples.append((round(t, 1), round(_rss_mb(), 1),
+                             threading.active_count(), _num_fds()))
+        next_sample = t + sample_every
+      if t >= next_kill:
+        self._kill_one_env()
+        next_kill = t + kill_every
+      if use_remote and not dropped and t >= drop_at:
+        dropped = True
+        if self._actor is not None and self._actor.poll() is None:
+          self._actor.kill()
+          self._event('SIGKILL remote actor host')
+        self._reap_actor()
+        self._actor = _spawn_remote_actor(self._cfg, self._port,
+                                          self.actor_log)
+        self.drop_wall_time = time.time()
+        self._event('replacement remote actor spawned')
+
+  def stop(self):
+    self._stop.set()
+    self._thread.join(timeout=10)
+    if self._actor is not None and self._actor.poll() is None:
+      # Learner is down by now; the child's reconnect window would
+      # just burn — end it.
+      self._actor.kill()
+    self._reap_actor()
+
+
+def _flatness_problems(samples):
+  """Fail on growth that looks like a leak: compare the run's tail
+  against the post-warmup reference window. Thresholds are loose
+  enough for allocator noise and respawn transients, tight enough
+  that an unbounded leak over ≥20 min trips them."""
+  problems = []
+  if len(samples) < 8:
+    problems.append(f'only {len(samples)} resource samples')
+    return problems
+  body = samples[len(samples) // 4:]          # drop warmup quarter
+  ref = body[:max(len(body) // 2, 1)]
+  tail = body[-3:]
+  ref_rss = max(s[1] for s in ref)
+  ref_thr = max(s[2] for s in ref)
+  ref_fds = max(s[3] for s in ref)
+  for name, idx, bound in (('rss_mb', 1, ref_rss * 1.20),
+                           ('threads', 2, ref_thr + 4),
+                           ('fds', 3, ref_fds + 16)):
+    worst = max(s[idx] for s in tail)
+    if worst > bound:
+      problems.append(
+          f'{name} grew: tail max {worst} vs reference {bound:.1f} '
+          f'(post-warmup ref max × tolerance)')
+  return problems
+
+
+def _downsample(samples, n=40):
+  if len(samples) <= n:
+    return samples
+  step = len(samples) / n
+  return [samples[int(i * step)] for i in range(n)] + [samples[-1]]
+
 
 def main():
   smoke = os.environ.get('SOAK_SMOKE') == '1'
-  seconds = float(os.environ.get('SOAK_SECONDS', '600' if not smoke
-                                 else '40'))
+  churn = os.environ.get('SOAK_CHURN') == '1'
+  default_secs = ('40' if smoke else '1200' if churn else '600')
+  seconds = float(os.environ.get('SOAK_SECONDS', default_secs))
   if smoke:
     import jax
     jax.config.update('jax_platforms', 'cpu')
@@ -50,6 +292,10 @@ def main():
   from scalable_agent_tpu.config import Config
 
   logdir = tempfile.mkdtemp(prefix='soak_')
+  ingest_port = 0
+  if churn:
+    with socket.create_server(('127.0.0.1', 0)) as s:
+      ingest_port = s.getsockname()[1]
   cfg = Config(
       logdir=logdir,
       env_backend='bandit',
@@ -62,7 +308,8 @@ def main():
       width=96 if not smoke else 32,
       torso='deep' if not smoke else 'shallow',
       compute_dtype='bfloat16' if not smoke else 'float32',
-      use_py_process=not smoke,
+      # Churn needs real processes to kill — also in smoke.
+      use_py_process=(not smoke) or churn,
       use_instruction=True,
       use_popart=True,
       pixel_control_cost=0.01,
@@ -74,10 +321,23 @@ def main():
       inference_timeout_ms=20,
       checkpoint_secs=10**6,
       summary_secs=10 if not smoke else 2,
+      remote_actor_port=ingest_port,
       seed=7)
-  run = driver.train(cfg, max_seconds=seconds, stall_timeout_secs=180)
+
+  churner = None
+  if churn:
+    churner = Churn(cfg, ingest_port, seconds, smoke)
+    churner.start()
+  try:
+    run = driver.train(cfg, max_seconds=seconds,
+                       stall_timeout_secs=180)
+  finally:
+    if churner is not None:
+      churner.stop()
 
   losses, sigmas_min, sigmas_max, returns = [], [], [], []
+  remote_unrolls = []  # (wall_time, cumulative unrolls over the wire)
+  remote_conns = 0
   with open(os.path.join(logdir, 'summaries.jsonl')) as f:
     for line in f:
       e = json.loads(line)
@@ -89,6 +349,10 @@ def main():
         sigmas_min.append(e['value'])
       elif e['tag'] == 'popart_sigma_max':
         sigmas_max.append(e['value'])
+      elif e['tag'] == 'remote_unrolls':
+        remote_unrolls.append((e['wall_time'], e['value']))
+      elif e['tag'] == 'remote_connections':
+        remote_conns = max(remote_conns, int(e['value']))
       elif e['tag'].endswith('/episode_return'):
         returns.append(e['value'])
 
@@ -126,6 +390,51 @@ def main():
           f'return does not clear the random baseline '
           f'({random_baseline:.2f}): late={late:.3f}')
 
+  churn_artifact = None
+  if churner is not None:
+    respawns = run.fleet.stats()['respawns']
+    if churner.env_kills == 0:
+      problems.append('churn mode killed no env process')
+    elif respawns == 0:
+      problems.append(
+          f'{churner.env_kills} env kills but fleet recorded 0 '
+          'respawns')
+    if not smoke:
+      # The remote host was dropped and replaced: cumulative ingest
+      # connections must show BOTH actors beyond the churn thread's
+      # own port probe (which the server also counts), and unrolls
+      # must keep landing AFTER the replacement connected.
+      needed = 2 + churner.port_probes
+      if remote_conns < needed:
+        problems.append(
+            f'expected >={needed} cumulative remote connections '
+            f'({churner.port_probes} probe + original + replacement), '
+            f'saw {remote_conns}')
+      drop_wall = getattr(churner, 'drop_wall_time', None)
+      if drop_wall is None:
+        problems.append('remote actor was never dropped/replaced')
+      else:
+        before = max((v for w, v in remote_unrolls
+                      if w <= drop_wall), default=0)
+        after = max((v for w, v in remote_unrolls), default=0)
+        if after <= before:
+          problems.append(
+              f'remote unrolls did not resume after the drop: '
+              f'{before} before vs {after} final')
+    problems.extend(_flatness_problems(churner.samples))
+    churn_artifact = {
+        'env_kills': churner.env_kills,
+        'fleet_respawns': respawns,
+        'remote_connections': remote_conns,
+        'remote_unrolls_final': (remote_unrolls[-1][1]
+                                 if remote_unrolls else 0),
+        'events': churner.events,
+        'resource_curve': [
+            {'t': t, 'rss_mb': r, 'threads': th, 'fds': fd}
+            for t, r, th, fd in _downsample(churner.samples)],
+        'actor_tail': _file_tail(churner.actor_log, 400),
+    }
+
   n_chunks = 8
   chunk = max(len(returns) // n_chunks, 1)
   curve = [round(float(np.mean(returns[i:i + chunk])), 3)
@@ -145,6 +454,7 @@ def main():
       'popart_sigma_range': ([round(float(min(sigmas_min)), 5),
                               round(float(max(sigmas_max)), 5)]
                              if sigmas_max else None),
+      'churn': churn_artifact,
       'stack': {
           'torso': cfg.torso, 'compute_dtype': cfg.compute_dtype,
           'frames': [cfg.height, cfg.width],
@@ -156,8 +466,7 @@ def main():
       },
       'smoke': smoke,
   }
-  out_path = os.path.join(os.path.dirname(os.path.dirname(
-      os.path.abspath(__file__))), 'SOAK_r03.json')
+  out_path = os.path.join(REPO, 'SOAK_r04.json')
   if smoke:
     out_path = os.path.join(logdir, 'SOAK_smoke.json')
   with open(out_path, 'w') as f:
