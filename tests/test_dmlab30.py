@@ -21,6 +21,21 @@ def test_level_table_shape():
             > dmlab30.RANDOM_SCORES[test_level])
 
 
+def test_table_values_are_sane():
+  """Property bounds on the reconstructed tables (VERDICT r3 #7: the
+  constants can't be re-verified offline — provenance is caveated in
+  the module — but damage is bounded: finite floats, exactly the
+  benchmark's key sets, well-formed level names)."""
+  assert set(dmlab30.HUMAN_SCORES) == set(dmlab30.LEVEL_MAPPING.values())
+  assert set(dmlab30.RANDOM_SCORES) == set(dmlab30.LEVEL_MAPPING.values())
+  for table in (dmlab30.HUMAN_SCORES, dmlab30.RANDOM_SCORES):
+    for level, score in table.items():
+      assert np.isfinite(score), (level, score)
+      assert isinstance(score, float), (level, score)
+  for name in (*dmlab30.ALL_LEVELS, *dmlab30.LEVEL_MAPPING.values()):
+    assert name == name.lower() and ' ' not in name, name
+
+
 def test_score_at_anchors():
   # Returns exactly at the random anchor -> 0; at the human anchor -> 100.
   random_returns = {
