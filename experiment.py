@@ -50,6 +50,11 @@ flags.DEFINE_string('remote_actor_bind_host',
                     'unauthenticated pickle — for real actor hosts, '
                     'explicitly bind a cluster-internal interface; '
                     'never expose the port publicly.')
+flags.DEFINE_string('remote_params_dtype',
+                    _DEFAULTS.remote_params_dtype,
+                    "Wire dtype for served param snapshots: '' exact "
+                    "float32, 'bfloat16' halves the learner's weight "
+                    'egress (actors upcast on receipt).')
 flags.DEFINE_float('actor_reconnect_secs',
                    _DEFAULTS.actor_reconnect_secs,
                    'Actor: on disconnect, retry the learner for this '

@@ -122,6 +122,14 @@ class Config:
   # Min seconds between param snapshots published to remote hosts (a
   # publish is a full device_get; remote staleness ~ this value).
   remote_publish_secs: float = 2.0
+  # Wire dtype for served param snapshots: '' ships exact float32;
+  # 'bfloat16' casts float32 leaves for the wire (the actor host
+  # upcasts back) — exactly halves the dominant term of learner
+  # egress (hosts x blob_bytes / remote_publish_secs; docs/PERF.md
+  # "Param-snapshot egress") at a measured ~ms cast cost. Acting
+  # tolerates the ~3 decimal digits of mantissa (inference already
+  # runs bfloat16 compute); training state is never touched.
+  remote_params_dtype: str = ''
   # Actor-host elasticity: on disconnect, keep retrying the learner
   # for this many seconds (surviving a learner restart-from-
   # checkpoint) instead of exiting. 0 = exit on disconnect.

@@ -322,7 +322,8 @@ def train(config: Config, max_steps: Optional[int] = None,
           host=config.remote_actor_bind_host,
           port=config.remote_actor_port,
           contract=remote.trajectory_contract(config, agent,
-                                              num_actions))
+                                              num_actions),
+          wire_dtype=config.remote_params_dtype)
       log.info('remote-actor ingest listening on port %d', ingest.port)
     # --- Inference server (weights served host-side to actor
     # threads). Per-process seed offset: params/init use config.seed
