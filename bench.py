@@ -164,6 +164,26 @@ def _transport_unroll(t1, h, w, num_actions=9):
                              MAX_INSTRUCTION_LEN)
 
 
+def _count_window(count_fn, base, min_dur, min_count=8, max_dur=30.0):
+  """Measure a completion-counter window robustly.
+
+  Sleeps at least `min_dur`, then keeps extending (in 50 ms slices, up
+  to `max_dur`) until at least `min_count` completions landed. A loaded
+  1-core CI host can legitimately finish zero requests inside a 0.4 s
+  smoke window — that starvation is scheduling noise, not a pipeline
+  rate, and publishing 0.0 into the scaling arithmetic (or a smoke
+  assert) is wrong. A genuinely dead stage still terminates: after
+  `max_dur` we return whatever was counted (possibly 0) and the
+  caller's zero-checks fire with their diagnostics.
+  """
+  t0 = time.perf_counter()
+  time.sleep(min_dur)
+  while (count_fn() - base < min_count
+         and time.perf_counter() - t0 < max_dur):
+    time.sleep(0.05)
+  return time.perf_counter() - t0
+
+
 def bench_transport(smoke):
   """Host-transport ceiling with the TPU tunnel and the envs OUT of
   the loop (VERDICT r2 Missing #1 / W4): what the host-side pipeline
@@ -252,9 +272,7 @@ def bench_transport(smoke):
       t.start()
     time.sleep(0.3)  # warm
     base = sum(counts)
-    t0 = time.perf_counter()
-    time.sleep(dur / 2)
-    dt = time.perf_counter() - t0
+    dt = _count_window(lambda: sum(counts), base, dur / 2)
     got = sum(counts) - base
     # Join BEFORE close: close() cancels in-flight requests, which
     # raises BatcherCancelled out of any worker still inside fn().
@@ -320,9 +338,7 @@ def bench_transport(smoke):
       t.start()
     time.sleep(0.3)  # warm/connect
     base = sum(counts)
-    t0 = time.perf_counter()
-    time.sleep(dur / 2)
-    dt = time.perf_counter() - t0
+    dt = _count_window(lambda: sum(counts), base, dur / 2)
     got = sum(counts) - base
     stop_c.set()
     for t in pumps:
@@ -461,9 +477,16 @@ def bench_param_fanout(smoke):
     time.sleep(0.5)  # warm/connect
     fetch_base, pump_base = sum(fetch_counts), pump_count[0]
     lat_base = len(pump_latencies)
-    t0 = time.perf_counter()
-    time.sleep(dur / 2)
-    dt = time.perf_counter() - t0
+
+    def progress():
+      vals = []
+      if nfetchers:
+        vals.append(sum(fetch_counts) - fetch_base)
+      if with_pump:
+        vals.append(pump_count[0] - pump_base)
+      return min(vals) if vals else 1 << 30
+
+    dt = _count_window(progress, 0, dur / 2)
     fetched = sum(fetch_counts) - fetch_base
     pumped = pump_count[0] - pump_base
     window_lat = sorted(pump_latencies[lat_base:])
