@@ -40,7 +40,7 @@ from scalable_agent_tpu import checkpoint as checkpoint_lib
 from scalable_agent_tpu import learner as learner_lib
 from scalable_agent_tpu import observability
 from scalable_agent_tpu.config import Config
-from scalable_agent_tpu.envs import dmlab30, factory
+from scalable_agent_tpu.envs import factory, suites
 from scalable_agent_tpu.models import ImpalaAgent, init_params
 from scalable_agent_tpu.parallel import mesh as mesh_lib
 from scalable_agent_tpu.parallel import train_parallel
@@ -384,7 +384,9 @@ def train(config: Config, max_steps: Optional[int] = None,
         json.dump(dataclasses.asdict(config), f, indent=2,
                   sort_keys=True)
     stats = observability.EpisodeStats(
-        levels, multi_task=(config.level_name == 'dmlab30'),
+        levels,
+        benchmark=(config.level_name
+                   if config.level_name in suites.SUITES else None),
         writer=writer)
     fps_meter = observability.FpsMeter()
     run = TrainRun(config, agent, state, fleet, prefetcher, server,
@@ -791,14 +793,12 @@ def evaluate(config: Config,
     writer.scalar(f'{test_name}/test_episode_return', mean_return,
                   step)
 
-  if config.level_name == 'dmlab30':
-    no_cap = dmlab30.compute_human_normalized_score(
-        level_returns, per_level_cap=None)
-    cap_100 = dmlab30.compute_human_normalized_score(
-        level_returns, per_level_cap=100)
-    log.info('dmlab30 human-normalized: no_cap=%.1f cap_100=%.1f',
-             no_cap, cap_100)
-    writer.scalar('dmlab30/test_no_cap', no_cap, step)
-    writer.scalar('dmlab30/test_cap_100', cap_100, step)
+  if config.level_name in suites.SUITES:
+    scores = suites.SUITES[config.level_name].eval_scores(level_returns)
+    log.info('%s human-normalized: %s', config.level_name,
+             ' '.join(f'{t.split("/")[-1]}={v:.1f}'
+                      for t, v in scores.items()))
+    for tag, value in scores.items():
+      writer.scalar(tag, value, step)
   writer.close()
   return level_returns

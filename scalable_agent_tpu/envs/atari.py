@@ -67,10 +67,17 @@ class AtariEnv(base.Environment):
     so it is deterministic under the env seed and testable without
     ALE. 0.0 (default) matches the reference-era deterministic
     protocol.
+
+    is_test does NOT disable no-op starts: the random-≤30-no-op
+    regime is the ALE *evaluation* protocol (DQN/IMPALA-era scores
+    are reported under it) — without it a deterministic ALE would
+    replay near-identical eval episodes. It is accepted for API
+    symmetry with the DMLab adapter (whose test mode switches
+    holdout levels/mixerSeed).
     """
     self._h, self._w = height, width
     self._num_action_repeats = num_action_repeats
-    self._noop_max = 0 if is_test else noop_max
+    self._noop_max = noop_max
     self._sticky_prob = float(sticky_action_prob)
     if not 0.0 <= self._sticky_prob <= 1.0:
       # Fail fast: e.g. 25 meant-as-percent would otherwise make
@@ -181,6 +188,15 @@ class _AlePyBackend:
     return np.asarray(self._ale.getScreenRGB(), np.uint8)
 
 
+def gym_game_id(game: str) -> str:
+  """Canonical snake_case rom id ('kung_fu_master', the envs/atari57.py
+  convention) → gymnasium's CamelCase registration ('KungFuMaster').
+  Already-CamelCase names pass through."""
+  if '_' in game or game.islower():
+    return ''.join(part.capitalize() for part in game.split('_'))
+  return game
+
+
 class _GymnasiumBackend:
   """Fallback over gymnasium's ALE envs (frameskip disabled — the
   adapter owns action repeat and pooling)."""
@@ -188,7 +204,8 @@ class _GymnasiumBackend:
   def __init__(self, game, seed, full_action_set):
     import gymnasium
     self._env = gymnasium.make(
-        f'ALE/{game}-v5', frameskip=1, repeat_action_probability=0.0,
+        f'ALE/{gym_game_id(game)}-v5', frameskip=1,
+        repeat_action_probability=0.0,
         full_action_space=full_action_set, render_mode='rgb_array')
     self._seed = int(seed)
     self._frame = None

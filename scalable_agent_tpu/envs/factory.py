@@ -15,7 +15,7 @@ via runtime/py_process.py — the reference's PyProcess GIL-escape.
 from typing import List, Optional, Tuple
 
 from scalable_agent_tpu.config import Config
-from scalable_agent_tpu.envs import dmlab30
+from scalable_agent_tpu.envs import suites
 from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
 
 
@@ -38,17 +38,18 @@ class EnvSpec(object):
 
 
 def level_names(config: Config) -> List[str]:
-  """Training level list; 'dmlab30' expands to the 30-level benchmark
-  (reference: experiment.py main ≈L630)."""
-  if config.level_name == 'dmlab30':
-    return list(dmlab30.ALL_LEVELS)
+  """Training level list; a suite name ('dmlab30', 'atari57') expands
+  to its full level list (reference: experiment.py main ≈L630)."""
+  if config.level_name in suites.SUITES:
+    return list(suites.SUITES[config.level_name].train_levels)
   return [config.level_name]
 
 
 def test_level_names(config: Config) -> List[str]:
-  """Held-out eval variants (reference: dmlab30.LEVEL_MAPPING)."""
-  if config.level_name == 'dmlab30':
-    return list(dmlab30.LEVEL_MAPPING.values())
+  """Held-out eval variants (reference: dmlab30.LEVEL_MAPPING; see
+  envs/suites.py for the per-suite eval-level story)."""
+  if config.level_name in suites.SUITES:
+    return list(suites.SUITES[config.level_name].test_levels)
   return [config.level_name]
 
 

@@ -25,7 +25,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from scalable_agent_tpu.envs import dmlab30
+from scalable_agent_tpu.envs import suites
 
 
 class SummaryWriter:
@@ -135,22 +135,31 @@ class EpisodeStats:
 
   Mirrors the reference learner loop (experiment.py ≈L590–620): every
   finished episode logs `<level>/episode_return` and
-  `<level>/episode_frames`; in multi-task mode, once EVERY level has at
-  least one finished episode, emit `dmlab30/training_no_cap` and
-  `dmlab30/training_cap_100` human-normalized scores over the per-level
-  means, then reset the accumulator.
+  `<level>/episode_frames`; in benchmark mode, once EVERY level has at
+  least one finished episode, emit the suite's human-normalized
+  training scores over the per-level means (`dmlab30/training_no_cap`
+  + `dmlab30/training_cap_100`, or `atari57/training_median` +
+  `atari57/training_mean`), then reset the accumulator.
 
   Args:
     level_names: id → name mapping (actors carry int level ids;
       strings never enter trajectories).
-    multi_task: enable the dmlab30 scoring path (level_names must then
-      be the 30 training levels).
+    multi_task: legacy alias for benchmark='dmlab30'.
+    benchmark: None | 'dmlab30' | 'atari57' — enables the suite
+      scoring path (level_names must then be that suite's levels).
   """
 
   def __init__(self, level_names: List[str], multi_task: bool = False,
-               writer: Optional[SummaryWriter] = None):
+               writer: Optional[SummaryWriter] = None,
+               benchmark: Optional[str] = None):
     self._level_names = list(level_names)
-    self._multi_task = multi_task
+    if benchmark is None and multi_task:
+      benchmark = 'dmlab30'
+    if benchmark is not None and benchmark not in suites.SUITES:
+      raise ValueError(f'unknown benchmark {benchmark!r} '
+                       f'(suites: {sorted(suites.SUITES)})')
+    self._multi_task = benchmark is not None
+    self._suite = suites.SUITES[benchmark] if benchmark else None
     self._writer = writer
     self._level_returns: Dict[str, List[float]] = {
         name: [] for name in self._level_names}
@@ -178,12 +187,7 @@ class EpisodeStats:
     if not all(self._level_returns.get(name)
                for name in self._level_names):
       return
-    no_cap = dmlab30.compute_human_normalized_score(
-        self._level_returns, per_level_cap=None)
-    cap_100 = dmlab30.compute_human_normalized_score(
-        self._level_returns, per_level_cap=100)
-    self.last_scores = {'dmlab30/training_no_cap': no_cap,
-                        'dmlab30/training_cap_100': cap_100}
+    self.last_scores = self._suite.training_scores(self._level_returns)
     if self._writer is not None:
       self._writer.scalars(self.last_scores, step)
     self._level_returns = {name: [] for name in self._level_names}

@@ -202,6 +202,17 @@ def test_atari_noop_starts_bounded():
   assert 0 <= ale._acts <= 30
 
 
+def test_atari_noop_starts_stay_on_in_test_mode():
+  """Random ≤30-no-op starts are the ALE *eval* protocol; is_test must
+  not disable them (a deterministic ALE would otherwise replay
+  near-identical eval episodes)."""
+  expected = np.random.RandomState(7).randint(31)  # = 15; first rng draw
+  ale = FakeAle(episode_len=1000)
+  atari.AtariEnv('pong', seed=7, height=24, width=32,
+                 noop_max=30, is_test=True, ale=ale)
+  assert ale._acts == expected > 0
+
+
 def test_atari_specs():
   specs = atari.AtariEnv._tensor_specs('step', None,
                                        {'height': 84, 'width': 84})
