@@ -55,6 +55,12 @@ flags.DEFINE_string('remote_params_dtype',
                     "Wire dtype for served param snapshots: '' exact "
                     "float32, 'bfloat16' halves the learner's weight "
                     'egress (actors upcast on receipt).')
+flags.DEFINE_float('remote_publish_secs',
+                   _DEFAULTS.remote_publish_secs,
+                   'Min seconds between param snapshots published to '
+                   'remote actor hosts; the main knob on learner '
+                   'weight egress (hosts x blob_bytes / this) and '
+                   'remote policy staleness (docs/PERF.md).')
 flags.DEFINE_float('actor_reconnect_secs',
                    _DEFAULTS.actor_reconnect_secs,
                    'Actor: on disconnect, retry the learner for this '
@@ -136,6 +142,45 @@ flags.DEFINE_integer('inference_max_batch', _DEFAULTS.inference_max_batch,
 flags.DEFINE_integer('inference_timeout_ms',
                      _DEFAULTS.inference_timeout_ms,
                      'Dynamic batcher flush timeout.')
+flags.DEFINE_integer('num_actions', _DEFAULTS.num_actions,
+                     'Policy head size override (None = backend '
+                     'default; Atari: 18 full set, fewer = minimal '
+                     'set, validated against the backend).')
+flags.DEFINE_float('popart_beta', _DEFAULTS.popart_beta,
+                   'PopArt statistics EMA step size.')
+flags.DEFINE_float('pixel_control_discount',
+                   _DEFAULTS.pixel_control_discount,
+                   'UNREAL pixel-control n-step discount.')
+flags.DEFINE_integer('pixel_control_cell_size',
+                     _DEFAULTS.pixel_control_cell_size,
+                     'UNREAL pixel-control spatial cell size.')
+flags.DEFINE_float('grad_clip_norm', _DEFAULTS.grad_clip_norm,
+                   'Global gradient-norm clip (None = off, the '
+                   'reference behavior).')
+flags.DEFINE_bool('use_associative_scan', _DEFAULTS.use_associative_scan,
+                  'V-trace via lax.associative_scan (log-depth in T) '
+                  'instead of the sequential scan.')
+flags.DEFINE_bool('use_pallas_vtrace', _DEFAULTS.use_pallas_vtrace,
+                  'V-trace via the fused Pallas TPU kernel '
+                  '(single-device meshes only).')
+flags.DEFINE_integer('scan_unroll', _DEFAULTS.scan_unroll,
+                     'LSTM time-scan unroll factor (perf knob; see '
+                     'config.py for the measured sweep).')
+flags.DEFINE_integer('checkpoint_secs', _DEFAULTS.checkpoint_secs,
+                     'Seconds between checkpoints (reference '
+                     'save_checkpoint_secs=600).')
+flags.DEFINE_integer('checkpoint_check_every_steps',
+                     _DEFAULTS.checkpoint_check_every_steps,
+                     'Learner steps between cross-host checkpoint-'
+                     'cadence broadcasts (multi-host).')
+flags.DEFINE_integer('summary_secs', _DEFAULTS.summary_secs,
+                     'Seconds between summary flushes (reference '
+                     'save_summaries_secs=30).')
+flags.DEFINE_integer('queue_capacity_batches',
+                     _DEFAULTS.queue_capacity_batches,
+                     'Trajectory buffer capacity in batches '
+                     '(reference FIFOQueue capacity=1; small keeps '
+                     'policy lag bounded).')
 flags.DEFINE_string('profile_dir', _DEFAULTS.profile_dir,
                     'Capture a jax.profiler trace of a few learner '
                     'steps into this directory.')

@@ -1,0 +1,125 @@
+"""Convert a run's JSONL summaries into TensorBoard event files.
+
+The reference writes `tf.summary` event files an operator watches in
+TensorBoard (experiment.py ≈L570 MonitoredTrainingSession
+save_summaries_secs + the manual per-episode Summary protos ≈L590).
+This build logs JSONL (observability.py — grep/jq-able, no TF
+dependency on the hot path); this offline converter gives reference
+operators their TensorBoard view back:
+
+    python scripts/to_tensorboard.py LOGDIR [--out OUT]
+    tensorboard --logdir OUT   # default: LOGDIR/tb
+
+Each summary stream becomes a TB run: `summaries.jsonl` -> train,
+`summaries_p3.jsonl` -> train_p3 (multi-host: one stream per process),
+`eval_summaries.jsonl` -> eval. Scalars convert exactly (tag, value,
+step, wall time). Histograms (kind=histogram: integer `counts`,
+optional bin `edges`) convert via add_histogram_raw; min/max/sum/
+sum_sq are reconstructed from bin centers — fine for the shape-of-
+distribution reading these are for.
+
+Import-guarded: requires the `tensorboard` package (ships with torch
+in this image); the training path never imports it.
+"""
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import sys
+
+
+def _run_name(filename):
+  base = os.path.basename(filename)
+  if base == 'summaries.jsonl':
+    return 'train'
+  if base == 'eval_summaries.jsonl':
+    return 'eval'
+  for prefix, run in (('summaries_', 'train_'),
+                      ('eval_summaries_', 'eval_')):
+    if base.startswith(prefix):
+      return run + base[len(prefix):].removesuffix('.jsonl')
+  return base.removesuffix('.jsonl')
+
+
+def _histogram_raw_args(event):
+  """JSONL histogram -> add_histogram_raw kwargs. Without edges the
+  counts are per-integer bins (e.g. action ids 0..n-1)."""
+  counts = event['counts']
+  edges = event.get('edges')
+  if edges is None:
+    edges = [i - 0.5 for i in range(len(counts) + 1)]
+  centers = [(edges[i] + edges[i + 1]) / 2 for i in range(len(counts))]
+  num = float(sum(counts))
+  total = sum(c * x for c, x in zip(counts, centers))
+  total_sq = sum(c * x * x for c, x in zip(counts, centers))
+  nonzero = [i for i, c in enumerate(counts) if c]
+  lo = edges[nonzero[0]] if nonzero else 0.0
+  hi = edges[nonzero[-1] + 1] if nonzero else 0.0
+  return dict(min=lo, max=hi, num=num, sum=total, sum_squares=total_sq,
+              bucket_limits=list(edges[1:]), bucket_counts=list(counts))
+
+
+def convert(logdir, out=None):
+  """Convert every summary stream under `logdir`; returns
+  {run_name: events_written}."""
+  try:
+    from torch.utils.tensorboard import SummaryWriter
+  except ImportError as e:
+    raise ImportError(
+        'scripts/to_tensorboard.py writes events via '
+        'torch.utils.tensorboard (`pip install torch tensorboard`); '
+        'the training path itself never requires either') from e
+
+  out = out or os.path.join(logdir, 'tb')
+  streams = sorted(glob.glob(os.path.join(logdir, '*summaries*.jsonl')))
+  if not streams:
+    raise FileNotFoundError(f'no *summaries*.jsonl under {logdir!r}')
+  written = {}
+  for path in streams:
+    run = _run_name(path)
+    run_dir = os.path.join(out, run)
+    # Re-converting must replace, not append: a second event file in
+    # the same run dir would make TensorBoard merge both conversions
+    # and show every point twice.
+    if os.path.isdir(run_dir):
+      shutil.rmtree(run_dir)
+    writer = SummaryWriter(run_dir)
+    n = 0
+    with open(path) as f:
+      for line in f:
+        line = line.strip()
+        if not line:
+          continue
+        event = json.loads(line)
+        step = int(event.get('step', 0))
+        wall = event.get('wall_time')
+        if event.get('kind') == 'histogram':
+          writer.add_histogram_raw(
+              event['tag'], global_step=step, walltime=wall,
+              **_histogram_raw_args(event))
+        else:
+          writer.add_scalar(event['tag'], float(event['value']),
+                            global_step=step, walltime=wall)
+        n += 1
+    writer.close()
+    written[run] = n
+  return written
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(
+      description='JSONL summaries -> TensorBoard event files')
+  parser.add_argument('logdir', help='run directory (has summaries.jsonl)')
+  parser.add_argument('--out', default=None,
+                      help='TB output dir (default: LOGDIR/tb)')
+  args = parser.parse_args(argv)
+  written = convert(args.logdir, args.out)
+  for run, n in sorted(written.items()):
+    print(f'{run}: {n} events')
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -1,0 +1,49 @@
+"""Doc-consistency guards.
+
+docs/MIGRATION.md promises reference operators that flags exist under
+the stated names; a renamed/removed flag must fail a test, not a user.
+"""
+
+import os
+import re
+
+import experiment  # noqa: F401  (defines the absl flags)
+from absl import flags
+
+DOCS = os.path.join(os.path.dirname(__file__), os.pardir, 'docs')
+
+
+def _expand(token):
+  """'inference_{min_batch,max_batch}' -> both names; skip wildcards."""
+  if '*' in token:
+    return []
+  m = re.fullmatch(r'([a-z_]*)\{([a-z_,]+)\}([a-z_]*)', token)
+  if m:
+    return [m.group(1) + part + m.group(3)
+            for part in m.group(2).split(',')]
+  return [token]
+
+
+def test_every_config_field_has_a_flag():
+  """The 'dataclass config + absl flags overlay' design (SURVEY §5.6)
+  only holds if the overlay is total: a Config field without a flag is
+  silently unsettable from the CLI (how --remote_publish_secs went
+  missing)."""
+  import dataclasses
+  from scalable_agent_tpu.config import Config
+  defined = set(flags.FLAGS)
+  missing = sorted(f.name for f in dataclasses.fields(Config)
+                   if f.name not in defined)
+  assert not missing, f'Config fields with no CLI flag: {missing}'
+
+
+def test_migration_md_flags_exist():
+  text = open(os.path.join(DOCS, 'MIGRATION.md')).read()
+  # `--flag` and `--flag={a,b}` mentions; value-assignment suffixes
+  # (`--flag=x`) document values, not names.
+  tokens = set(re.findall(r'--([a-z_{},*]+)', text))
+  names = {name for token in tokens for name in _expand(token)}
+  assert 'level_name' in names and 'learning_rate' in names  # parser sanity
+  defined = set(flags.FLAGS)
+  missing = sorted(n for n in names if n not in defined)
+  assert not missing, f'MIGRATION.md names undefined flags: {missing}'

@@ -1,0 +1,59 @@
+"""JSONL -> TensorBoard converter: values survive the round trip.
+
+Written through the real observability.SummaryWriter and read back
+with TensorBoard's own EventAccumulator, so the test pins the full
+operator-facing path, not the converter's internals.
+"""
+
+import numpy as np
+import pytest
+
+tb_accumulator = pytest.importorskip(
+    'tensorboard.backend.event_processing.event_accumulator')
+
+from scalable_agent_tpu import observability as obs
+from scripts import to_tensorboard
+
+
+def test_scalars_and_histograms_round_trip(tmp_path):
+  writer = obs.SummaryWriter(str(tmp_path))
+  writer.scalar('loss/total', 1.5, step=1)
+  writer.scalar('loss/total', 0.5, step=2)
+  writer.histogram('actions', np.array([4, 0, 2]), step=2)
+  writer.close()
+  ev = obs.SummaryWriter(str(tmp_path), filename='eval_summaries.jsonl')
+  ev.scalar('atari57/test_median', 42.0, step=2)
+  ev.close()
+
+  written = to_tensorboard.convert(str(tmp_path))
+  assert written == {'train': 3, 'eval': 1}
+  # Idempotent: re-converting replaces the event files (TensorBoard
+  # would otherwise merge both passes and plot every point twice).
+  to_tensorboard.convert(str(tmp_path))
+  import glob as globlib
+  assert len(globlib.glob(str(tmp_path / 'tb' / 'train' / '*'))) == 1
+
+  acc = tb_accumulator.EventAccumulator(str(tmp_path / 'tb' / 'train'))
+  acc.Reload()
+  scalars = acc.Scalars('loss/total')
+  assert [(s.step, s.value) for s in scalars] == [(1, 1.5), (2, 0.5)]
+  hists = acc.Histograms('actions')
+  assert hists[0].step == 2
+  assert sum(hists[0].histogram_value.bucket) == 6  # 4 + 0 + 2 actions
+
+  acc_eval = tb_accumulator.EventAccumulator(str(tmp_path / 'tb' / 'eval'))
+  acc_eval.Reload()
+  assert acc_eval.Scalars('atari57/test_median')[0].value == 42.0
+
+
+def test_run_names():
+  f = to_tensorboard._run_name
+  assert f('/x/summaries.jsonl') == 'train'
+  assert f('/x/summaries_p3.jsonl') == 'train_p3'
+  assert f('/x/eval_summaries.jsonl') == 'eval'
+  assert f('/x/eval_summaries_p1.jsonl') == 'eval_p1'
+
+
+def test_missing_dir_raises(tmp_path):
+  with pytest.raises(FileNotFoundError):
+    to_tensorboard.convert(str(tmp_path / 'nope'))
