@@ -1,0 +1,326 @@
+"""Anakin mode: acting + learning fused into ONE jitted device step.
+
+The production path (driver.py) is Sebulba-shaped (Podracer
+architectures, arXiv:2104.06272): C++/CPU simulators on the host feed
+a TPU learner through the batcher/buffer pipeline, because DMLab/ALE
+can only ever be host processes (reference: environments.py ≈L60
+PyProcessDmLab). But the framework's CI tasks (envs/fake.py bandit /
+cue-memory) are pure state machines — for these, the TPU-idiomatic
+architecture is Podracer's *Anakin*: put the environment INSIDE the
+jitted step, `lax.scan` the act→env→act rollout on device, and feed
+the trajectory straight into the same learner update, with zero host
+transport, zero inference servers, zero Python in the loop.
+
+What this buys:
+- research-mode throughput on the CI tasks (no host round trips; the
+  whole unroll+update is one XLA program), and
+- a one-file demonstration that acting and learning are the SAME
+  functional pieces everywhere: this module reuses `ImpalaAgent`
+  unchanged (T=1 apply for acting, [T+1, B] apply inside the update)
+  and `learner.make_train_step_fn` unchanged — there is exactly one
+  IMPALA loss/update in the codebase.
+
+Semantics mirror the host actor loop (runtime/actor.py) exactly:
+T+1 overlap frame (timestep 0 of an unroll = last timestep of the
+previous one), `agent_state` = LSTM carry at unroll start, flow-style
+episode stats (the emitted StepOutputInfo carries final stats at done;
+the carried state resets), initial env_output has done=True with a
+zero/priming agent_output. Because acting uses the pre-update params
+of the same step, behaviour == target at loss time and V-trace's rhos
+are 1 — the on-policy special case (the correction machinery still
+runs; tests pin this).
+
+Scale-out note: Anakin scales by pmap/sharding the batch over chips —
+each device runs envs+learner locally and only gradients cross ICI.
+Single-device jit here (the CI tasks saturate one chip); the DP mesh
+path stays with the production pipeline.
+"""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scalable_agent_tpu import learner
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+from scalable_agent_tpu.structs import (ActorOutput, AgentOutput,
+                                        StepOutput, StepOutputInfo)
+
+
+class EnvCoreState(NamedTuple):
+  """Batched functional env state (all [B] unless noted)."""
+  rng: Any            # PRNG key []
+  context: Any        # i32 [B] — bandit target / memory cue
+  step_in_episode: Any  # i32 [B]
+  episode_return: Any   # f32 [B] — flow-style carried stats
+  episode_frames: Any   # i32 [B]
+
+
+def _frame_from_channel(channel, batch, height, width, visible=None):
+  """uint8 [B, H, W, 3] with `channel`'s plane at 255 (optionally
+  masked per-env by `visible`)."""
+  plane = jax.nn.one_hot(channel, 3, dtype=jnp.float32) * 255.0
+  if visible is not None:
+    plane = plane * visible[:, None].astype(jnp.float32)
+  plane = plane.astype(jnp.uint8)  # [B, 3]
+  return jnp.broadcast_to(plane[:, None, None, :],
+                          (batch, height, width, 3))
+
+
+def _zero_instr(batch):
+  return jnp.zeros((batch, MAX_INSTRUCTION_LEN), jnp.int32)
+
+
+class BanditCore:
+  """Jittable ContextualBanditEnv (envs/fake.py): the frame's dominant
+  color channel is the rewarded action; `episode_length` steps per
+  context. Same rewards, episode shape, and stats semantics as the
+  host version — property-tested side by side."""
+
+  num_actions = 3
+
+  def __init__(self, height=24, width=32, episode_length=5,
+               num_action_repeats=1):
+    self.height, self.width = height, width
+    self.episode_length = episode_length
+    self.num_action_repeats = num_action_repeats
+
+  def _observation(self, state, visible=None):
+    frame = _frame_from_channel(state.context, state.context.shape[0],
+                                self.height, self.width, visible)
+    return (frame, _zero_instr(state.context.shape[0]))
+
+  def init(self, rng, batch) -> Tuple[EnvCoreState, StepOutput]:
+    rng, sub = jax.random.split(rng)
+    state = EnvCoreState(
+        rng=rng,
+        context=jax.random.randint(sub, (batch,), 0, self.num_actions),
+        step_in_episode=jnp.zeros((batch,), jnp.int32),
+        episode_return=jnp.zeros((batch,), jnp.float32),
+        episode_frames=jnp.zeros((batch,), jnp.int32))
+    # Mirrors runtime/actor.py's priming output: done=True (first obs
+    # starts an episode), zero reward/stats.
+    output = StepOutput(
+        reward=jnp.zeros((batch,), jnp.float32),
+        info=StepOutputInfo(jnp.zeros((batch,), jnp.float32),
+                            jnp.zeros((batch,), jnp.int32)),
+        done=jnp.ones((batch,), bool),
+        observation=self._observation(state))
+    return state, output
+
+  def step(self, state: EnvCoreState, action
+           ) -> Tuple[EnvCoreState, StepOutput]:
+    reward = (action == state.context).astype(jnp.float32)
+    step_count = state.step_in_episode + 1
+    done = step_count >= self.episode_length
+
+    ep_return = state.episode_return + reward
+    ep_frames = state.episode_frames + self.num_action_repeats
+    info = StepOutputInfo(ep_return, ep_frames)  # emitted: incl. done
+    zero_f = jnp.zeros_like(ep_return)
+    zero_i = jnp.zeros_like(ep_frames)
+
+    rng, sub = jax.random.split(state.rng)
+    fresh = jax.random.randint(sub, action.shape, 0, self.num_actions)
+    new_state = EnvCoreState(
+        rng=rng,
+        context=jnp.where(done, fresh, state.context),
+        step_in_episode=jnp.where(done, 0, step_count),
+        episode_return=jnp.where(done, zero_f, ep_return),
+        episode_frames=jnp.where(done, zero_i, ep_frames))
+    output = StepOutput(reward=reward, info=info, done=done,
+                        observation=self._observation(new_state))
+    return new_state, output
+
+
+class CueMemoryCore:
+  """Jittable CueMemoryEnv (envs/fake.py): two-step episodes, cue
+  visible only on the first frame, fixed-action-0 bonus on the first
+  step (relay-proof), match-the-cue reward on the second."""
+
+  num_actions = 3
+
+  def __init__(self, height=16, width=16, episode_length=2,
+               num_action_repeats=1):
+    del episode_length  # fixed two-step episodes, like the host env
+    self.height, self.width = height, width
+    self.num_action_repeats = num_action_repeats
+
+  def _observation(self, state):
+    visible = state.step_in_episode == 0  # cue only pre-first-action
+    frame = _frame_from_channel(state.context, state.context.shape[0],
+                                self.height, self.width, visible)
+    return (frame, _zero_instr(state.context.shape[0]))
+
+  def init(self, rng, batch) -> Tuple[EnvCoreState, StepOutput]:
+    rng, sub = jax.random.split(rng)
+    state = EnvCoreState(
+        rng=rng,
+        context=jax.random.randint(sub, (batch,), 0, 3),
+        step_in_episode=jnp.zeros((batch,), jnp.int32),
+        episode_return=jnp.zeros((batch,), jnp.float32),
+        episode_frames=jnp.zeros((batch,), jnp.int32))
+    output = StepOutput(
+        reward=jnp.zeros((batch,), jnp.float32),
+        info=StepOutputInfo(jnp.zeros((batch,), jnp.float32),
+                            jnp.zeros((batch,), jnp.int32)),
+        done=jnp.ones((batch,), bool),
+        observation=self._observation(state))
+    return state, output
+
+  def step(self, state: EnvCoreState, action
+           ) -> Tuple[EnvCoreState, StepOutput]:
+    first = state.step_in_episode == 0
+    reward = jnp.where(
+        first,
+        jnp.where(action == 0, 2.0, 0.0),              # info-free bonus
+        (action == state.context).astype(jnp.float32))  # recall
+    done = ~first
+
+    ep_return = state.episode_return + reward
+    ep_frames = state.episode_frames + self.num_action_repeats
+    info = StepOutputInfo(ep_return, ep_frames)
+
+    rng, sub = jax.random.split(state.rng)
+    fresh = jax.random.randint(sub, action.shape, 0, 3)
+    new_state = EnvCoreState(
+        rng=rng,
+        context=jnp.where(done, fresh, state.context),
+        step_in_episode=jnp.where(done, 0, 1),
+        episode_return=jnp.where(done, jnp.zeros_like(ep_return),
+                                 ep_return),
+        episode_frames=jnp.where(done, jnp.zeros_like(ep_frames),
+                                 ep_frames))
+    output = StepOutput(reward=reward, info=info, done=done,
+                        observation=self._observation(new_state))
+    return new_state, output
+
+
+ENV_CORES = {'bandit': BanditCore, 'cue_memory': CueMemoryCore}
+
+
+class AnakinCarry(NamedTuple):
+  """Everything that persists across fused steps (all device-side)."""
+  train_state: Any   # learner.TrainState
+  env_state: Any     # EnvCoreState
+  env_output: Any    # StepOutput [B] — the pending overlap timestep
+  agent_output: Any  # AgentOutput [B] — ditto
+  core_state: Any    # LSTM carry (c, h) [B, hidden]
+  rng: Any
+
+
+def init_carry(agent, env_core, config: Config, rng) -> AnakinCarry:
+  """Initial params/opt/env/agent state for `make_anakin_step`."""
+  from scalable_agent_tpu.models import init_params
+  b = config.batch_size
+  rng, params_rng, env_rng = jax.random.split(rng, 3)
+  obs_spec = {'frame': (env_core.height, env_core.width, 3),
+              'instr_len': MAX_INSTRUCTION_LEN}
+  params = init_params(agent, params_rng, obs_spec)
+  train_state = learner.make_train_state(params, config)
+  env_state, env_output = env_core.init(env_rng, b)
+  agent_output = AgentOutput(  # actor.py's priming output
+      action=jnp.zeros((b,), jnp.int32),
+      policy_logits=jnp.zeros((b, env_core.num_actions), jnp.float32),
+      baseline=jnp.zeros((b,), jnp.float32))
+  return AnakinCarry(train_state, env_state, env_output, agent_output,
+                     agent.initial_state(b), rng)
+
+
+def make_anakin_step(agent, env_core, config: Config,
+                     return_batch: bool = False):
+  """One fused device step: scan T acting steps, then the SGD update.
+
+  Returns jitted `f(carry) -> (carry, metrics)` (donating the carry);
+  with `return_batch` the assembled [T+1, B] ActorOutput is added to
+  the metrics dict under 'batch' (alignment tests)."""
+  train_step_fn = learner.make_train_step_fn(agent, config)
+  t = config.unroll_length
+
+  def anakin_step(carry: AnakinCarry):
+    initial_core_state = carry.core_state
+    params = carry.train_state.params  # pre-update: behaviour == target
+
+    def acting_step(acting_carry, _):
+      env_state, env_output, agent_output, core_state, rng = (
+          acting_carry)
+      rng, sample_rng = jax.random.split(rng)
+      # T=1 apply of the SAME agent the learner unrolls — one model.
+      out_t, new_core = agent.apply(
+          params, agent_output.action[None],
+          jax.tree_util.tree_map(lambda x: x[None], env_output),
+          core_state, sample_rng=sample_rng)
+      new_agent_output = jax.tree_util.tree_map(lambda x: x[0], out_t)
+      new_env_state, new_env_output = env_core.step(
+          env_state, new_agent_output.action)
+      return ((new_env_state, new_env_output, new_agent_output,
+               new_core, rng),
+              (new_env_output, new_agent_output))
+
+    (env_state, env_output, agent_output, core_state, rng), tail = (
+        jax.lax.scan(
+            acting_step,
+            (carry.env_state, carry.env_output, carry.agent_output,
+             carry.core_state, carry.rng),
+            None, length=t))
+    # T+1 assembly with the overlap frame (actor.py unroll()).
+    batch = ActorOutput(
+        level_name=jnp.zeros((config.batch_size,), jnp.int32),
+        agent_state=initial_core_state,
+        env_outputs=jax.tree_util.tree_map(
+            lambda first, rest: jnp.concatenate([first[None], rest]),
+            carry.env_output, tail[0]),
+        agent_outputs=jax.tree_util.tree_map(
+            lambda first, rest: jnp.concatenate([first[None], rest]),
+            carry.agent_output, tail[1]))
+    new_train_state, metrics = train_step_fn(carry.train_state, batch)
+    metrics['mean_reward'] = jnp.mean(batch.env_outputs.reward[1:])
+    if return_batch:
+      metrics['batch'] = batch
+    return (AnakinCarry(new_train_state, env_state, env_output,
+                        agent_output, core_state, rng),
+            metrics)
+
+  return jax.jit(anakin_step, donate_argnums=(0,))
+
+
+def run(config: Config, num_steps: int, rng_seed: int = 0,
+        env_backend: Optional[str] = None):
+  """Convenience runner: build agent + env core, run `num_steps` fused
+  steps, return (carry, list-of-metrics, env_frames_per_sec)."""
+  import time
+  from scalable_agent_tpu import driver
+  if num_steps < 1:
+    raise ValueError(f'num_steps must be >= 1, got {num_steps}')
+  backend = env_backend or config.env_backend
+  if backend not in ENV_CORES:
+    raise ValueError(
+        f'anakin needs a jittable env core, got {backend!r} '
+        f'(available: {sorted(ENV_CORES)}); real simulators use the '
+        'host pipeline (driver.train)')
+  core_cls = ENV_CORES[backend]
+  env_core = core_cls(height=config.height, width=config.width,
+                      episode_length=config.episode_length,
+                      num_action_repeats=config.num_action_repeats)
+  agent = driver.build_agent(config, env_core.num_actions)
+  step = make_anakin_step(agent, env_core, config)
+  carry = init_carry(agent, env_core, config,
+                     jax.random.PRNGKey(rng_seed))
+
+  carry, metrics = step(carry)  # compile + step 1
+  history = [metrics]
+  float(jax.device_get(metrics['total_loss']))  # compile barrier
+  t0 = time.perf_counter()
+  for _ in range(num_steps - 1):
+    carry, metrics = step(carry)
+    history.append(metrics)  # async — no per-step readback
+  # ONE value readback as the timing barrier (tunnel-safe: see
+  # docs/PERF.md — block_until_ready can return early here).
+  float(jax.device_get(history[-1]['total_loss']))
+  dt = time.perf_counter() - t0
+  # First (compile) step excluded from timing; num_steps=1 has no
+  # timed window at all.
+  frames = (num_steps - 1) * config.frames_per_step
+  fps = frames / dt if num_steps > 1 and dt > 0 else float('nan')
+  return carry, [jax.device_get(m) for m in history], fps

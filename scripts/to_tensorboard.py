@@ -87,12 +87,19 @@ def convert(logdir, out=None):
       shutil.rmtree(run_dir)
     writer = SummaryWriter(run_dir)
     n = 0
+    skipped = 0
     with open(path) as f:
       for line in f:
         line = line.strip()
         if not line:
           continue
-        event = json.loads(line)
+        try:
+          event = json.loads(line)
+        except json.JSONDecodeError:
+          # A crashed trainer can leave a truncated final line; the
+          # thousands of valid events before it must still convert.
+          skipped += 1
+          continue
         step = int(event.get('step', 0))
         wall = event.get('wall_time')
         if event.get('kind') == 'histogram':
@@ -104,6 +111,9 @@ def convert(logdir, out=None):
                             global_step=step, walltime=wall)
         n += 1
     writer.close()
+    if skipped:
+      print(f'warning: {run}: skipped {skipped} undecodable line(s) '
+            f'in {path}', file=sys.stderr)
     written[run] = n
   return written
 

@@ -57,3 +57,15 @@ def test_run_names():
 def test_missing_dir_raises(tmp_path):
   with pytest.raises(FileNotFoundError):
     to_tensorboard.convert(str(tmp_path / 'nope'))
+
+
+def test_truncated_final_line_is_skipped(tmp_path):
+  """A crashed trainer can leave a partial last line; the valid events
+  before it must still convert."""
+  writer = obs.SummaryWriter(str(tmp_path))
+  writer.scalar('loss/total', 1.0, step=1)
+  writer.close()
+  with open(writer.path, 'a') as f:
+    f.write('{"tag": "loss/total", "va')  # truncated mid-write
+  written = to_tensorboard.convert(str(tmp_path))
+  assert written == {'train': 1}
