@@ -550,6 +550,40 @@ def bench_param_fanout(smoke):
   return results
 
 
+def bench_anakin(smoke):
+  """Anakin research mode (parallel/anakin.py): the whole act+learn
+  loop fused on-device for the jittable bandit env. Reported alongside
+  the learner headline — it is a different (host-free, small-model)
+  operating point, not a replacement: the flagship model is
+  acting-latency-bound in this mode (docs/PARALLELISM.md)."""
+  import numpy as np
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.parallel import anakin
+
+  cfg = Config(
+      env_backend='bandit',
+      batch_size=256 if not smoke else 4,
+      unroll_length=20 if not smoke else 3,
+      num_action_repeats=1, episode_length=5,
+      height=24, width=32, torso='shallow',
+      compute_dtype='bfloat16' if not smoke else 'float32',
+      use_instruction=False, use_py_process=False,
+      learning_rate=2e-3, entropy_cost=3e-3, discounting=0.0,
+      total_environment_frames=10**9, seed=0)
+  steps = 200 if not smoke else 3
+  _, history, fps = anakin.run(cfg, steps)
+  rewards = [float(h['mean_reward']) for h in history]
+  tail = max(len(rewards) // 10, 1)
+  return {
+      'env_frames_per_sec': round(fps, 1),
+      'config': ('shallow, %dx%d, B=%d, T=%d, bandit' %
+                 (cfg.height, cfg.width, cfg.batch_size,
+                  cfg.unroll_length)),
+      'mean_reward_first': round(float(np.mean(rewards[:tail])), 3),
+      'mean_reward_last': round(float(np.mean(rewards[-tail:])), 3),
+  }
+
+
 def main():
   # BENCH_SMOKE=1: tiny shapes on CPU — validates bench mechanics in CI
   # without the chip. The driver runs the real thing (no env var, TPU).
@@ -568,6 +602,9 @@ def main():
   fanout = None
   if os.environ.get('BENCH_SKIP_FANOUT') != '1':
     fanout = bench_param_fanout(smoke)
+  anakin = None
+  if os.environ.get('BENCH_SKIP_ANAKIN') != '1':
+    anakin = bench_anakin(smoke)
 
   baseline_per_chip = 200_000.0 / 16.0  # north star / v5e-16 chips
   out = {
@@ -587,6 +624,8 @@ def main():
     out['transport'] = transport
   if fanout is not None:
     out['param_fanout'] = fanout
+  if anakin is not None:
+    out['anakin'] = anakin
   print(json.dumps(out))
 
 

@@ -303,6 +303,14 @@ def run(config: Config, num_steps: int, rng_seed: int = 0,
   env_core = core_cls(height=config.height, width=config.width,
                       episode_length=config.episode_length,
                       num_action_repeats=config.num_action_repeats)
+  if (config.num_actions is not None
+      and config.num_actions != env_core.num_actions):
+    # Fail fast: silently building a differently-shaped policy head
+    # than driver.train would for the same Config would make params/
+    # checkpoints incompatible between the two paths.
+    raise ValueError(
+        f'config.num_actions={config.num_actions} but the {backend!r} '
+        f'anakin core is a fixed {env_core.num_actions}-action task')
   agent = driver.build_agent(config, env_core.num_actions)
   step = make_anakin_step(agent, env_core, config)
   carry = init_carry(agent, env_core, config,

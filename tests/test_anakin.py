@@ -123,3 +123,5 @@ def test_run_rejects_host_only_backends_and_zero_steps():
     anakin.run(_anakin_config(env_backend='dmlab'), 1)
   with pytest.raises(ValueError, match='num_steps'):
     anakin.run(_anakin_config(), 0)
+  with pytest.raises(ValueError, match='num_actions'):
+    anakin.run(_anakin_config(num_actions=5), 1)

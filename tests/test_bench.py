@@ -18,3 +18,9 @@ def test_transport_bench_smoke():
   ingest = results['ingest_1conn']
   assert ingest['unrolls_per_sec'] > 0
   assert ingest['mb_per_sec'] > 0
+
+
+def test_anakin_bench_smoke():
+  results = bench.bench_anakin(smoke=True)
+  assert results['env_frames_per_sec'] > 0
+  assert 0 <= results['mean_reward_last'] <= 1.0
