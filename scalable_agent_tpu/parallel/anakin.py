@@ -30,10 +30,11 @@ of the same step, behaviour == target at loss time and V-trace's rhos
 are 1 — the on-policy special case (the correction machinery still
 runs; tests pin this).
 
-Scale-out note: Anakin scales by pmap/sharding the batch over chips —
-each device runs envs+learner locally and only gradients cross ICI.
-Single-device jit here (the CI tasks saturate one chip); the DP mesh
-path stays with the production pipeline.
+Scale-out: `init_carry(..., mesh=...)` / `run(..., mesh=...)` shard
+every batch-leading leaf over the mesh's data axis — each device steps
+its slice of the environments and the learner locally, params
+replicate, and jit inserts the gradient psum over ICI (same placement
+discipline as train_parallel.py; `test_anakin_shards_over_the_mesh`).
 """
 
 from typing import Any, NamedTuple, Optional, Tuple
@@ -210,22 +211,64 @@ class AnakinCarry(NamedTuple):
   rng: Any
 
 
-def init_carry(agent, env_core, config: Config, rng) -> AnakinCarry:
-  """Initial params/opt/env/agent state for `make_anakin_step`."""
+def init_carry(agent, env_core, config: Config, rng,
+               mesh=None) -> AnakinCarry:
+  """Initial params/opt/env/agent state for `make_anakin_step`.
+
+  With `mesh`, this IS Anakin's scale-out story: every [B]-leading
+  leaf (env state, pending outputs, LSTM carry) shards over the data
+  axis — each device runs its slice of the environments AND the
+  learner locally; params/opt replicate and only the gradient psum
+  crosses ICI (inserted by jit from these placements, exactly like
+  parallel/train_parallel.py)."""
   from scalable_agent_tpu.models import init_params
   b = config.batch_size
+  if mesh is not None:
+    from scalable_agent_tpu.parallel import mesh as mesh_lib
+    if b % mesh.shape[mesh_lib.DATA_AXIS] != 0:
+      # Before any init work — a full param init would be wasted.
+      raise ValueError(
+          f'batch_size={b} not divisible by the data axis '
+          f'({mesh.shape[mesh_lib.DATA_AXIS]} devices)')
   rng, params_rng, env_rng = jax.random.split(rng, 3)
   obs_spec = {'frame': (env_core.height, env_core.width, 3),
               'instr_len': MAX_INSTRUCTION_LEN}
   params = init_params(agent, params_rng, obs_spec)
-  train_state = learner.make_train_state(params, config)
   env_state, env_output = env_core.init(env_rng, b)
   agent_output = AgentOutput(  # actor.py's priming output
       action=jnp.zeros((b,), jnp.int32),
       policy_logits=jnp.zeros((b, env_core.num_actions), jnp.float32),
       baseline=jnp.zeros((b,), jnp.float32))
+  core_state = agent.initial_state(b)
+
+  if mesh is None:
+    train_state = learner.make_train_state(params, config)
+    return AnakinCarry(train_state, env_state, env_output,
+                       agent_output, core_state, rng)
+
+  from jax.sharding import NamedSharding, PartitionSpec as P
+  from scalable_agent_tpu.parallel import mesh as mesh_lib
+  from scalable_agent_tpu.parallel import train_parallel
+  train_state = train_parallel.make_sharded_train_state(
+      params, config, mesh)
+  data = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+  replicated = NamedSharding(mesh, P())
+
+  def place(x):
+    x = jnp.asarray(x)
+    batch_leading = x.ndim >= 1 and x.shape[0] == b
+    return jax.device_put(x, data if batch_leading else replicated)
+
+  # The env core's PRNG key is [2]u32 — shape-sniffing would misplace
+  # it at b=2, so it is pinned replicated by name.
+  env_state = EnvCoreState(
+      rng=jax.device_put(env_state.rng, replicated),
+      **{f: place(getattr(env_state, f))
+         for f in EnvCoreState._fields if f != 'rng'})
+  env_output, agent_output, core_state = jax.tree_util.tree_map(
+      place, (env_output, agent_output, core_state))
   return AnakinCarry(train_state, env_state, env_output, agent_output,
-                     agent.initial_state(b), rng)
+                     core_state, jax.device_put(rng, replicated))
 
 
 def make_anakin_step(agent, env_core, config: Config,
@@ -286,9 +329,10 @@ def make_anakin_step(agent, env_core, config: Config,
 
 
 def run(config: Config, num_steps: int, rng_seed: int = 0,
-        env_backend: Optional[str] = None):
+        env_backend: Optional[str] = None, mesh=None):
   """Convenience runner: build agent + env core, run `num_steps` fused
-  steps, return (carry, list-of-metrics, env_frames_per_sec)."""
+  steps, return (carry, list-of-metrics, env_frames_per_sec). Pass
+  `mesh` to shard the env batch over the data axis (multi-chip)."""
   import time
   from scalable_agent_tpu import driver
   if num_steps < 1:
@@ -314,15 +358,26 @@ def run(config: Config, num_steps: int, rng_seed: int = 0,
   agent = driver.build_agent(config, env_core.num_actions)
   step = make_anakin_step(agent, env_core, config)
   carry = init_carry(agent, env_core, config,
-                     jax.random.PRNGKey(rng_seed))
+                     jax.random.PRNGKey(rng_seed), mesh=mesh)
 
   carry, metrics = step(carry)  # compile + step 1
   history = [metrics]
   float(jax.device_get(metrics['total_loss']))  # compile barrier
+  # CPU-emulated meshes (xla_force_host_platform_device_count) run one
+  # thread per virtual device; on an oversubscribed host a long async
+  # chain can starve one device >40 s behind its peers at a collective,
+  # tripping XLA's rendezvous watchdog (observed at ~60 queued sharded
+  # steps on the 1-core CI host). Periodic syncs bound the queue there;
+  # real chips keep pace and skip this (it would cost a tunnel readback
+  # per window).
+  sync_every = 8 if (mesh is not None
+                     and jax.default_backend() == 'cpu') else None
   t0 = time.perf_counter()
-  for _ in range(num_steps - 1):
+  for i in range(num_steps - 1):
     carry, metrics = step(carry)
     history.append(metrics)  # async — no per-step readback
+    if sync_every is not None and i % sync_every == sync_every - 1:
+      jax.block_until_ready(metrics['total_loss'])
   # ONE value readback as the timing barrier (tunnel-safe: see
   # docs/PERF.md — block_until_ready can return early here).
   float(jax.device_get(history[-1]['total_loss']))
