@@ -247,7 +247,6 @@ def init_carry(agent, env_core, config: Config, rng,
                        agent_output, core_state, rng)
 
   from jax.sharding import NamedSharding, PartitionSpec as P
-  from scalable_agent_tpu.parallel import mesh as mesh_lib
   from scalable_agent_tpu.parallel import train_parallel
   train_state = train_parallel.make_sharded_train_state(
       params, config, mesh)
