@@ -25,7 +25,9 @@ from scalable_agent_tpu.config import Config
 _DEFAULTS = Config()
 
 flags.DEFINE_string('logdir', _DEFAULTS.logdir, 'Experiment directory.')
-flags.DEFINE_enum('mode', 'train', ['train', 'test'], 'Run mode.')
+flags.DEFINE_enum('mode', 'train', ['train', 'test', 'anakin'],
+                  'Run mode. anakin = fused on-device acting+learning '
+                  '(jittable CI envs only — parallel/anakin.py).')
 flags.DEFINE_integer('test_num_episodes', _DEFAULTS.test_num_episodes,
                      'Episodes per level in test mode.')
 flags.DEFINE_integer('task', _DEFAULTS.task,
@@ -253,6 +255,12 @@ def main(argv):
   if cfg.mode == 'train':
     run = driver.train(cfg)
     logging.info('training done at %d frames', run.frames)
+  elif cfg.mode == 'anakin':
+    from scalable_agent_tpu.parallel import anakin
+    carry = anakin.train(cfg)
+    logging.info('anakin training done at %d frames',
+                 int(carry.train_state.update_steps) *
+                 cfg.frames_per_step)
   else:
     driver.evaluate(cfg)
 

@@ -327,22 +327,16 @@ def make_anakin_step(agent, env_core, config: Config,
   return jax.jit(anakin_step, donate_argnums=(0,))
 
 
-def run(config: Config, num_steps: int, rng_seed: int = 0,
-        env_backend: Optional[str] = None, mesh=None):
-  """Convenience runner: build agent + env core, run `num_steps` fused
-  steps, return (carry, list-of-metrics, env_frames_per_sec). Pass
-  `mesh` to shard the env batch over the data axis (multi-chip)."""
-  import time
+def _build(config: Config, mesh=None, rng_seed: Optional[int] = None):
+  """Shared construction for run()/train(): validated env core, agent,
+  jitted fused step, initial carry."""
   from scalable_agent_tpu import driver
-  if num_steps < 1:
-    raise ValueError(f'num_steps must be >= 1, got {num_steps}')
-  backend = env_backend or config.env_backend
-  if backend not in ENV_CORES:
+  if config.env_backend not in ENV_CORES:
     raise ValueError(
-        f'anakin needs a jittable env core, got {backend!r} '
-        f'(available: {sorted(ENV_CORES)}); real simulators use the '
-        'host pipeline (driver.train)')
-  core_cls = ENV_CORES[backend]
+        f'anakin needs a jittable env core, got '
+        f'{config.env_backend!r} (available: {sorted(ENV_CORES)}); '
+        'real simulators use the host pipeline (driver.train)')
+  core_cls = ENV_CORES[config.env_backend]
   env_core = core_cls(height=config.height, width=config.width,
                       episode_length=config.episode_length,
                       num_action_repeats=config.num_action_repeats)
@@ -352,25 +346,133 @@ def run(config: Config, num_steps: int, rng_seed: int = 0,
     # than driver.train would for the same Config would make params/
     # checkpoints incompatible between the two paths.
     raise ValueError(
-        f'config.num_actions={config.num_actions} but the {backend!r} '
-        f'anakin core is a fixed {env_core.num_actions}-action task')
+        f'config.num_actions={config.num_actions} but the '
+        f'{config.env_backend!r} anakin core is a fixed '
+        f'{env_core.num_actions}-action task')
   agent = driver.build_agent(config, env_core.num_actions)
   step = make_anakin_step(agent, env_core, config)
-  carry = init_carry(agent, env_core, config,
-                     jax.random.PRNGKey(rng_seed), mesh=mesh)
+  seed = config.seed if rng_seed is None else rng_seed
+  carry = init_carry(agent, env_core, config, jax.random.PRNGKey(seed),
+                     mesh=mesh)
+  return env_core, agent, step, carry
+
+
+def _cpu_mesh_sync_every(mesh) -> Optional[int]:
+  """CPU-emulated meshes (xla_force_host_platform_device_count) run one
+  thread per virtual device; on an oversubscribed host a long async
+  chain can starve one device >40 s behind its peers at a collective,
+  tripping XLA's rendezvous watchdog (observed at ~60 queued sharded
+  steps on the 1-core CI host). Periodic syncs bound the queue there;
+  real chips keep pace and skip them (a sync costs a tunnel readback)."""
+  return 8 if (mesh is not None
+               and jax.default_backend() == 'cpu') else None
+
+
+def train(config: Config, max_steps: Optional[int] = None, mesh=None):
+  """Operator-facing Anakin training (`experiment.py --mode=anakin`):
+  chunked fused steps with the framework's standard run artifacts —
+  JSONL summaries (total_loss, mean_reward, env_frames_per_sec,
+  learning_rate), checkpoint/resume in the same TrainState layout as
+  driver.train, config.json dump, total_environment_frames
+  termination. Returns the final AnakinCarry.
+
+  The carry's env/agent state is NOT checkpointed — matching the
+  production path, where actor-local state is intentionally excluded
+  (reference: local variables are not saved; SURVEY §5.4)."""
+  import dataclasses
+  import json as json_lib
+  import os
+  import time
+  from scalable_agent_tpu import checkpoint as checkpoint_lib
+  from scalable_agent_tpu import observability
+
+  _, _, step, carry = _build(config, mesh=mesh)
+  os.makedirs(config.logdir, exist_ok=True)
+  with open(os.path.join(config.logdir, 'config.json'), 'w') as f:
+    json_lib.dump(dataclasses.asdict(config), f, indent=2,
+                  sort_keys=True)
+  checkpointer = checkpoint_lib.Checkpointer(
+      os.path.join(config.logdir, 'checkpoints'),
+      save_interval_secs=config.checkpoint_secs)
+  writer = observability.SummaryWriter(config.logdir)
+  fps_meter = observability.FpsMeter()
+  sync_every = _cpu_mesh_sync_every(mesh)
+
+  steps_done = 0
+  metrics = None
+
+  def flush(step_num):
+    m = jax.device_get(metrics)  # readback = pipeline barrier
+    writer.scalars(
+        {'total_loss': float(m['total_loss']),
+         'mean_reward': float(m['mean_reward']),
+         'learning_rate': float(m['learning_rate']),
+         'env_frames_per_sec': fps_meter.fps()}, step=step_num)
+
+  restore_ok = False
+  try:
+    # A structure-mismatch raise must not leak the manager/writer
+    # (same discipline as driver.train's restore path).
+    restored = checkpointer.restore_latest(carry.train_state)
+    restore_ok = True
+    if restored is not None:
+      carry = carry._replace(train_state=restored)
+    # Step count tracked host-side: reading the device counter in the
+    # loop condition would be a per-step sync (~85 ms over the
+    # tunnel), serializing the async dispatch chain.
+    base_steps = int(carry.train_state.update_steps)
+    last_summary = time.monotonic()
+    while True:
+      steps = base_steps + steps_done
+      frames = steps * config.frames_per_step
+      if frames >= config.total_environment_frames:
+        break
+      if max_steps is not None and steps_done >= max_steps:
+        break
+      carry, metrics = step(carry)
+      steps_done += 1
+      fps_meter.update(config.frames_per_step)
+      if sync_every is not None and steps_done % sync_every == 0:
+        jax.block_until_ready(metrics['total_loss'])
+      now = time.monotonic()
+      if now - last_summary >= config.summary_secs:
+        flush(base_steps + steps_done)
+        last_summary = now
+      checkpointer.maybe_save(carry.train_state)
+    if steps_done:
+      # Final flush: a short run can finish inside one summary window
+      # and would otherwise end with only the post-compile sample.
+      flush(base_steps + steps_done)
+  finally:
+    try:
+      if restore_ok:
+        # Tail-save (preemption/interrupt safety); skipped when the
+        # restore itself failed — a fresh state must not be written
+        # into a logdir holding an incompatible checkpoint.
+        checkpointer.save(carry.train_state)
+    finally:
+      checkpointer.close()
+      writer.close()
+  return carry
+
+
+def run(config: Config, num_steps: int, rng_seed: int = 0,
+        env_backend: Optional[str] = None, mesh=None):
+  """Convenience runner: build agent + env core, run `num_steps` fused
+  steps, return (carry, list-of-metrics, env_frames_per_sec). Pass
+  `mesh` to shard the env batch over the data axis (multi-chip)."""
+  import dataclasses
+  import time
+  if num_steps < 1:
+    raise ValueError(f'num_steps must be >= 1, got {num_steps}')
+  if env_backend is not None and env_backend != config.env_backend:
+    config = dataclasses.replace(config, env_backend=env_backend)
+  _, _, step, carry = _build(config, mesh=mesh, rng_seed=rng_seed)
 
   carry, metrics = step(carry)  # compile + step 1
   history = [metrics]
   float(jax.device_get(metrics['total_loss']))  # compile barrier
-  # CPU-emulated meshes (xla_force_host_platform_device_count) run one
-  # thread per virtual device; on an oversubscribed host a long async
-  # chain can starve one device >40 s behind its peers at a collective,
-  # tripping XLA's rendezvous watchdog (observed at ~60 queued sharded
-  # steps on the 1-core CI host). Periodic syncs bound the queue there;
-  # real chips keep pace and skip this (it would cost a tunnel readback
-  # per window).
-  sync_every = 8 if (mesh is not None
-                     and jax.default_backend() == 'cpu') else None
+  sync_every = _cpu_mesh_sync_every(mesh)
   t0 = time.perf_counter()
   for i in range(num_steps - 1):
     carry, metrics = step(carry)
