@@ -63,6 +63,14 @@ class ShallowTorso(nn.Module):
 
   @nn.compact
   def __call__(self, frame):
+    h, w = frame.shape[1], frame.shape[2]
+    if h < 20 or w < 20:
+      # VALID 8x8/4 then 4x4/2 needs >= 20 px per dim; smaller frames
+      # reach a zero-size activation and die in flax initializers with
+      # an inscrutable ZeroDivisionError.
+      raise ValueError(
+          f'shallow torso needs frames >= 20x20, got {h}x{w} '
+          '(--height/--width)')
     x = frame.astype(self.dtype) / 255.0
     x = nn.relu(nn.Conv(16, (8, 8), strides=(4, 4), padding='VALID',
                         dtype=self.dtype)(x))

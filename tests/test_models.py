@@ -178,3 +178,12 @@ class TestDtype:
     assert out.baseline.dtype == jnp.float32
     assert state[0].dtype == jnp.float32
     assert np.all(np.isfinite(np.asarray(out.policy_logits)))
+
+
+def test_shallow_torso_rejects_too_small_frames():
+  """Frames under the conv stack's 20x20 minimum must fail with the
+  flag hint, not flax's inscrutable ZeroDivisionError."""
+  agent = ImpalaAgent(num_actions=NUM_ACTIONS, torso='shallow')
+  with pytest.raises(ValueError, match='20x20.*16x16'):
+    init_params(agent, jax.random.PRNGKey(0),
+                {'frame': (16, 16, 3), 'instr_len': MAX_INSTRUCTION_LEN})
