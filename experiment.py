@@ -256,8 +256,20 @@ def main(argv):
     run = driver.train(cfg)
     logging.info('training done at %d frames', run.frames)
   elif cfg.mode == 'anakin':
+    import jax
     from scalable_agent_tpu.parallel import anakin
-    carry = anakin.train(cfg)
+    if jax.process_count() > 1:
+      # Anakin is single-host by design: there is no cross-host batch
+      # transport in the fused loop, so each process would train an
+      # independent, never-synchronized replica (the failure
+      # driver.choose_mesh refuses for multi-host too).
+      raise app.UsageError('--mode=anakin is single-host; use '
+                           '--mode=train for the multi-host pipeline')
+    # Same mesh policy as driver.train (ADVICE r4: a v5e-8 pod slice
+    # must not silently train on one chip): all local devices,
+    # model_parallelism honored, warn-and-fallback to single-device
+    # when the batch cannot shard.
+    carry = anakin.train(cfg, mesh=driver.choose_mesh(cfg))
     logging.info('anakin training done at %d frames',
                  int(carry.train_state.update_steps) *
                  cfg.frames_per_step)

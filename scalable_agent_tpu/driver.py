@@ -120,7 +120,7 @@ def _choose_eval_mesh():
   return mesh_lib.make_mesh(devices, model_parallelism=1)
 
 
-def _choose_mesh(config: Config):
+def choose_mesh(config: Config):
   """Mesh over all local devices when the batch can shard; None means
   plain single-device jit (the reference's single-machine mode)."""
   devices = jax.devices()
@@ -214,7 +214,7 @@ def train(config: Config, max_steps: Optional[int] = None,
     # trace time for library users).
     raise ValueError('use_pallas_vtrace and use_associative_scan are '
                      'mutually exclusive')
-  mesh = _choose_mesh(config)
+  mesh = choose_mesh(config)
   if mesh is not None and config.use_pallas_vtrace:
     # pallas_call has no SPMD partitioning rule: under the sharded
     # step it would be rejected or force replication of the [T, B]

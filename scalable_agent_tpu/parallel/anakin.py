@@ -27,8 +27,11 @@ episode stats (the emitted StepOutputInfo carries final stats at done;
 the carried state resets), initial env_output has done=True with a
 zero/priming agent_output. Because acting uses the pre-update params
 of the same step, behaviour == target at loss time and V-trace's rhos
-are 1 — the on-policy special case (the correction machinery still
-runs; tests pin this).
+are 1 for the T timesteps acted THIS step — the on-policy special
+case (the correction machinery still runs; tests pin this). The one
+exception is the t=0 overlap timestep: its behaviour logits came from
+the PREVIOUS fused step's pre-update params, so it carries exactly
+one update of policy lag (same as the host pipeline's overlap frame).
 
 Scale-out: `init_carry(..., mesh=...)` / `run(..., mesh=...)` shard
 every batch-leading leaf over the mesh's data axis — each device steps

@@ -42,7 +42,7 @@ def shard_batch_over_model(config) -> bool:
   (each process supplies only its own fleet's rows), so model-axis
   batch replication would demand bit-identical batches from different
   hosts. The ONE predicate both the batch-divisibility check
-  (driver._choose_mesh) and the actual sharding choice
+  (driver.choose_mesh) and the actual sharding choice
   (train_parallel.make_sharded_train_step) consult — they must never
   drift."""
   return config.model_parallelism > 1 and jax.process_count() > 1

@@ -1,0 +1,121 @@
+"""Diff the reconstructed anchor tables against their upstream sources.
+
+This sandbox has no network and an empty reference mount, so the
+human/random anchor tables in `envs/dmlab30.py` and `envs/atari57.py`
+are reconstructions (each module's provenance caveat). This script is
+the mechanical half of docs/RUNBOOK.md section 2: run it on a machine
+that has the upstream sources, and it diffs every constant, prints any
+drift, and on a clean diff prints the exact edits (provenance flip +
+checksum) that mark the tables verified.
+
+Usage:
+  # DMLab-30: point at a checkout of the upstream module
+  #   github.com/deepmind/scalable_agent/blob/master/dmlab30.py
+  python scripts/verify_anchors.py dmlab30 /path/to/upstream/dmlab30.py
+
+  # Atari-57: point at a JSON file {game: [random, human], ...}
+  # transcribed from Wang et al. 2016 (arXiv:1511.06581) Table 4.
+  python scripts/verify_anchors.py atari57 /path/to/wang2016_table4.json
+
+Exit status: 0 = tables match upstream exactly; 1 = drift found (each
+drifted symbol printed); 2 = usage/load error.
+
+Not run in CI (upstream unavailable there) — tests/test_anchors.py
+covers the checksum/warning machinery instead.
+"""
+
+import json
+import os
+import runpy
+import sys
+
+# `python scripts/verify_anchors.py` puts scripts/ (not the repo root)
+# on sys.path — same preamble as the sibling scripts.
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _fail(msg):
+  print(f'verify_anchors: {msg}', file=sys.stderr)
+  return 2
+
+
+def _diff_tables(name, ours, upstream):
+  """Print per-key drift between two {key: value} tables."""
+  drift = 0
+  for key in sorted(set(ours) | set(upstream)):
+    if key not in ours:
+      print(f'  {name}[{key!r}]: MISSING locally '
+            f'(upstream {upstream[key]!r})')
+      drift += 1
+    elif key not in upstream:
+      print(f'  {name}[{key!r}]: not in upstream '
+            f'(local {ours[key]!r})')
+      drift += 1
+    elif ours[key] != upstream[key]:
+      print(f'  {name}[{key!r}]: local {ours[key]!r} != '
+            f'upstream {upstream[key]!r}')
+      drift += 1
+  return drift
+
+
+def verify_dmlab30(upstream_path):
+  """Returns (drift_count, module_path, our_tables)."""
+  from scalable_agent_tpu.envs import dmlab30
+  # Upstream is a plain-constants module (no package-relative imports);
+  # runpy executes it without installing anything.
+  up = runpy.run_path(upstream_path)
+  tables = {'LEVEL_MAPPING': dict(dmlab30.LEVEL_MAPPING),
+            'HUMAN_SCORES': dmlab30.HUMAN_SCORES,
+            'RANDOM_SCORES': dmlab30.RANDOM_SCORES}
+  drift = 0
+  for sym, ours in tables.items():
+    if sym not in up:
+      print(f'  upstream module has no {sym} — wrong file?')
+      drift += 1
+      continue
+    drift += _diff_tables(sym, dict(ours), dict(up[sym]))
+  return drift, 'scalable_agent_tpu/envs/dmlab30.py', tables
+
+
+def verify_atari57(upstream_path):
+  """Returns (drift_count, module_path, our_tables)."""
+  from scalable_agent_tpu.envs import atari57
+  with open(upstream_path) as f:
+    table = json.load(f)
+  tables = {'RANDOM_SCORES': atari57.RANDOM_SCORES,
+            'HUMAN_SCORES': atari57.HUMAN_SCORES}
+  upstream_random = {g: float(rh[0]) for g, rh in table.items()}
+  upstream_human = {g: float(rh[1]) for g, rh in table.items()}
+  drift = _diff_tables('RANDOM_SCORES', tables['RANDOM_SCORES'],
+                       upstream_random)
+  drift += _diff_tables('HUMAN_SCORES', tables['HUMAN_SCORES'],
+                        upstream_human)
+  return drift, 'scalable_agent_tpu/envs/atari57.py', tables
+
+
+def main(argv):
+  if len(argv) != 3 or argv[1] not in ('dmlab30', 'atari57'):
+    return _fail(__doc__)
+  which, upstream_path = argv[1], argv[2]
+  try:
+    drift, module_path, tables = (verify_dmlab30(upstream_path)
+                                  if which == 'dmlab30'
+                                  else verify_atari57(upstream_path))
+  except (OSError, json.JSONDecodeError, SyntaxError) as e:
+    return _fail(f'could not load upstream source: {e!r}')
+  if drift:
+    print(f'{which}: {drift} drifted constant(s) — fix them in '
+          f'{module_path}, rerun this script, then apply the '
+          f'verified-edit it prints.')
+    return 1
+  from scalable_agent_tpu.envs import anchors
+  print(f'{which}: all constants match upstream. Mark verified in '
+        f'{module_path}:')
+  print("  ANCHOR_PROVENANCE = 'verified'")
+  print(f"  ANCHOR_SHA256 = ('{anchors.anchor_checksum(tables)}')")
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main(sys.argv))

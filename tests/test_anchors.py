@@ -1,0 +1,113 @@
+"""Anchor-table provenance machinery (envs/anchors.py; VERDICT r4 #7).
+
+The anchor VALUES cannot be proven in this sandbox (no upstream — see
+docs/RUNBOOK.md section 2); these tests pin the guard rails around
+them: checksum stability, corruption detection, and the once-per-run
+provenance warning.
+"""
+
+import logging
+
+import pytest
+
+from scalable_agent_tpu.envs import anchors, atari57, dmlab30
+
+
+def _dmlab30_tables():
+  return {'LEVEL_MAPPING': dict(dmlab30.LEVEL_MAPPING),
+          'HUMAN_SCORES': dmlab30.HUMAN_SCORES,
+          'RANDOM_SCORES': dmlab30.RANDOM_SCORES}
+
+
+def _atari57_tables():
+  return {'RANDOM_SCORES': atari57.RANDOM_SCORES,
+          'HUMAN_SCORES': atari57.HUMAN_SCORES}
+
+
+def test_pinned_checksums_match_the_tables():
+  """The ANCHOR_SHA256 constants pin the exact shipped values — any
+  edit to a constant must update the pin (and go through the
+  verify_anchors.py workflow)."""
+  assert anchors.anchor_checksum(_dmlab30_tables()) == (
+      dmlab30.ANCHOR_SHA256)
+  assert anchors.anchor_checksum(_atari57_tables()) == (
+      atari57.ANCHOR_SHA256)
+
+
+def test_checksum_is_order_independent_but_value_sensitive():
+  t = {'A': {'x': 1.0, 'y': 2.0}}
+  reordered = {'A': {'y': 2.0, 'x': 1.0}}
+  assert anchors.anchor_checksum(t) == anchors.anchor_checksum(reordered)
+  assert anchors.anchor_checksum(t) != anchors.anchor_checksum(
+      {'A': {'x': 1.0, 'y': 2.0000001}})
+  assert anchors.anchor_checksum(t) != anchors.anchor_checksum(
+      {'B': {'x': 1.0, 'y': 2.0}})
+
+
+def test_scoring_raises_on_corrupted_anchor(monkeypatch):
+  """A drifted constant must fail scoring loudly, not skew scores."""
+  corrupted = dict(dmlab30.HUMAN_SCORES)
+  corrupted['rooms_watermaze'] = 999.0
+  monkeypatch.setattr(dmlab30, 'HUMAN_SCORES', corrupted)
+  returns = {l: [1.0] for l in dmlab30.ALL_LEVELS}
+  with pytest.raises(ValueError, match='pinned checksum'):
+    dmlab30.compute_human_normalized_score(returns)
+
+
+def test_provenance_warning_once_per_process(monkeypatch, caplog):
+  monkeypatch.setattr(anchors, '_warned', set())
+  returns = {g: [0.0] for g in atari57.ALL_GAMES}
+  with caplog.at_level(logging.WARNING):
+    atari57.compute_human_normalized_score(returns)
+  warnings = [r for r in caplog.records if 'PROVENANCE' in r.message]
+  assert len(warnings) == 1
+  assert 'envs/atari57.py' in warnings[0].message
+  caplog.clear()
+  with caplog.at_level(logging.WARNING):
+    atari57.compute_human_normalized_score(returns)
+  assert not [r for r in caplog.records if 'PROVENANCE' in r.message]
+
+
+def test_verified_provenance_is_silent(monkeypatch, caplog):
+  monkeypatch.setattr(anchors, '_warned', set())
+  monkeypatch.setattr(dmlab30, 'ANCHOR_PROVENANCE', 'verified')
+  returns = {l: [1.0] for l in dmlab30.ALL_LEVELS}
+  with caplog.at_level(logging.WARNING):
+    dmlab30.compute_human_normalized_score(returns)
+  assert not [r for r in caplog.records if 'PROVENANCE' in r.message]
+
+
+def test_verify_anchors_script_clean_and_drifted(tmp_path, capsys):
+  """scripts/verify_anchors.py: a faithful upstream file diffs clean
+  (exit 0, prints the verified edit); a drifted one is itemized."""
+  import sys
+  sys.path.insert(0, 'scripts')
+  try:
+    import verify_anchors
+  finally:
+    sys.path.pop(0)
+
+  # Synthesize an "upstream" dmlab30 module from our own tables — the
+  # script's load/diff machinery is what's under test here, not the
+  # values (which CI cannot know).
+  lines = ['import collections',
+           f'LEVEL_MAPPING = collections.OrderedDict('
+           f'{list(dmlab30.LEVEL_MAPPING.items())!r})',
+           f'HUMAN_SCORES = {dmlab30.HUMAN_SCORES!r}',
+           f'RANDOM_SCORES = {dmlab30.RANDOM_SCORES!r}']
+  upstream = tmp_path / 'dmlab30.py'
+  upstream.write_text('\n'.join(lines))
+  rc = verify_anchors.main(['prog', 'dmlab30', str(upstream)])
+  out = capsys.readouterr().out
+  assert rc == 0
+  assert "ANCHOR_PROVENANCE = 'verified'" in out
+  assert dmlab30.ANCHOR_SHA256 in out
+
+  drifted = dict(dmlab30.HUMAN_SCORES)
+  drifted['rooms_watermaze'] = 55.5
+  lines[2] = f'HUMAN_SCORES = {drifted!r}'
+  upstream.write_text('\n'.join(lines))
+  rc = verify_anchors.main(['prog', 'dmlab30', str(upstream)])
+  out = capsys.readouterr().out
+  assert rc == 1
+  assert 'rooms_watermaze' in out and '55.5' in out

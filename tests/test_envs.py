@@ -242,3 +242,162 @@ def test_cue_memory_rejects_wrong_action_count():
   from scalable_agent_tpu.envs.fake import CueMemoryEnv
   with pytest.raises(ValueError, match='3-action'):
     CueMemoryEnv(num_actions=4)
+
+
+# --- DMLab adapter over a scripted backend (VERDICT r4 #4) ---
+
+class FakeLab:
+  """Deterministic deepmind_lab.Lab stand-in exercising the adapter's
+  real-hardware code path: episodes end (`is_running` False) after
+  `episode_len` step() calls; reward = sum of the raw action row ×
+  num_steps; INSTR changes every step; the constructor runs DMLab's
+  level-cache protocol (fetch, compile-on-miss, write)."""
+
+  episode_len = 3
+
+  def __init__(self, level, observations, config, level_cache):
+    self.level = level
+    self.observations_spec = list(observations)
+    self.config = dict(config)
+    self.reset_seeds = []
+    self.step_calls = []   # (raw action row copy, num_steps)
+    self.closed = False
+    self._t = 0            # global step counter → frame/INSTR content
+    self._acts = 0         # steps since reset
+    self._started = False
+    self.cache_hit = None
+    self.fetched_pk3 = None
+    if level_cache is not None:
+      # DMLab's side of the cache contract: try fetch, else compile
+      # and publish. The key is the level name here; real DMLab hashes
+      # level + params, which the cache treats as opaque anyway.
+      import tempfile
+      with tempfile.NamedTemporaryFile(suffix='.pk3',
+                                       delete=False) as f:
+        pk3_path = f.name
+      self.cache_hit = level_cache.fetch(level, pk3_path)
+      if self.cache_hit:
+        with open(pk3_path, 'rb') as f:
+          self.fetched_pk3 = f.read()
+      else:
+        with open(pk3_path, 'wb') as f:
+          f.write(b'compiled:' + level.encode())
+        level_cache.write(level, pk3_path)
+      os.unlink(pk3_path)
+
+  def reset(self, seed):
+    self.reset_seeds.append(int(seed))
+    self._acts = 0
+    self._started = True
+
+  def is_running(self):
+    return self._started and self._acts < self.episode_len
+
+  def step(self, action, num_steps):
+    assert self.is_running(), 'step() on a finished episode'
+    self.step_calls.append((np.array(action, copy=True),
+                            int(num_steps)))
+    self._t += 1
+    self._acts += 1
+    return float(action.sum()) * num_steps
+
+  def observations(self):
+    h = int(self.config['height'])
+    w = int(self.config['width'])
+    return {
+        'RGB_INTERLEAVED': np.full((h, w, 3), self._t % 256, np.uint8),
+        'INSTR': f'go to step {self._t}',
+    }
+
+  def close(self):
+    self.closed = True
+
+
+def _make_fake_dmlab(tmp_path, seed=11, **kwargs):
+  kwargs.setdefault('level_cache_dir', str(tmp_path / 'cache'))
+  return dmlab.DmLabEnv(
+      'rooms_watermaze', {'height': 8, 'width': 12}, seed=seed,
+      num_action_repeats=4, lab_cls=FakeLab, **kwargs)
+
+
+def test_dmlab_step_action_set_and_repeat(tmp_path):
+  env = _make_fake_dmlab(tmp_path)
+  lab = env._env
+  frame, instr = env.initial()
+  assert frame.shape == (8, 12, 3) and frame.dtype == np.uint8
+  assert lab.observations_spec == ['RGB_INTERLEAVED', 'INSTR']
+
+  reward, done, (frame, instr) = env.step(5)  # Look Right
+  raw, num_steps = lab.step_calls[-1]
+  np.testing.assert_array_equal(raw, dmlab.DEFAULT_ACTION_SET[5])
+  assert raw.dtype == np.intc          # DMLab's required action dtype
+  assert num_steps == 4                # action repeat via num_steps
+  assert reward == np.float32(20.0 * 4) and reward.dtype == np.float32
+  assert not done and frame[0, 0, 0] == 1  # post-step observation
+
+
+def test_dmlab_instr_hashing_tracks_the_env(tmp_path):
+  from scalable_agent_tpu.models.instruction import hash_instruction
+  env = _make_fake_dmlab(tmp_path)
+  _, _, (_, instr) = env.step(0)
+  np.testing.assert_array_equal(instr, hash_instruction('go to step 1'))
+  _, _, (_, instr) = env.step(0)
+  np.testing.assert_array_equal(instr, hash_instruction('go to step 2'))
+  assert instr.dtype == np.int32
+
+
+def test_dmlab_auto_reset_and_seed_stream(tmp_path):
+  """Two full episodes: done fires exactly at episode end, the env
+  auto-resets (observation comes from the NEW episode), and each reset
+  consumes the next value of the per-env RandomState(seed) stream."""
+  env = _make_fake_dmlab(tmp_path, seed=11)
+  lab = env._env
+  dones = []
+  for _ in range(2 * FakeLab.episode_len):
+    reward, done, (frame, instr) = env.step(0)
+    dones.append(bool(done))
+  # Episodes are episode_len steps; done on the last step of each.
+  expected = ([False] * (FakeLab.episode_len - 1) + [True]) * 2
+  assert dones == expected
+  # initial reset + 2 auto-resets, seeds drawn from RandomState(11).
+  expected_stream = np.random.RandomState(seed=11)
+  assert lab.reset_seeds == [
+      int(expected_stream.randint(0, 2 ** 31 - 1)) for _ in range(3)]
+  # The post-done observation belongs to the fresh episode (is_running
+  # again true, stepping works without error).
+  assert lab.is_running()
+  env.step(1)
+  env.close()
+  assert lab.closed
+
+
+def test_dmlab_level_cache_fetch_and_write(tmp_path):
+  """First construction misses the cache and writes the compiled
+  level; a second env for the same level hits it (LocalLevelCache's
+  real on-disk protocol, driven through the Lab constructor)."""
+  cache_dir = tmp_path / 'cache'
+  env1 = _make_fake_dmlab(tmp_path)
+  assert env1._env.cache_hit is False
+  assert (cache_dir / 'rooms_watermaze').read_bytes() == (
+      b'compiled:rooms_watermaze')
+  # Second env: fetch() returns True and the fake skips compilation —
+  # so the pk3 content it reads back is the CACHED copy.
+  env2 = _make_fake_dmlab(tmp_path)
+  assert env2._env.cache_hit is True
+  assert env2._env.fetched_pk3 == b'compiled:rooms_watermaze'
+  env1.close(), env2.close()
+
+
+def test_dmlab_shared_cache_object_and_per_env_seeds(tmp_path):
+  """An explicitly shared LocalLevelCache instance is honored, and
+  two envs with different seeds draw different reset streams."""
+  shared = dmlab.LocalLevelCache(str(tmp_path / 'shared'))
+  env1 = dmlab.DmLabEnv('explore_goal_locations_small',
+                        {'height': 8, 'width': 12}, seed=1,
+                        level_cache=shared, lab_cls=FakeLab)
+  env2 = dmlab.DmLabEnv('explore_goal_locations_small',
+                        {'height': 8, 'width': 12}, seed=2,
+                        level_cache=shared, lab_cls=FakeLab)
+  assert (tmp_path / 'shared' /
+          'explore_goal_locations_small').is_file()
+  assert env1._env.reset_seeds != env2._env.reset_seeds
