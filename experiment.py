@@ -114,8 +114,11 @@ flags.DEFINE_float('sticky_action_prob', _DEFAULTS.sticky_action_prob,
                    'Atari: per-frame previous-action repeat '
                    'probability (0.25 = Machado et al. evaluation '
                    'protocol).', lower_bound=0.0, upper_bound=1.0)
-flags.DEFINE_enum('torso', _DEFAULTS.torso, ['deep', 'shallow'],
-                  'Agent torso: deep ResNet (reference) or the '
+flags.DEFINE_enum('torso', _DEFAULTS.torso,
+                  ['deep', 'deep_fast', 'shallow'],
+                  'Agent torso: deep ResNet (reference), deep_fast '
+                  '(stride-2 convs replace the max-pools — the HBM-'
+                  'bandwidth operating point, docs/PERF.md), or the '
                   "paper's shallow CNN.")
 flags.DEFINE_enum('compute_dtype', _DEFAULTS.compute_dtype,
                   ['float32', 'bfloat16'], 'On-device compute dtype.')
@@ -265,6 +268,11 @@ def main(argv):
       # driver.choose_mesh refuses for multi-host too).
       raise app.UsageError('--mode=anakin is single-host; use '
                            '--mode=train for the multi-host pipeline')
+    if cfg.model_parallelism > 1:
+      # Anakin shards only the data axis (init_carry); a TP mesh would
+      # silently replicate identical compute across the model axis.
+      raise app.UsageError('--mode=anakin is data-parallel only; drop '
+                           '--model_parallelism')
     # Same mesh policy as driver.train (ADVICE r4: a v5e-8 pod slice
     # must not silently train on one chip): all local devices,
     # model_parallelism honored, warn-and-fallback to single-device

@@ -65,7 +65,7 @@ class Config:
   use_py_process: bool = True             # host each env in its own process
   publish_params_every: int = 1           # actor weight-snapshot cadence
   model_parallelism: int = 1              # TP width of the mesh
-  torso: str = 'deep'                     # deep | shallow
+  torso: str = 'deep'                     # deep | deep_fast | shallow
   scan_unroll: int = 10                   # LSTM time-scan unroll factor
                                           # (v5e sweep at T=100, B=32:
                                           # 1→40.8ms 5→40.5 10→39.3

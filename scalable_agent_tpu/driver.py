@@ -179,9 +179,17 @@ class TrainRun:
 
 def train(config: Config, max_steps: Optional[int] = None,
           stall_timeout_secs: Optional[float] = None,
-          max_seconds: Optional[float] = None) -> TrainRun:
+          max_seconds: Optional[float] = None,
+          fleet_factory=None) -> TrainRun:
   """Run IMPALA training until total_environment_frames (or max_steps
   / max_seconds — timed smoke and bench runs).
+
+  `fleet_factory(config, agent, policy, buffer, levels)` replaces
+  make_fleet when given — bench.py's fed-learner stage injects a
+  synthetic producer fleet here so THIS loop (stats extraction,
+  publish cadence, summaries, health checks) can be measured at full
+  feed rate without env/inference cost (VERDICT r4 #3). Production
+  always uses the default.
 
   Returns the TrainRun with the final state (all machinery shut down).
   """
@@ -342,11 +350,19 @@ def train(config: Config, max_steps: Optional[int] = None,
     server.update_params(initial_pub)
     # Pre-compile inference buckets up to the fleet size: a bucket's
     # first appearance otherwise stalls every parked actor for the TPU
-    # compile (the reference's TF graph had dynamic batch dims).
-    server.warmup(spec0.obs_spec, max_size=config.num_actors)
+    # compile (the reference's TF graph had dynamic batch dims). With
+    # no local fleet (remote-ingest-only learners, synthetic
+    # fleet_factory benches) nothing calls local inference — skip the
+    # 20–40 s compile.
+    if config.num_actors > 0:
+      server.warmup(spec0.obs_spec, max_size=config.num_actors)
 
-    fleet = make_fleet(config, agent, server.policy, buffer, levels,
-                       seed_base=process_seed_base)
+    if fleet_factory is None:
+      fleet = make_fleet(config, agent, server.policy, buffer, levels,
+                         seed_base=process_seed_base)
+    else:
+      fleet = fleet_factory(config, agent, server.policy, buffer,
+                            levels)
 
     def stage(host_batch):
       """Prefetcher stage: peel off a tiny host-side stats view (done /

@@ -55,6 +55,37 @@ class DeepResNetTorso(nn.Module):
     return nn.relu(x)
 
 
+class DeepFastTorso(nn.Module):
+  """`deep_fast`: the deep ResNet with each section's conv3x3 +
+  maxpool3x3/2 replaced by a single stride-2 conv3x3.
+
+  HBM-bandwidth variant (docs/PERF.md round 5): the flagship step is
+  memory-bound and the per-section PRE-POOL activation (section 1:
+  [3232, 72, 96, 16] bf16 = 715 MB at flagship shapes) dominates the
+  backward's byte traffic; producing the downsampled activation
+  directly removes that tensor and the pool's select-and-scatter
+  backward entirely. Same parameter count/shapes as `deep` (conv
+  kernels are 3x3 either way), NOT weight-compatible in function: a
+  smaller receptive field per section (3 vs 5) and no max nonlinearity
+  — an opt-in operating point, not the parity model."""
+  sections: Sequence[Tuple[int, int]] = ((16, 2), (32, 2), (32, 2))
+  output_size: int = 256
+  dtype: jnp.dtype = jnp.float32
+
+  @nn.compact
+  def __call__(self, frame):
+    x = frame.astype(self.dtype) / 255.0
+    for channels, num_blocks in self.sections:
+      x = nn.Conv(channels, (3, 3), strides=(2, 2), padding='SAME',
+                  dtype=self.dtype)(x)
+      for _ in range(num_blocks):
+        x = ResidualBlock(channels, dtype=self.dtype)(x)
+    x = nn.relu(x)
+    x = x.reshape((x.shape[0], -1))
+    x = nn.Dense(self.output_size, dtype=self.dtype)(x)
+    return nn.relu(x)
+
+
 class ShallowTorso(nn.Module):
   """Paper's shallow 2-conv torso (not in the reference repo; see module
   docstring)."""
@@ -83,5 +114,6 @@ class ShallowTorso(nn.Module):
 
 TORSOS = {
     'deep': DeepResNetTorso,
+    'deep_fast': DeepFastTorso,
     'shallow': ShallowTorso,
 }

@@ -36,7 +36,7 @@ def _make_env_outputs(rng, t, b, done=None):
                       jnp.int32)))
 
 
-@pytest.fixture(scope='module', params=['shallow', 'deep'])
+@pytest.fixture(scope='module', params=['shallow', 'deep', 'deep_fast'])
 def agent_and_params(request):
   agent = ImpalaAgent(num_actions=NUM_ACTIONS, torso=request.param)
   params = init_params(agent, jax.random.PRNGKey(0), OBS_SPEC)
@@ -187,3 +187,19 @@ def test_shallow_torso_rejects_too_small_frames():
   with pytest.raises(ValueError, match='20x20.*16x16'):
     init_params(agent, jax.random.PRNGKey(0),
                 {'frame': (16, 16, 3), 'instr_len': MAX_INSTRUCTION_LEN})
+
+
+def test_deep_fast_matches_deep_param_tree():
+  """deep_fast (stride-2 convs, docs/PERF.md round 5) keeps the exact
+  parameter tree of the parity deep torso — checkpoints stay
+  layout-compatible even though the FUNCTION differs (no max-pool)."""
+  from scalable_agent_tpu.models.torsos import TORSOS
+  x = jnp.zeros((2, 72, 96, 3), jnp.uint8)
+  p_deep = TORSOS['deep']().init(jax.random.PRNGKey(0), x)
+  p_fast = TORSOS['deep_fast']().init(jax.random.PRNGKey(0), x)
+  shapes = lambda p: jax.tree_util.tree_map(lambda a: a.shape, p)
+  assert shapes(p_deep) == shapes(p_fast)
+  # Same spatial reduction per section (stride 2 vs pool 2): identical
+  # flatten width into the Dense projection.
+  y = TORSOS['deep_fast']().apply(p_fast, x)
+  assert y.shape == (2, 256)
