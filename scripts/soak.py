@@ -32,7 +32,7 @@ sustained failure — the greenfield feature the reference never had
     in the respawn/reconnect paths would be invisible in short
     targeted tests.
 
-Writes SOAK_r04.json at the repo root. Invocation (real chip):
+Writes SOAK_r05.json at the repo root. Invocation (real chip):
 
     SOAK_CHURN=1 python scripts/soak.py        # ~20 min churn soak
     python scripts/soak.py                      # 10 min steady-state
@@ -525,7 +525,7 @@ def main():
       },
       'smoke': smoke,
   }
-  out_path = os.path.join(REPO, 'SOAK_r04.json')
+  out_path = os.path.join(REPO, 'SOAK_r05.json')
   if smoke:
     out_path = os.path.join(logdir, 'SOAK_smoke.json')
   with open(out_path, 'w') as f:
