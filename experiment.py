@@ -141,7 +141,12 @@ flags.DEFINE_integer('publish_params_every',
                      _DEFAULTS.publish_params_every,
                      'Learner steps between actor weight snapshots.')
 flags.DEFINE_integer('inference_min_batch', _DEFAULTS.inference_min_batch,
-                     'Dynamic batcher minimum merge size.')
+                     'Dynamic batcher minimum merge size. 0 = auto: '
+                     'train-mode merges floor at the fleet size, '
+                     'bounded by --inference_timeout_ms (the measured '
+                     '+53% e2e merge lever, docs/PERF.md); eval '
+                     'ignores the floor (its caller count shrinks as '
+                     'levels finish).')
 flags.DEFINE_integer('inference_max_batch', _DEFAULTS.inference_max_batch,
                      'Dynamic batcher maximum merge size.')
 flags.DEFINE_integer('inference_timeout_ms',

@@ -101,7 +101,7 @@ class Config:
   profile_start_step: int = 20            # past warmup/compile
   profile_num_steps: int = 5
   # Inference batching (reference dynamic_batching defaults, ≈2.9).
-  inference_min_batch: int = 1
+  inference_min_batch: int = 1            # 0 = auto (fleet-size floor)
   inference_max_batch: int = 1024
   inference_timeout_ms: int = 100
   # Ring buffer capacity in batches (reference FIFOQueue capacity=1 +

@@ -341,7 +341,8 @@ def train(config: Config, max_steps: Optional[int] = None,
     process_index = jax.process_index()
     process_seed_base = process_index * max(config.num_actors, 1000)
     server = InferenceServer(agent, initial_pub, config,
-                             seed=config.seed + 1000 + process_seed_base)
+                             seed=config.seed + 1000 + process_seed_base,
+                             fleet_size=config.num_actors)
     # update_params COPIES: the constructor stores its argument by
     # reference, and in the non-localized path that is state.params
     # itself — which the first train step DONATES. Without this copy,
@@ -722,6 +723,12 @@ def evaluate(config: Config,
     server = None
     fleet = None
     try:
+      # No fleet_size here: the auto merge FLOOR (inference_min_batch
+      # =0) must not apply to eval — levels retire as their episodes
+      # finish, so the caller count shrinks PERMANENTLY below the
+      # floor and the tail would step one timeout per batch
+      # (reintroducing the W5 tail stalls pad_batch_to eliminated).
+      # pad_batch_to keeps the single-compile property either way.
       server = InferenceServer(agent, params, config,
                                seed=config.seed + 2000,
                                mesh=_choose_eval_mesh(),

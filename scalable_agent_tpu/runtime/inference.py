@@ -64,11 +64,25 @@ class InferenceServer:
       steady-state merged size is known (eval: all levels step
       concurrently), not for training fleets whose merge size is the
       tuning signal.
+    fleet_size: number of actor threads this server will serve —
+      only consulted when config.inference_min_batch == 0 (AUTO merge
+      floor; see the constructor comment).
   """
 
   def __init__(self, agent, params, config, seed=0, mesh=None,
-               pad_batch_to=None):
+               pad_batch_to=None, fleet_size=None):
     self._pad_floor = pad_batch_to
+    # inference_min_batch == 0 means AUTO: floor the merge at the
+    # local fleet size, so every inference call carries the whole
+    # fleet and per-call dispatch amortizes fully (measured +53% e2e
+    # fps at the bench operating point — docs/PERF.md round-5 batcher
+    # sweep). inference_timeout_ms bounds the wait when an actor is
+    # mid-unroll-publish or being respawned, so the floor degrades to
+    # a latency cap, never a deadlock.
+    min_batch = config.inference_min_batch
+    if min_batch == 0:
+      min_batch = max(fleet_size or 1, 1)
+    self._min_batch = min(min_batch, config.inference_max_batch)
     self._agent = agent
     self._core_sizes = (agent.hidden_size, agent.hidden_size)  # (c, h)
     self._mesh = mesh
@@ -159,7 +173,7 @@ class InferenceServer:
       return tuple(o[:n] for o in outs)
 
     self._batched = dynamic_batching.batch_fn_with_options(
-        minimum_batch_size=config.inference_min_batch,
+        minimum_batch_size=self._min_batch,
         maximum_batch_size=config.inference_max_batch,
         timeout_ms=config.inference_timeout_ms)(batched)
 

@@ -878,7 +878,8 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
     # streams in the same training batch.
     seed_base = _REMOTE_SEED_SPACE + task * max(config.num_actors, 1000)
     server = InferenceServer(agent, params, config,
-                             seed=config.seed + seed_base)
+                             seed=config.seed + seed_base,
+                             fleet_size=config.num_actors)
     server.warmup(spec0.obs_spec, max_size=config.num_actors)
     buffer = ring_buffer.TrajectoryBuffer(
         max(2 * config.num_actors, 2))

@@ -24,3 +24,64 @@ def test_anakin_bench_smoke():
   results = bench.bench_anakin(smoke=True)
   assert results['env_frames_per_sec'] > 0
   assert 0 <= results['mean_reward_last'] <= 1.0
+
+
+def test_read_window_summaries_counts_frames_over_window(tmp_path):
+  """The e2e instrument (round 5): fps = step deltas between the first
+  and last summary event over their wall-time span — NOT the last
+  FpsMeter sample (which quantizes in whole batches per meter window)."""
+  import json
+  lines = [
+      # tag, value, step, wall_time
+      ('env_frames_per_sec', 100.0, 10, 1000.0),
+      ('inference_mean_batch', 3.5, 10, 1000.0),
+      ('env_frames_per_sec', 999.0, 20, 1004.0),  # meter lies; steps don't
+      ('buffer_unrolls', 2.0, 20, 1004.0),
+  ]
+  with open(tmp_path / 'summaries.jsonl', 'w') as f:
+    for tag, value, step, wall in lines:
+      f.write(json.dumps({'tag': tag, 'value': value, 'step': step,
+                          'wall_time': wall}) + '\n')
+  fps, span, last = bench._read_window_summaries(str(tmp_path),
+                                                 frames_per_step=40)
+  # (20-10) steps * 40 frames / (1004-1000) s = 100 fps — the meter's
+  # bogus 999 sample must not leak into the result.
+  assert fps == 100.0
+  assert span == 4.0
+  assert last['inference_mean_batch'] == 3.5
+  assert last['buffer_unrolls'] == 2.0
+
+
+def test_read_window_summaries_single_event_falls_back(tmp_path):
+  import json
+  with open(tmp_path / 'summaries.jsonl', 'w') as f:
+    f.write(json.dumps({'tag': 'env_frames_per_sec', 'value': 77.0,
+                        'step': 5, 'wall_time': 1.0}) + '\n')
+  fps, span, _ = bench._read_window_summaries(str(tmp_path),
+                                              frames_per_step=40)
+  assert fps == 77.0 and span == 0.0
+
+
+def test_fed_learner_smoke_via_fleet_factory(tmp_path):
+  """driver.train(fleet_factory=...) — the injection point the fed
+  bench stands on: a synthetic producer fleet feeds the real loop with
+  no envs/inference; the run trains and terminates on max_steps."""
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.testing import make_example_unroll
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+
+  cfg = Config(logdir=str(tmp_path), env_backend='fake', num_actions=9,
+               num_actors=0, batch_size=2, unroll_length=5,
+               num_action_repeats=1, height=24, width=32,
+               torso='shallow', use_py_process=False,
+               use_instruction=False,
+               total_environment_frames=10**9,
+               checkpoint_secs=10**6, summary_secs=10**6)
+  unroll = make_example_unroll(6, 24, 32, 9, MAX_INSTRUCTION_LEN)
+
+  def fleet_factory(config, agent, policy, buffer, levels):
+    return bench._SyntheticFleet(buffer, unroll)
+
+  run = driver.train(cfg, max_steps=3, fleet_factory=fleet_factory)
+  assert run.frames == 3 * cfg.frames_per_step

@@ -288,6 +288,77 @@ class TestInferenceServer:
       server.close()
 
 
+
+  def test_auto_min_batch_resolves_to_fleet_size(self, monkeypatch):
+    """inference_min_batch=0 (auto) floors the merge at the fleet
+    size, clamped to max_batch (docs/PERF.md round-5 batcher sweep)."""
+    from scalable_agent_tpu.ops import dynamic_batching
+    captured = {}
+    real = dynamic_batching.batch_fn_with_options
+
+    def spy(**kwargs):
+      captured.update(kwargs)
+      return real(**kwargs)
+
+    monkeypatch.setattr(dynamic_batching, 'batch_fn_with_options', spy)
+    agent, params, cfg = _mk(
+        batch_size=4, unroll_length=8, num_action_repeats=1,
+        inference_min_batch=0, inference_max_batch=8,
+        inference_timeout_ms=20)
+    server = InferenceServer(agent, params, cfg, seed=3, fleet_size=6)
+    server.close()
+    assert captured['minimum_batch_size'] == 6
+    # Clamped at max_batch when the fleet is bigger.
+    server = InferenceServer(agent, params, cfg, seed=3, fleet_size=99)
+    server.close()
+    assert captured['minimum_batch_size'] == 8
+    # Explicit min_batch is untouched by fleet_size.
+    agent, params, cfg = _mk(
+        batch_size=4, unroll_length=8, num_action_repeats=1,
+        inference_min_batch=2, inference_max_batch=8,
+        inference_timeout_ms=20)
+    server = InferenceServer(agent, params, cfg, seed=3, fleet_size=6)
+    server.close()
+    assert captured['minimum_batch_size'] == 2
+
+  def test_auto_min_batch_serves_a_fleet(self):
+    """Auto merge floor end-to-end: 3 actors against min_batch=0 —
+    every call should carry all 3 once the fleet is in steady state,
+    and the timeout must keep a lone straggler from deadlocking."""
+    agent, params, cfg = _mk(
+        batch_size=3, unroll_length=6, num_action_repeats=1,
+        inference_min_batch=0, inference_max_batch=8,
+        inference_timeout_ms=50)
+    server = InferenceServer(agent, params, cfg, seed=3, fleet_size=3)
+    try:
+      actors = [
+          Actor(FakeEnv(height=H, width=W, num_actions=A, seed=i),
+                server.policy, agent.initial_state(1), 6)
+          for i in range(3)]
+      results = [None] * 3
+
+      def run(i):
+        results[i] = actors[i].unroll()
+
+      threads = [threading.Thread(target=run, args=(i,))
+                 for i in range(3)]
+      for t in threads:
+        t.start()
+      for t in threads:
+        t.join(timeout=60)
+      assert all(r is not None for r in results)
+      stats = server.stats()
+      assert stats['requests'] >= 3 * 6
+      assert stats['calls'] >= 1
+      # NOTE deliberately no merge-ratio assert: on a loaded 1-core CI
+      # host thread skew can expire the 50 ms window with partial
+      # batches — the floor-resolution contract is pinned by the
+      # monkeypatch test above, and the steady-state merge (3.92/4)
+      # was measured on the real pipeline (docs/PERF.md r5 sweep).
+      # This test pins the no-deadlock property.
+    finally:
+      server.close()
+
 class TestFullPipeline:
 
   def test_actors_buffer_prefetcher_learner(self):
