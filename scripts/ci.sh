@@ -31,4 +31,7 @@ SOAK_SMOKE=1 SOAK_CHURN=1 python scripts/soak.py
 echo '== byte-attribution smoke (cost_analysis mechanics) =='
 SMOKE=1 python scripts/attribute_bytes.py
 
+echo '== conv-lever smoke (variant mechanics + argmax-VJP parity) =='
+SMOKE=1 python scripts/conv_levers.py
+
 echo 'CI OK'
