@@ -26,3 +26,23 @@ warm_forkserver()
 import jax  # noqa: E402
 
 jax.config.update('jax_platforms', 'cpu')
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def batcher_options_spy(monkeypatch):
+  """Intercept dynamic_batching.batch_fn_with_options and record each
+  call's kwargs (shared by the inference merge-floor tests — keeps the
+  two spies from drifting if the decoration call ever changes shape)."""
+  from scalable_agent_tpu.ops import dynamic_batching
+  calls = []
+  real = dynamic_batching.batch_fn_with_options
+
+  def spy(**kwargs):
+    calls.append(kwargs)
+    return real(**kwargs)
+
+  monkeypatch.setattr(dynamic_batching, 'batch_fn_with_options', spy)
+  return calls

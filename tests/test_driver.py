@@ -259,3 +259,17 @@ def test_pallas_vtrace_rejected_under_mesh(tmp_path):
                  use_associative_scan=True)
   with pytest.raises(ValueError, match='mutually exclusive'):
     driver.train(cfg2, max_steps=1)
+
+
+def test_eval_ignores_auto_merge_floor(tmp_path, batcher_options_spy):
+  """--inference_min_batch=0 (auto fleet-size floor, round 5) must NOT
+  apply to evaluate(): levels retire as their episodes finish, so a
+  floor would make the tail step one batcher-timeout per batch (the
+  W5 tail stalls pad_batch_to eliminated). Train resolves the floor;
+  eval resolves to 1."""
+  cfg = _config(tmp_path, inference_min_batch=0,
+                inference_timeout_ms=50, num_actors=2)
+  driver.train(cfg, max_steps=2, stall_timeout_secs=60)
+  assert batcher_options_spy[-1]['minimum_batch_size'] == 2  # train: fleet
+  driver.evaluate(cfg)
+  assert batcher_options_spy[-1]['minimum_batch_size'] == 1  # eval: no floor

@@ -289,29 +289,20 @@ class TestInferenceServer:
 
 
 
-  def test_auto_min_batch_resolves_to_fleet_size(self, monkeypatch):
+  def test_auto_min_batch_resolves_to_fleet_size(self, batcher_options_spy):
     """inference_min_batch=0 (auto) floors the merge at the fleet
     size, clamped to max_batch (docs/PERF.md round-5 batcher sweep)."""
-    from scalable_agent_tpu.ops import dynamic_batching
-    captured = {}
-    real = dynamic_batching.batch_fn_with_options
-
-    def spy(**kwargs):
-      captured.update(kwargs)
-      return real(**kwargs)
-
-    monkeypatch.setattr(dynamic_batching, 'batch_fn_with_options', spy)
     agent, params, cfg = _mk(
         batch_size=4, unroll_length=8, num_action_repeats=1,
         inference_min_batch=0, inference_max_batch=8,
         inference_timeout_ms=20)
     server = InferenceServer(agent, params, cfg, seed=3, fleet_size=6)
     server.close()
-    assert captured['minimum_batch_size'] == 6
+    assert batcher_options_spy[-1]['minimum_batch_size'] == 6
     # Clamped at max_batch when the fleet is bigger.
     server = InferenceServer(agent, params, cfg, seed=3, fleet_size=99)
     server.close()
-    assert captured['minimum_batch_size'] == 8
+    assert batcher_options_spy[-1]['minimum_batch_size'] == 8
     # Explicit min_batch is untouched by fleet_size.
     agent, params, cfg = _mk(
         batch_size=4, unroll_length=8, num_action_repeats=1,
@@ -319,7 +310,7 @@ class TestInferenceServer:
         inference_timeout_ms=20)
     server = InferenceServer(agent, params, cfg, seed=3, fleet_size=6)
     server.close()
-    assert captured['minimum_batch_size'] == 2
+    assert batcher_options_spy[-1]['minimum_batch_size'] == 2
 
   def test_auto_min_batch_serves_a_fleet(self):
     """Auto merge floor end-to-end: 3 actors against min_batch=0 —
