@@ -34,4 +34,7 @@ SMOKE=1 python scripts/attribute_bytes.py
 echo '== conv-lever smoke (variant mechanics + argmax-VJP parity) =='
 SMOKE=1 python scripts/conv_levers.py
 
+echo '== pallas fused conv+pool smoke (interpret-mode parity) =='
+SMOKE=1 python scripts/pallas_conv_pool.py
+
 echo 'CI OK'

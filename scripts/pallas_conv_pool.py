@@ -1,0 +1,225 @@
+"""Pallas fused conv3x3(3->16)+maxpool3x3/2 prototype — measured
+accept/reject for the section-1 kernel (docs/PERF.md round 5 rejected
+it at DESIGN time on im2col arithmetic; this prototype tests the one
+formulation that beats the arithmetic: a banded matmul).
+
+Key idea: flatten W and C (x: [N, H, W*C]) and pre-pad one pixel of
+halo, so the 3x3 conv becomes, for each of 3 row shifts dy, a matmul
+of overlapping 30-column windows against ONE banded weight block
+  Wb[dy] : [30, 128]   (30 = (8+2) cols x 3 ch, 128 = 8 cols x 16 ch)
+whose band structure repeats with period 24 — every column chunk uses
+the same Wb, so the MXU streams [rows, 30] @ [30, 128] with a 128-wide
+output (vs the 27x16 output-starved im2col form). Max-pool (3x3/2,
+XLA's asymmetric SAME: window i covers rows 2i..2i+2) fuses in VMEM —
+the 715 MB pre-pool tensor never reaches HBM.
+
+Usage: python scripts/pallas_conv_pool.py          # real chip
+       SMOKE=1 python scripts/pallas_conv_pool.py  # CPU interpreter
+Prints timing + parity JSON lines.
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SMOKE = os.environ.get('SMOKE') == '1'
+
+import jax  # noqa: E402
+
+if SMOKE:
+  jax.config.update('jax_platforms', 'cpu')
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+CIN, COUT = 3, 16
+JCHUNK = 8                      # output cols per matmul (x16 ch = 128)
+KWIN = (JCHUNK + 2) * CIN       # 30 input cols per window
+
+
+def build_banded_weights(w):
+  """w [3, 3, 3, 16] -> Wb [3, KWIN, JCHUNK*COUT].
+
+  Wb[dy, (j'+1)*CIN + ci, j*COUT + co] = w[dy, j'-j+1, ci, co]
+  for j in [0, JCHUNK), j' in [j-1, j+1] — the chunk's input window
+  is columns (j-1)..(j+JCHUNK) of the (1-padded) frame row."""
+  wb = np.zeros((3, KWIN, JCHUNK * COUT), np.float32)
+  w = np.asarray(w, np.float32)
+  for dy in range(3):
+    for j in range(JCHUNK):
+      for dx in range(3):           # j' = j + dx - 1, window-relative
+        jp = j + dx - 1
+        for ci in range(CIN):
+          wb[dy, (jp + 1) * CIN + ci, j * COUT:(j + 1) * COUT] = \
+              w[dy, dx, ci]
+  return jnp.asarray(wb, jnp.bfloat16)
+
+
+def _kernel(x_ref, wb_ref, sel_ref, out_ref, *, bh, h, wd):
+  """One block of BH samples.
+
+  x [BH, h+2, (wd+2)*CIN] bf16 (halo pre-padded, already /255),
+  wb [3, KWIN, 128], sel [wd*COUT, (wd//2)*COUT] (0/1 compaction) ->
+  out [BH*h, (wd//2)*COUT]: column-pooled, ROW-pooled-but-uncompacted
+  (every row r holds max over conv rows r..r+2; the stride-2 row
+  selection happens outside — Mosaic has no stride-2 vector ops).
+  Everything stays in the flat [rows, wd*COUT] layout: lane-splitting
+  reshapes and strided slices don't lower."""
+  nchunks = wd // JCHUNK
+  x = x_ref[:]                                  # [BH, h+2, (wd+2)*3]
+  rows = bh * h
+
+  # Conv as banded matmuls. Output column chunks are disjoint (only
+  # the dy row-shifts accumulate) — no scatter needed.
+  slabs = [x[:, dy:dy + h, :].reshape(rows, (wd + 2) * CIN)
+           for dy in range(3)]
+  chunks = []
+  for c in range(nchunks):
+    lo, hi = c * JCHUNK * CIN, (c * JCHUNK + JCHUNK + 2) * CIN
+    acc = jnp.dot(slabs[0][:, lo:hi], wb_ref[0],
+                  preferred_element_type=jnp.float32)
+    for dy in (1, 2):
+      acc += jnp.dot(slabs[dy][:, lo:hi], wb_ref[dy],
+                     preferred_element_type=jnp.float32)
+    chunks.append(acc)
+  y = jnp.concatenate(chunks, axis=1)           # [rows, wd*COUT] f32
+
+  neg = jnp.float32(-np.inf)
+  # --- Row pooling (window rows r..r+2) via sublane rolls + sample-
+  # boundary masks: roll -k brings row r+k to row r; rows past the
+  # sample's last conv row contribute -inf (XLA SAME pads below).
+  # pltpu.roll wants non-negative shifts; roll by size-k == roll -k.
+  row_in_sample = lax.broadcasted_iota(jnp.int32, y.shape, 0) % h
+  r1 = pltpu.roll(y, rows - 1, 0)
+  r2 = pltpu.roll(y, rows - 2, 0)
+  y = jnp.maximum(y, jnp.where(row_in_sample + 1 < h, r1, neg))
+  y = jnp.maximum(y, jnp.where(row_in_sample + 2 < h, r2, neg))
+
+  # --- Column pooling (cols 2j..2j+2) via lane rolls. Lane layout is
+  # [w, ch] interleaved (period COUT): col +1 = roll -COUT, col +2 =
+  # roll -2*COUT. The -2*COUT roll wraps for the last column block;
+  # mask those lanes (their col 2j+2 = wd is XLA's SAME pad).
+  lane = lax.broadcasted_iota(jnp.int32, y.shape, 1)
+  nlanes = wd * COUT
+  c1 = pltpu.roll(y, nlanes - COUT, 1)
+  c2 = pltpu.roll(y, nlanes - 2 * COUT, 1)
+  y = jnp.maximum(y, c1)   # valid for every SELECTED (even) column
+  y = jnp.maximum(y, jnp.where(lane < wd * COUT - 2 * COUT, c2, neg))
+
+  # --- Column compaction (keep blocks at even columns): one MXU pass
+  # against the 0/1 selection matrix — exact (one term per output).
+  out_ref[:] = jnp.dot(y.astype(jnp.bfloat16), sel_ref[:],
+                       preferred_element_type=jnp.float32).astype(
+                           jnp.bfloat16)
+
+
+def build_selection(wd):
+  """0/1 compaction matrix [wd*COUT, (wd//2)*COUT]: keep the COUT-lane
+  block of every EVEN column."""
+  wo = wd // 2
+  s = np.zeros((wd * COUT, wo * COUT), np.float32)
+  for k in range(wo):
+    for r in range(COUT):
+      s[2 * k * COUT + r, k * COUT + r] = 1.0
+  return jnp.asarray(s, jnp.bfloat16)
+
+
+def fused_conv_pool(frames, w, b, block=8):
+  """frames uint8 [N, H, W, 3] -> pooled bf16 [N, H/2, W/2, 16]."""
+  n, h, wd, _ = frames.shape
+  assert n % block == 0 and wd % JCHUNK == 0
+  # Host-side prep (XLA ops, fused/cheap): scale + halo pad + flatten.
+  x = frames.astype(jnp.bfloat16) / 255.0
+  x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+  x = x.reshape(n, h + 2, (wd + 2) * CIN)
+  wb = build_banded_weights(np.asarray(w, np.float32))
+  sel = build_selection(wd)
+  ho, wo = h // 2, wd // 2
+  out = pl.pallas_call(
+      functools.partial(_kernel, bh=block, h=h, wd=wd),
+      grid=(n // block,),
+      in_specs=[
+          pl.BlockSpec((block, h + 2, (wd + 2) * CIN),
+                       lambda i: (i, 0, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((3, KWIN, JCHUNK * COUT), lambda i: (0, 0, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((wd * COUT, wo * COUT), lambda i: (0, 0),
+                       memory_space=pltpu.VMEM),
+      ],
+      out_specs=pl.BlockSpec((block * h, wo * COUT), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+      out_shape=jax.ShapeDtypeStruct((n * h, wo * COUT), jnp.bfloat16),
+      interpret=SMOKE,
+  )(x, wb, sel)
+  # Row compaction (stride-2) outside the kernel: 2x-pooled-rows out,
+  # keep the even ones (Mosaic has no stride-2 vector ops in-kernel).
+  out = out.reshape(n, h, wo * COUT)[:, 0::2]
+  return out.reshape(n, ho, wo, COUT) + b.astype(jnp.bfloat16)
+
+
+def xla_conv_pool(frames, w, b):
+  x = frames.astype(jnp.bfloat16) / 255.0
+  y = lax.conv_general_dilated(
+      x, w, (1, 1), 'SAME', dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+  y = y + b
+  return lax.reduce_window(y, -jnp.inf, lax.max, (1, 3, 3, 1),
+                           (1, 2, 2, 1), 'SAME')
+
+
+def main():
+  n = 64 if SMOKE else 3232
+  h, wd = (24, 32) if SMOKE else (72, 96)
+  rng = np.random.RandomState(0)
+  frames = jnp.asarray(rng.randint(0, 255, (n, h, wd, 3)), jnp.uint8)
+  w = jax.random.normal(jax.random.PRNGKey(0), (3, 3, CIN, COUT),
+                        jnp.bfloat16) * 0.3
+  b = jnp.zeros((COUT,), jnp.bfloat16)
+
+  ref = xla_conv_pool(frames, w, b)
+  got = fused_conv_pool(frames, w, b)
+  err = float(jnp.max(jnp.abs(ref.astype(jnp.float32) -
+                              got.astype(jnp.float32))))
+  scale = float(jnp.max(jnp.abs(ref.astype(jnp.float32))))
+  print(json.dumps({'parity_max_abs_err': err, 'scale': scale}),
+        flush=True)
+  # Gate, not just telemetry (CI runs the SMOKE path): interpret mode
+  # reproduces XLA bit-for-bit; on the chip the f32-accumulate-then-
+  # round matmul differs from XLA's conv by bf16 rounding only
+  # (measured 0.004 relative), so a few bf16 ulps is the budget.
+  tol = 1e-6 if SMOKE else 0.02 * scale
+  assert err <= tol, f'fused conv+pool parity broke: {err} > {tol}'
+
+  if SMOKE:
+    return
+
+  def bench(fn, label):
+    jf = jax.jit(lambda f: fn(f, w, b))
+    out = jf(frames)
+    float(out.ravel()[0].astype(jnp.float32))
+    t0 = time.perf_counter()
+    for _ in range(20):
+      out = jf(frames)
+    float(out.ravel()[0].astype(jnp.float32))
+    dt = (time.perf_counter() - t0) / 20
+    c = jf.lower(frames).compile().cost_analysis()
+    if isinstance(c, list):
+      c = c[0]
+    print(json.dumps({label: {'ms': round(dt * 1e3, 2),
+                              'gb': round(c.get('bytes accessed', 0)
+                                          / 1e9, 2)}}), flush=True)
+
+  bench(xla_conv_pool, 'xla_fwd')
+  bench(fused_conv_pool, 'pallas_fwd')
+
+
+if __name__ == '__main__':
+  main()
