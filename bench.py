@@ -22,7 +22,11 @@ actor hosts — the reverse direction).
 vs_baseline: BASELINE.json's north star is >=200k env-frames/sec on a
 v5e-16 ⇒ 12,500 frames/sec/chip. vs_baseline = value / 12500.
 
-Prints ONE JSON line.
+Artifact protocol (round 6): the FULL result is written to
+BENCH_OUT.json (self-contained — the driver's tail capture used to
+clip the one giant JSON line mid-object, VERDICT r5 weak #1); stdout
+gets the full JSON line for humans, then a compact headline line LAST
+so a clipped tail still ends on one complete object.
 """
 
 import json
@@ -243,7 +247,8 @@ def bench_e2e(smoke):
     # the merge (min_batch) or a longer merge window (timeout) push
     # mean_batch toward 4/4 — and does fps follow or does the added
     # latency eat the gain? (paper Table 1's single-machine ~3×
-    # lever; the default row above is min_batch=1/timeout=20.)
+    # lever; since round 6 the default row above runs min_batch=0 =
+    # AUTO, i.e. the fleet-size floor this sweep motivated.)
     sweep = []
     for min_batch, timeout_ms in ((2, 20), (4, 60)):
       scfg = _e2e_window_config(
@@ -362,26 +367,47 @@ def bench_e2e_fed(smoke):
   # small-leaf readback could stop the clock before the dominant
   # transfer lands; a full-leaf np.asarray would add its own 66 MB D2H
   # to the timing. Residual error is bounded by the small leaves.
-  def h2d_once():
-    placed = jax.tree_util.tree_map(jax.device_put, stacked)
+  def place_and_barrier(batch):
+    placed = jax.tree_util.tree_map(jax.device_put, batch)
     biggest = max(jax.tree_util.tree_leaves(placed),
                   key=lambda x: x.nbytes)
-    float(biggest.ravel()[0].astype(np.float32))
+    return lambda: float(biggest.ravel()[0].astype(np.float32))
+
+  def h2d_once():
+    place_and_barrier(stacked)()
   h2d_once()  # warm path
   t0 = time.perf_counter()
   for _ in range(n_itemize):
     h2d_once()
   h2d_ms = (time.perf_counter() - t0) / n_itemize * 1e3
+  # Pipelined variant (round 6, staging_depth>=2): TWO transfers in
+  # flight, barriered together, amortized per batch. This is what the
+  # prefetcher's double-buffering actually issues; serial-vs-
+  # pipelined is the measured overlap win of the transfers
+  # themselves, independent of compute overlap (which the run's
+  # h2d_overlap_fraction summary below reports).
+  stacked2 = batch_unrolls(rows)  # distinct host buffers
+  t0 = time.perf_counter()
+  for _ in range(n_itemize):
+    barriers = [place_and_barrier(stacked), place_and_barrier(stacked2)]
+    for b in barriers:
+      b()
+  h2d_pipelined_ms = ((time.perf_counter() - t0) / n_itemize / 2) * 1e3
   return {
       'fps': round(fps, 1),
       'steady_secs': round(span, 1),
       'buffer_unrolls': last.get('buffer_unrolls', 0.0),
+      # Fraction of steps that never blocked on staging (driver
+      # summary; the ISSUE-1 acceptance counter).
+      'h2d_overlap_fraction': last.get('h2d_overlap_fraction', 0.0),
+      'staging_depth': cfg.staging_depth,
       'frames': int(run.frames),
       'batch_size': cfg.batch_size,
       'gap_itemization': {
           'batch_mb': round(batch_mb, 1),
           'stack_ms': round(stack_ms, 1),
           'h2d_ms': round(h2d_ms, 1),
+          'h2d_pipelined_ms': round(h2d_pipelined_ms, 1),
       },
   }
 
@@ -392,6 +418,80 @@ def _transport_unroll(t1, h, w, num_actions=9):
   from scalable_agent_tpu.testing import make_example_unroll
   return make_example_unroll(t1, h, w, num_actions,
                              MAX_INSTRUCTION_LEN)
+
+
+def _ingest_pump_child(port, smoke, validate, duration):
+  """Ingest-bench pump, run in a CHILD process (spawn): one actor
+  host's connection at full tilt. Exits 0 when the duration lapses or
+  the learner goes away (the parent tears the server down mid-pump)."""
+  import os as _os
+  _os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.models import ImpalaAgent
+  from scalable_agent_tpu.runtime import remote
+  t1 = 101 if not smoke else 6
+  h, w = (72, 96) if not smoke else (24, 32)
+  unroll = _transport_unroll(t1, h, w)
+  client = remote.RemoteActorClient(f'127.0.0.1:{port}',
+                                    connect_timeout_secs=30)
+  try:
+    if validate:
+      cfg = Config(env_backend='fake', num_actions=9,
+                   unroll_length=t1 - 1, height=h, width=w,
+                   use_instruction=False)
+      agent = ImpalaAgent(num_actions=9, use_instruction=False)
+      client.handshake(remote.trajectory_contract(cfg, agent, 9))
+    end = time.monotonic() + duration
+    while time.monotonic() < end:
+      client.send_unroll(unroll)
+  except (OSError, remote.LearnerShutdown):
+    pass  # parent closed the server: clean end of the window
+  finally:
+    client.close()
+
+
+def _fanout_fetch_child(port, duration, counter):
+  """Fan-out bench fetcher, run in a CHILD process: one actor host
+  polling get_params at full tilt (worst case — production clients
+  are version-gated), decoding each blob like a real host would."""
+  import os as _os
+  _os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+  from scalable_agent_tpu.runtime import remote
+  client = remote.RemoteActorClient(f'127.0.0.1:{port}',
+                                    connect_timeout_secs=30)
+  try:
+    end = time.monotonic() + duration
+    while time.monotonic() < end:
+      client.fetch_params()
+      counter.value += 1
+  except (OSError, RuntimeError, remote.LearnerShutdown):
+    pass  # parent closed the server: end of the window
+  finally:
+    client.close()
+
+
+def _fanout_pump_child(port, smoke, duration, counter, lat_queue):
+  """Fan-out bench unroll pump (the hot ingest path), run in a CHILD
+  process; ships per-send ack latencies back for the p50/p99 rows."""
+  import os as _os
+  _os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+  from scalable_agent_tpu.runtime import remote
+  t1 = 101 if not smoke else 6
+  h, w = (72, 96) if not smoke else (24, 32)
+  unroll = _transport_unroll(t1, h, w)
+  client = remote.RemoteActorClient(f'127.0.0.1:{port}',
+                                    connect_timeout_secs=30)
+  try:
+    end = time.monotonic() + duration
+    while time.monotonic() < end:
+      t0 = time.perf_counter()
+      client.send_unroll(unroll)
+      lat_queue.put(time.perf_counter() - t0)
+      counter.value += 1
+  except (OSError, RuntimeError, remote.LearnerShutdown):
+    pass  # parent closed the server: end of the window
+  finally:
+    client.close()
 
 
 def _count_window(count_fn, base, min_dur, min_count=8, max_dur=30.0):
@@ -513,13 +613,18 @@ def bench_transport(smoke):
     batcher_results[f'threads_{nthreads}'] = round(got / dt, 1)
   results['batcher_requests_per_sec'] = batcher_results
 
-  # --- (c) ingest loopback (pickle TCP wire), with the production
+  # --- (c) ingest loopback (tagged TCP wire), with the production
   # contract: the measured constant must include the handshake and the
   # per-unroll signature/action-range validation every real ingest
-  # pays (driver.train always passes a contract). ---
+  # pays (driver.train always passes a contract). Pumps run in CHILD
+  # PROCESSES (round 6): the real topology is actor HOSTS feeding the
+  # learner, so the measured quantity must be the learner-side ingest
+  # capacity — in-process pump threads shared the server's GIL and
+  # measured the bench's own client cost as much as the server (the
+  # r5 "4 connections lose to 1" was partly that artifact, partly the
+  # reader-thread critical path the worker-pool handoff removed). ---
   from scalable_agent_tpu.config import Config
   from scalable_agent_tpu.models import ImpalaAgent
-  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
   ingest_cfg = Config(env_backend='fake', num_actions=9,
                       unroll_length=t1 - 1, height=h, width=w,
                       use_instruction=False)
@@ -527,6 +632,8 @@ def bench_transport(smoke):
   contract = remote.trajectory_contract(ingest_cfg, ingest_agent, 9)
 
   def run_ingest(nclients, validate):
+    import multiprocessing
+    ctx = multiprocessing.get_context('spawn')
     buf = ring_buffer.TrajectoryBuffer(16)
     server = remote.TrajectoryIngestServer(
         buf, {'w': np.zeros(1)}, host='127.0.0.1',
@@ -542,49 +649,47 @@ def bench_transport(smoke):
 
     drainer = threading.Thread(target=drain, daemon=True)
     drainer.start()
-    counts = [0] * nclients
-    pump_errors = []
-
-    def pump(i):
-      client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
-                                        connect_timeout_secs=10)
-      try:
-        if validate:
-          client.handshake(contract)
-        while not stop_c.is_set():
-          client.send_unroll(unroll)
-          counts[i] += 1
-      except (OSError, RuntimeError, remote.LearnerShutdown) as e:
-        # Recorded, not swallowed: a rejection here (e.g. the example
-        # unroll drifting behind the contract) must not silently
-        # publish 0.0 rates into the scaling arithmetic.
-        pump_errors.append(e)
-      finally:
-        client.close()
-
-    pumps = [threading.Thread(target=pump, args=(i,), daemon=True)
-             for i in range(nclients)]
-    for t in pumps:
-      t.start()
-    time.sleep(0.3)  # warm/connect
-    base = sum(counts)
-    dt = _count_window(lambda: sum(counts), base, dur / 2)
-    got = sum(counts) - base
+    # Children pump for a fixed wall budget that comfortably covers
+    # their own startup plus the measuring window; the count is read
+    # on the SERVER side.
+    child_secs = dur * 2 + (30.0 if not smoke else 20.0)
+    pumps = [ctx.Process(target=_ingest_pump_child,
+                         args=(server.port, smoke, validate,
+                               child_secs), daemon=True)
+             for _ in range(nclients)]
+    for p in pumps:
+      p.start()
+    # Warm until every connection is live and feeding (child startup
+    # pays a jax import; do not let it eat the window).
+    deadline = time.perf_counter() + (60 if not smoke else 120)
+    while (server.stats()['unrolls'] < nclients
+           and time.perf_counter() < deadline):
+      if any(p.exitcode not in (None, 0) for p in pumps):
+        break
+      time.sleep(0.1)
+    base = server.stats()['unrolls']
+    dt = _count_window(lambda: server.stats()['unrolls'], base,
+                       dur / 2)
+    got = server.stats()['unrolls'] - base
+    server_stats = server.stats()
     stop_c.set()
-    for t in pumps:
-      t.join(timeout=3)
+    for p in pumps:
+      p.terminate()
+      p.join(timeout=10)
     server.close()
     buf.close()
     drainer.join(timeout=2)
     if got == 0:
       raise RuntimeError(
-          f'ingest bench moved no unrolls ({nclients} conns); first '
-          f'pump error: {pump_errors[0]!r}' if pump_errors else
-          f'ingest bench moved no unrolls ({nclients} conns), no '
-          'pump error recorded')
+          f'ingest bench moved no unrolls ({nclients} conns); child '
+          f'exitcodes: {[p.exitcode for p in pumps]}')
     return {
         'unrolls_per_sec': round(got / dt, 1),
         'mb_per_sec': round(got * unroll_mb / dt, 1),
+        # Server-side ack service time (recv-complete → ack-sent):
+        # the per-lane counter the driver also exports.
+        'ack_p50_ms': round(server_stats['ack_p50_ms'], 2),
+        'ack_p99_ms': round(server_stats['ack_p99_ms'], 2),
     }
 
   for nclients in ((1, 4) if not smoke else (1,)):
@@ -609,25 +714,29 @@ def bench_param_fanout(smoke):
   blob (deep ResNet + instruction encoder, the tree every dmlab30
   actor host fetches):
 
-  a) serving ceiling: N loopback clients looping get_params —
-     aggregate blobs/s and MB/s vs N. Clients unpickle on the SAME
-     core here, so this UNDERSTATES a real learner whose actor hosts
-     decode on their own CPUs; it is the per-core constant the PERF.md
-     arithmetic divides by, same methodology as the ingest stage.
+  a) serving ceiling: N loopback CHILD-PROCESS clients looping
+     get_params over the PARAM LANE (round 6: one selector thread,
+     chunked non-blocking sends, bf16 codec default, out-of-band
+     blob frames) — aggregate blobs/s and MB/s vs N. Clients decode
+     on their own processes, matching the actor-host topology; the
+     serving side is the per-core constant the PERF.md arithmetic
+     divides by, same methodology as the ingest stage.
   b) ack-latency impact: one unroll pump (the hot ingest path) alone
-     vs sharing the server with 8 param fetchers — the blob shares
-     each connection's request-reply channel and the pump's acks queue
-     behind 6.5 MB sendalls on the others.
+     vs sharing the server with 8 param fetchers. r5 measured the
+     shared-thread design collapsing the pump 838.6 → 29.9 unrolls/s
+     (ack p99 95.8 ms); the lane isolation is accepted or rejected on
+     this row.
   c) wire-shrink levers, measured one-off on the real blob: zlib-1
      compression (ratio + CPU cost) and a bfloat16 cast (exactly
-     halves the float32 payload) — PERF.md takes or rejects each with
-     these numbers.
+     halves the float32 payload) — the bf16 numbers justify the
+     publish_codec='bf16' default (docs/TRANSPORT.md).
   """
   import pickle
   import threading
   import zlib
   import numpy as np
   import jax
+  from scalable_agent_tpu.config import Config
   from scalable_agent_tpu.models import ImpalaAgent, init_params
   from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
   from scalable_agent_tpu.runtime import remote, ring_buffer
@@ -643,23 +752,31 @@ def bench_param_fanout(smoke):
   blob = pickle.dumps(('params', 1, params),
                       protocol=pickle.HIGHEST_PROTOCOL)
   blob_mb = len(blob) / 1e6
+  # The production default codec (config.publish_codec='bf16') is the
+  # measured configuration; the f32 blob size is kept for the ratio.
+  wire_dtype = Config().resolved_wire_dtype
   results = {
       'blob_mb': round(blob_mb, 2),
+      'wire_dtype': wire_dtype or 'float32',
       'num_params': int(sum(
           x.size for x in jax.tree_util.tree_leaves(params))),
   }
 
   def run_fanout(nfetchers, with_pump):
-    """nfetchers get_params loops (+ optionally one unroll pump) against
-    one server; returns (blobs/s, pump stats or None)."""
+    """nfetchers get_params loops (+ optionally one unroll pump)
+    against one server; returns (blobs/s, pump stats or None). Like
+    the ingest stage, the clients run in CHILD processes (round 6):
+    real actor hosts fetch and decode on their own CPUs, so the
+    measured quantity must be the learner-side serving/ack capacity,
+    not the bench's own in-process client decode sharing the server's
+    GIL."""
+    import multiprocessing
+    ctx = multiprocessing.get_context('spawn')
     buf = ring_buffer.TrajectoryBuffer(16)
     server = remote.TrajectoryIngestServer(buf, params,
-                                           host='127.0.0.1')
+                                           host='127.0.0.1',
+                                           wire_dtype=wire_dtype)
     stop = threading.Event()
-    fetch_counts = [0] * max(nfetchers, 1)
-    pump_count = [0]
-    pump_latencies = []
-    errors = []
 
     def drain():
       while not stop.is_set():
@@ -668,78 +785,91 @@ def bench_param_fanout(smoke):
         except (TimeoutError, ring_buffer.Closed):
           continue
 
-    def fetch(i):
-      client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
-                                        connect_timeout_secs=10)
-      try:
-        while not stop.is_set():
-          client.fetch_params()
-          fetch_counts[i] += 1
-      except (OSError, RuntimeError, remote.LearnerShutdown) as e:
-        errors.append(e)
-      finally:
-        client.close()
-
-    t1 = (101 if not smoke else 6)
-    unroll = _transport_unroll(t1, h, w)
-
-    def pump():
-      client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
-                                        connect_timeout_secs=10)
-      try:
-        while not stop.is_set():
-          t0 = time.perf_counter()
-          client.send_unroll(unroll)
-          pump_latencies.append(time.perf_counter() - t0)
-          pump_count[0] += 1
-      except (OSError, RuntimeError, remote.LearnerShutdown) as e:
-        errors.append(e)
-      finally:
-        client.close()
-
-    threads = [threading.Thread(target=drain, daemon=True)]
-    threads += [threading.Thread(target=fetch, args=(i,), daemon=True)
-                for i in range(nfetchers)]
+    drainer = threading.Thread(target=drain, daemon=True)
+    drainer.start()
+    child_secs = dur * 2 + (30.0 if not smoke else 20.0)
+    # One counter PER child (each Value has a single writer — a shared
+    # lock-free Value across N processes would lose increments to the
+    # non-atomic read-modify-write and understate the ceiling).
+    fetch_counts = [ctx.Value('q', 0, lock=False)
+                    for _ in range(nfetchers)]
+    pump_count = ctx.Value('q', 0, lock=False)
+    lat_queue = ctx.Queue()
+    procs = [ctx.Process(target=_fanout_fetch_child,
+                         args=(server.port, child_secs,
+                               fetch_counts[i]), daemon=True)
+             for i in range(nfetchers)]
     if with_pump:
-      threads.append(threading.Thread(target=pump, daemon=True))
-    for t in threads:
-      t.start()
-    time.sleep(0.5)  # warm/connect
-    fetch_base, pump_base = sum(fetch_counts), pump_count[0]
-    lat_base = len(pump_latencies)
+      procs.append(ctx.Process(
+          target=_fanout_pump_child,
+          args=(server.port, smoke, child_secs, pump_count,
+                lat_queue), daemon=True))
+    for p in procs:
+      p.start()
+
+    pump_latencies = []
+
+    def drain_latencies():
+      while True:
+        try:
+          pump_latencies.append(lat_queue.get(timeout=0.1))
+        except Exception:
+          if stop.is_set():
+            return
+
+    lat_drainer = threading.Thread(target=drain_latencies, daemon=True)
+    lat_drainer.start()
+
+    def total_fetched():
+      return sum(c.value for c in fetch_counts)
 
     def progress():
       vals = []
       if nfetchers:
-        vals.append(sum(fetch_counts) - fetch_base)
+        vals.append(total_fetched() - fetch_base)
       if with_pump:
-        vals.append(pump_count[0] - pump_base)
+        vals.append(pump_count.value - pump_base)
       return min(vals) if vals else 1 << 30
 
+    # Warm until every role is live (children pay a jax import).
+    deadline = time.perf_counter() + (60 if not smoke else 120)
+    while time.perf_counter() < deadline:
+      if ((not nfetchers or total_fetched() > 0)
+          and (not with_pump or pump_count.value > 0)):
+        break
+      if any(p.exitcode not in (None, 0) for p in procs):
+        break
+      time.sleep(0.1)
+    fetch_base, pump_base = total_fetched(), pump_count.value
+    lat_base = len(pump_latencies)
     dt = _count_window(progress, 0, dur / 2)
-    fetched = sum(fetch_counts) - fetch_base
-    pumped = pump_count[0] - pump_base
+    fetched = total_fetched() - fetch_base
+    pumped = pump_count.value - pump_base
     window_lat = sorted(pump_latencies[lat_base:])
+    # MB/s must count the bytes actually on the wire (bf16 codec
+    # halves the f32 pickle this stage used to multiply by).
+    wire_mb = server.snapshot_nbytes() / 1e6
+    results.setdefault('wire_blob_mb', round(wire_mb, 2))
     stop.set()
-    for t in threads[1:]:
-      t.join(timeout=5)
+    for p in procs:
+      p.terminate()
+      p.join(timeout=10)
     server.close()
     buf.close()
-    threads[0].join(timeout=2)
+    drainer.join(timeout=2)
+    lat_drainer.join(timeout=2)
     if nfetchers and fetched == 0:
       raise RuntimeError(
           f'param fan-out moved no blobs ({nfetchers} fetchers); '
-          f'first error: {errors[0]!r}' if errors else
-          f'param fan-out moved no blobs ({nfetchers} fetchers)')
+          f'child exitcodes: {[p.exitcode for p in procs]}')
     if with_pump and pumped == 0:
       # Same no-silent-zero rule as the ingest stage: a dead pump must
       # fail the bench, not publish a null latency row.
       raise RuntimeError(
-          f'fan-out pump moved no unrolls; first error: '
-          f'{errors[0]!r}' if errors else
-          'fan-out pump moved no unrolls, no error recorded')
+          f'fan-out pump moved no unrolls; child exitcodes: '
+          f'{[p.exitcode for p in procs]}')
     fanout = {'blobs_per_sec': round(fetched / dt, 1),
-              'mb_per_sec': round(fetched * blob_mb / dt, 1)}
+              'mb_per_sec': round(fetched * wire_mb / dt, 1)}
     pump_stats = None
     if with_pump and window_lat:
       pump_stats = {
@@ -872,7 +1002,54 @@ def main():
     out['param_fanout'] = fanout
   if anakin is not None:
     out['anakin'] = anakin
+  _emit(out)
+
+
+def _headline(out):
+  """The compact last line: the handful of gate numbers a clipped tail
+  must still carry (VERDICT r5 weak #1 — the full JSON line got cut
+  mid-object by the driver's tail capture)."""
+  head = {
+      'metric': out['metric'],
+      'value': out['value'],
+      'vs_baseline': out['vs_baseline'],
+      'artifact': 'BENCH_OUT.json',
+  }
+  fed = out.get('e2e_fed')
+  if fed:
+    head['e2e_fed_fps'] = fed['fps']
+    head['h2d_overlap_fraction'] = fed.get('h2d_overlap_fraction')
+  transport = out.get('transport')
+  if transport:
+    head['ingest_1conn'] = transport['ingest_1conn']['unrolls_per_sec']
+    if 'ingest_4conn' in transport:
+      head['ingest_4conn'] = (
+          transport['ingest_4conn']['unrolls_per_sec'])
+  fanout = out.get('param_fanout')
+  if fanout:
+    for key, value in fanout.items():
+      if key.startswith('pump_with_') and value:
+        head['pump_contended_unrolls_per_sec'] = (
+            value['unrolls_per_sec'])
+        head['pump_contended_ack_p99_ms'] = value['ack_p99_ms']
+    if fanout.get('pump_alone'):
+      head['pump_alone_unrolls_per_sec'] = (
+          fanout['pump_alone']['unrolls_per_sec'])
+  return head
+
+
+def _emit(out, path=None):
+  """Self-contained artifact protocol: write the FULL result to
+  BENCH_OUT.json, print the full JSON line (for humans tailing the
+  log), then print the compact headline LAST so the driver's tail
+  capture always ends on one complete, parseable object."""
+  if path is None:
+    path = os.environ.get('BENCH_OUT', os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), 'BENCH_OUT.json'))
+  with open(path, 'w') as f:
+    json.dump(out, f, indent=1, sort_keys=True)
   print(json.dumps(out))
+  print(json.dumps(_headline(out)), flush=True)
 
 
 if __name__ == '__main__':

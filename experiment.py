@@ -54,9 +54,8 @@ flags.DEFINE_string('remote_actor_bind_host',
                     'never expose the port publicly.')
 flags.DEFINE_string('remote_params_dtype',
                     _DEFAULTS.remote_params_dtype,
-                    "Wire dtype for served param snapshots: '' exact "
-                    "float32, 'bfloat16' halves the learner's weight "
-                    'egress (actors upcast on receipt).')
+                    'LEGACY spelling of --publish_codec: \'\' defers '
+                    "to the codec, 'bfloat16' forces the bf16 cast.")
 flags.DEFINE_float('remote_publish_secs',
                    _DEFAULTS.remote_publish_secs,
                    'Min seconds between param snapshots published to '
@@ -191,6 +190,19 @@ flags.DEFINE_integer('queue_capacity_batches',
                      'Trajectory buffer capacity in batches '
                      '(reference FIFOQueue capacity=1; small keeps '
                      'policy lag bounded).')
+flags.DEFINE_integer('staging_depth', _DEFAULTS.staging_depth,
+                     'Staged device batches in flight (prefetcher '
+                     'depth): 2 overlaps consecutive host-to-device '
+                     'transfers with the step; each extra slot adds '
+                     'one batch of policy lag.')
+flags.DEFINE_enum('publish_codec', _DEFAULTS.publish_codec,
+                  ['bf16', 'f32'],
+                  'Wire codec for served param snapshots: bf16 '
+                  '(default) halves learner weight egress, actors '
+                  'upcast on receipt; f32 ships exact float32.')
+flags.DEFINE_integer('ingest_workers', _DEFAULTS.ingest_workers,
+                     'Validate/commit workers behind the remote-'
+                     'ingest reader threads (0 = auto).')
 flags.DEFINE_string('profile_dir', _DEFAULTS.profile_dir,
                     'Capture a jax.profiler trace of a few learner '
                     'steps into this directory.')

@@ -100,13 +100,25 @@ class Config:
   profile_dir: str = ''
   profile_start_step: int = 20            # past warmup/compile
   profile_num_steps: int = 5
-  # Inference batching (reference dynamic_batching defaults, ≈2.9).
-  inference_min_batch: int = 1            # 0 = auto (fleet-size floor)
+  # Inference batching (reference dynamic_batching ≈2.9). min_batch 0
+  # = AUTO: floor the merge at the fleet size so every call carries
+  # the whole fleet (r5 sweep: min_batch=4/t60 measured 201.7 e2e fps
+  # vs 146.4 at min_batch=1 — docs/PERF.md). Auto is the default
+  # since round 6; evaluate() opts out (retiring levels would turn
+  # the floor into one batcher-timeout per tail batch). Set an
+  # explicit value to pin the floor by hand.
+  inference_min_batch: int = 0
   inference_max_batch: int = 1024
   inference_timeout_ms: int = 100
   # Ring buffer capacity in batches (reference FIFOQueue capacity=1 +
   # StagingArea double buffer ⇒ bounded policy lag; keep it small).
   queue_capacity_batches: int = 1
+  # Staged device batches in flight (BatchPrefetcher depth — the
+  # StagingArea role). 2 double-buffers jax.device_put against the
+  # (sharded) step so consecutive H2D transfers overlap each other
+  # and the compute (BENCH_r05: h2d_ms 1430.5 dominated the fed-loop
+  # gap). Each extra slot extends the policy-lag bound by one batch.
+  staging_depth: int = 2
   # Remote actors (reference --job_name=actor gRPC topology, SURVEY
   # §3.4): learner listens on this port for actor-host connections
   # (0 = disabled); actor hosts point learner_address at it.
@@ -122,22 +134,48 @@ class Config:
   # Min seconds between param snapshots published to remote hosts (a
   # publish is a full device_get; remote staleness ~ this value).
   remote_publish_secs: float = 2.0
-  # Wire dtype for served param snapshots: '' ships exact float32;
-  # 'bfloat16' casts float32 leaves for the wire (the actor host
-  # upcasts back) — exactly halves the dominant term of learner
-  # egress (hosts x blob_bytes / remote_publish_secs; docs/PERF.md
-  # "Param-snapshot egress") at a measured ~ms cast cost. Acting
-  # tolerates the ~3 decimal digits of mantissa (inference already
-  # runs bfloat16 compute); training state is never touched.
+  # Publish codec for served param snapshots: 'bf16' (default) casts
+  # float32 leaves for the wire (the actor host upcasts back) —
+  # exactly halves the dominant term of learner egress
+  # (hosts x blob_bytes / remote_publish_secs) at a measured ~5 ms
+  # cast cost vs zlib-1's 209 ms for a 0.926 ratio (BENCH_r05;
+  # docs/TRANSPORT.md). Acting tolerates the ~3 decimal digits of
+  # mantissa (inference already runs bfloat16 compute); training
+  # state is never touched. 'f32' opts out and ships exact float32.
+  publish_codec: str = 'bf16'
+  # LEGACY spelling of the same knob (pre-round-6): '' defers to
+  # publish_codec; 'bfloat16' forces the cast regardless of codec.
   remote_params_dtype: str = ''
   # Actor-host elasticity: on disconnect, keep retrying the learner
   # for this many seconds (surviving a learner restart-from-
   # checkpoint) instead of exiting. 0 = exit on disconnect.
   actor_reconnect_secs: float = 0.0
+  # Validate/commit workers draining the ingest readers' handoff
+  # queue (runtime/remote.py — validation, the backpressure put and
+  # the ack run here, off the per-connection reader threads).
+  # 0 = auto (min(4, cpu count)).
+  ingest_workers: int = 0
 
   @property
   def frames_per_step(self):
     return self.batch_size * self.unroll_length * self.num_action_repeats
+
+  @property
+  def resolved_wire_dtype(self) -> str:
+    """The ingest server's wire_dtype from the codec knobs: the
+    legacy `remote_params_dtype` (non-empty) wins, else
+    `publish_codec` ('bf16' → 'bfloat16', 'f32' → exact float32).
+    Resolved here so the driver, the remote-actor role, and bench.py
+    can never disagree on the production default."""
+    if self.remote_params_dtype:
+      return self.remote_params_dtype
+    if self.publish_codec == 'bf16':
+      return 'bfloat16'
+    if self.publish_codec == 'f32':
+      return ''
+    raise ValueError(
+        f"publish_codec must be 'bf16' or 'f32', got "
+        f'{self.publish_codec!r}')
 
   @property
   def resolved_use_instruction(self) -> bool:

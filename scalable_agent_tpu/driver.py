@@ -331,7 +331,8 @@ def train(config: Config, max_steps: Optional[int] = None,
           port=config.remote_actor_port,
           contract=remote.trajectory_contract(config, agent,
                                               num_actions),
-          wire_dtype=config.remote_params_dtype)
+          wire_dtype=config.resolved_wire_dtype,
+          ingest_workers=config.ingest_workers)
       log.info('remote-actor ingest listening on port %d', ingest.port)
     # --- Inference server (weights served host-side to actor
     # threads). Per-process seed offset: params/init use config.seed
@@ -384,7 +385,8 @@ def train(config: Config, max_steps: Optional[int] = None,
       return stats_view, action_counts, place_fn(host_batch)
 
     prefetcher = ring_buffer.BatchPrefetcher(
-        buffer, local_batch_size, place_fn=stage)
+        buffer, local_batch_size, place_fn=stage,
+        depth=config.staging_depth)
 
     # Multi-host: every host logs its OWN fleet's stream; process 0
     # keeps the canonical filename (shared logdirs must not interleave
@@ -444,6 +446,8 @@ def train(config: Config, max_steps: Optional[int] = None,
   action_counts_acc = np.zeros((num_actions,), np.int64)
   last_remote_publish = float('-inf')
   last_inference_snap = {'calls': 0, 'requests': 0}
+  last_ingest_snap = {'unrolls': 0, 'per_conn_unrolls': {}}
+  last_ingest_time = time.monotonic()
   loop_start = time.monotonic()
   last_summary = time.monotonic()
   last_batch_time = time.monotonic()
@@ -566,6 +570,14 @@ def train(config: Config, max_steps: Optional[int] = None,
         # late policy collapse).
         writer.histogram('actions', action_counts_acc, step_now)
         action_counts_acc = np.zeros_like(action_counts_acc)
+        # Staging overlap (round 6): fraction of steps that did NOT
+        # block on the prefetcher — the H2D-hidden-behind-compute
+        # gate (read with buffer_unrolls: ≈0 there means the wait is
+        # starvation upstream of staging, not transfer).
+        pf = prefetcher.stats()
+        writer.scalar('h2d_overlap_fraction',
+                      pf['h2d_overlap_fraction'], step_now)
+        writer.scalar('staged_batches', pf['staged_batches'], step_now)
         if ingest is not None:
           ing = ingest.stats()
           writer.scalar('remote_unrolls', ing['unrolls'], step_now)
@@ -575,6 +587,32 @@ def train(config: Config, max_steps: Optional[int] = None,
           # decides severity), so without this counter a host whose
           # every unroll is being refused is invisible here.
           writer.scalar('remote_rejected', ing['rejected'], step_now)
+          # Per-lane transport counters (round 6). Ack latency is the
+          # end-to-end backpressure signal remote pumps feel; the
+          # per-connection rate spread separates one starved host
+          # from a uniformly slow fleet.
+          writer.scalar('remote_ack_p50_ms', ing['ack_p50_ms'],
+                        step_now)
+          writer.scalar('remote_ack_p99_ms', ing['ack_p99_ms'],
+                        step_now)
+          writer.scalar('remote_param_blobs', ing['param_blobs'],
+                        step_now)
+          dt_summary = now - last_ingest_time
+          d_unrolls = ing['unrolls'] - last_ingest_snap['unrolls']
+          writer.scalar('remote_unrolls_per_sec',
+                        d_unrolls / dt_summary if dt_summary else 0.0,
+                        step_now)
+          per_conn = ing['per_conn_unrolls']
+          prev_conn = last_ingest_snap['per_conn_unrolls']
+          rates = [(per_conn[k] - prev_conn.get(k, 0)) / dt_summary
+                   for k in per_conn] if dt_summary else []
+          if rates:
+            writer.scalar('remote_conn_unrolls_per_sec_min',
+                          min(rates), step_now)
+            writer.scalar('remote_conn_unrolls_per_sec_max',
+                          max(rates), step_now)
+          last_ingest_snap = ing
+          last_ingest_time = now
       # Checkpoint cadence: Orbax saves are collective across hosts;
       # clocks differ, so all hosts act on PROCESS 0's decision (a
       # host-local clock here would desync the barrier and deadlock).

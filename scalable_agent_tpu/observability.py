@@ -111,6 +111,44 @@ class FpsMeter:
     return sum(delta for _, delta in self._events) / span
 
 
+class LatencyReservoir:
+  """Bounded recent-sample reservoir for latency percentiles
+  (thread-safe) — the per-lane transport counters' backing store
+  (round 6): the ingest server records one ack service time per
+  unroll and the driver/bench read p50/p99 from here.
+
+  A deque of the most recent `maxlen` samples keeps memory O(1) over
+  unbounded runs while staying faithful to the CURRENT operating
+  point — a cumulative aggregate would average away a regression that
+  starts late in a long run (same rationale as the per-interval merge
+  telemetry in driver.train)."""
+
+  def __init__(self, maxlen: int = 4096):
+    self._samples = collections.deque(maxlen=maxlen)
+    self._lock = threading.Lock()
+    self._count = 0
+
+  def record(self, seconds: float):
+    with self._lock:
+      self._samples.append(float(seconds))
+      self._count += 1
+
+  @property
+  def count(self) -> int:
+    with self._lock:
+      return self._count
+
+  def percentiles(self, *qs: float) -> Tuple[float, ...]:
+    """Sample percentiles over the retained window; 0.0 when empty
+    (callers treat 'no traffic yet' as a zero row, not an error)."""
+    with self._lock:
+      snap = sorted(self._samples)
+    if not snap:
+      return tuple(0.0 for _ in qs)
+    last = len(snap) - 1
+    return tuple(snap[min(last, int(round(q * last)))] for q in qs)
+
+
 def extract_episodes(batch) -> List[Tuple[int, float, int]]:
   """Finished episodes in a dequeued [T+1, B] batch.
 

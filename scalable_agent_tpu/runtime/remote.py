@@ -29,11 +29,32 @@ processes"):
   explicit snapshot protocol, with the same staleness story (actions
   within one unroll may span weight versions).
 
-Wire protocol: length-prefixed pickled messages over one TCP connection
-per actor process, strict request→reply lockstep (no concurrent writes
-per socket). Backpressure is end-to-end: a full learner buffer blocks
-the server's `put`, which delays the ack, which blocks the actor's pump
-— the reference's capacity-1 remote enqueue semantics.
+Wire protocol: length-prefixed pickled messages over TCP, strict
+request→reply lockstep per socket (no concurrent writes per socket).
+Backpressure is end-to-end: a full learner buffer blocks the ingest
+worker's `put`, which delays the ack, which blocks the actor's pump —
+the reference's capacity-1 remote enqueue semantics.
+
+Transport planes (round 6 — BENCH_r05 measured all three pathologies):
+
+- **Trajectory lane** (the hot path): one reader thread per connection
+  does ONLY recv+parse and hands the unroll to a small validate/commit
+  worker pool via a GIL-atomic queue; the worker validates, lands the
+  unroll in the shared buffer (backpressure lives here) and sends the
+  ack. Readers never touch the buffer lock, so N connections scale by
+  overlapping socket copies instead of fighting over one
+  recv→validate→put→ack critical path (r5: 4 connections measured
+  SLOWER than 1).
+- **Param lane** (weight fan-out): subscribers open a SECOND
+  connection (`hello_params`) served by one selector thread with
+  chunked non-blocking sends. r5 measured 8 polling fetchers
+  collapsing the unroll pump 838.6 → 29.9 unrolls/s (ack p99 1.18 →
+  95.8 ms): 8 handler threads each mid-sendall of a 6.5 MB blob
+  starve the tiny acks. One multiplexing thread writing bounded
+  chunks caps the blob plane at one runnable thread regardless of
+  subscriber count. Snapshots ship bf16-cast by default
+  (config.publish_codec; measured ratio 0.5 for ~5 ms vs zlib-1's
+  0.926 for 209 ms).
 
 Trust model: pickle over cluster-internal sockets — identical trust to
 the reference's unauthenticated TF gRPC runtime. Never expose the
@@ -41,12 +62,17 @@ ingest port outside the job's network.
 """
 
 import logging
+import os
 import pickle
+import queue
+import selectors
 import socket
 import struct
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+from scalable_agent_tpu.observability import LatencyReservoir
 
 import numpy as np
 
@@ -80,11 +106,20 @@ def _send_msg(sock: socket.socket, obj) -> None:
                + payload)
 
 
-def _send_oob(sock: socket.socket, obj) -> None:
-  """Ship `obj` with its array buffers OUT of the pickle stream: the
-  skeleton + per-buffer lengths go in the frame head, then each raw
-  buffer is sent directly (sendall on the memoryview — no 2 MB join,
-  no pickler copy). The receiver reconstructs with zero-copy views."""
+# Buffers at or below this coalesce into one sendall with their
+# neighbors: an unroll carries ~11 OOB buffers of which only the
+# frame stack is big, and a syscall per 400-byte reward array costs
+# more than copying it (round 6 — the per-message syscall count was
+# one of the two costs keeping multi-connection ingest from scaling).
+_OOB_COALESCE = 128 * 1024
+
+
+def _oob_frame_segments(obj) -> List:
+  """The complete OOB wire frame for `obj`, as segments ready for
+  sendall: [head (length prefix + tag + meta + skeleton + buffer
+  table), raw buffer memoryview, ...]. The ONE place the OOB frame
+  layout is built — `_send_oob` streams these per message, the ingest
+  server caches them per published param version."""
   buffers = []
   skeleton = pickle.dumps(obj, protocol=5,
                           buffer_callback=buffers.append)
@@ -92,11 +127,38 @@ def _send_oob(sock: socket.socket, obj) -> None:
   lens = b''.join(_OOB_BUFLEN.pack(r.nbytes) for r in raws)
   total = (1 + _OOB_META.size + len(skeleton) + len(lens)
            + sum(r.nbytes for r in raws))
-  sock.sendall(_LEN.pack(total) + bytes((_FRAME_OOB,))
-               + _OOB_META.pack(len(raws), len(skeleton))
-               + skeleton + lens)
-  for raw in raws:
-    sock.sendall(raw)
+  head = (_LEN.pack(total) + bytes((_FRAME_OOB,))
+          + _OOB_META.pack(len(raws), len(skeleton))
+          + skeleton + lens)
+  return [head] + raws
+
+
+def _send_oob(sock: socket.socket, obj) -> None:
+  """Ship `obj` with its array buffers OUT of the pickle stream: the
+  skeleton + per-buffer lengths go in the frame head, then each raw
+  buffer is sent directly (no pickler copy). Small adjacent buffers
+  coalesce into one sendall (`_OOB_COALESCE`); big ones go as bare
+  sendalls on their memoryview — no 2 MB join. The receiver
+  reconstructs with zero-copy views."""
+  segments = _oob_frame_segments(obj)
+  pending = [segments[0]]
+
+  def flush():
+    if not pending:
+      return
+    sock.sendall(pending[0] if len(pending) == 1
+                 else b''.join(pending))
+    pending.clear()
+
+  for raw in segments[1:]:
+    if raw.nbytes <= _OOB_COALESCE:
+      pending.append(raw)
+      if sum(len(p) for p in pending) > _OOB_COALESCE:
+        flush()
+    else:
+      flush()
+      sock.sendall(raw)
+  flush()
 
 
 def _recv_exact(sock: socket.socket, n: int):
@@ -114,35 +176,65 @@ def _recv_exact(sock: socket.socket, n: int):
 
 
 def _recv_msg(sock: socket.socket):
-  """One message (either frame kind), or None on clean EOF."""
+  """One message (either frame kind), or None on clean EOF.
+
+  OOB frames recv each array buffer straight into its own
+  UNINITIALIZED storage (np.empty + recv_into): one 2.11 MB unroll
+  used to land in a zero-filled bytearray first — ~95 µs of memset
+  holding the GIL per message, one of the two per-message costs that
+  kept multi-connection ingest from scaling (round 6)."""
   header = _recv_exact(sock, _LEN.size)
   if header is None:
     return None
   (length,) = _LEN.unpack(header)
   if length > _MAX_MSG:
     raise ValueError(f'message length {length} exceeds bound')
-  payload = _recv_exact(sock, length)
-  if payload is None:
+  tag = _recv_exact(sock, 1)
+  if tag is None:
     raise ConnectionError('EOF mid-message')
-  kind = payload[0]
-  view = memoryview(payload)
+  kind = tag[0]
   if kind == _FRAME_PLAIN:
-    return pickle.loads(view[1:])
+    payload = _recv_exact(sock, length - 1)
+    if payload is None:
+      raise ConnectionError('EOF mid-message')
+    return pickle.loads(memoryview(payload))
   if kind == _FRAME_OOB:
-    nbufs, skel_len = _OOB_META.unpack_from(view, 1)
-    off = 1 + _OOB_META.size
-    skeleton = view[off:off + skel_len]
-    off += skel_len
-    sizes = [_OOB_BUFLEN.unpack_from(view, off + _OOB_BUFLEN.size * i)[0]
+    head_len = _OOB_META.size
+    head = _recv_exact(sock, head_len)
+    if head is None:
+      raise ConnectionError('EOF mid-message')
+    nbufs, skel_len = _OOB_META.unpack(head)
+    # Bound the header-derived sizes by the ALREADY-validated frame
+    # length BEFORE allocating or recv'ing anything sized by them: a
+    # corrupt peer can put 2^32-1 in either meta field independently
+    # of `length`, and the consistency check below runs too late to
+    # stop a ~38 GB table allocation.
+    if 1 + head_len + skel_len + _OOB_BUFLEN.size * nbufs > length:
+      raise ValueError(
+          f'OOB header inconsistent with frame length {length}: '
+          f'{nbufs} buffers, skeleton {skel_len}')
+    table = _recv_exact(sock, skel_len + _OOB_BUFLEN.size * nbufs)
+    if table is None:
+      raise ConnectionError('EOF mid-message')
+    view = memoryview(table)
+    skeleton = view[:skel_len]
+    sizes = [_OOB_BUFLEN.unpack_from(view,
+                                     skel_len + _OOB_BUFLEN.size * i)[0]
              for i in range(nbufs)]
-    off += _OOB_BUFLEN.size * nbufs
+    consumed = (1 + head_len + len(table) + sum(sizes))
+    if consumed != length:
+      raise ValueError(
+          f'OOB frame length mismatch: parsed {consumed} of {length}')
     buffers = []
     for size in sizes:
-      buffers.append(view[off:off + size])
-      off += size
-    if off != length:
-      raise ValueError(
-          f'OOB frame length mismatch: parsed {off} of {length}')
+      buf = memoryview(np.empty(int(size), np.uint8))
+      got = 0
+      while got < size:
+        r = sock.recv_into(buf[got:])
+        if r == 0:
+          raise ConnectionError('EOF mid-message')
+        got += r
+      buffers.append(buf)
     return pickle.loads(skeleton, buffers=buffers)
   raise ValueError(f'unknown frame kind {kind}')
 
@@ -170,7 +262,12 @@ class ProtocolError(RuntimeError):
 # v4: tagged frames — unrolls ship as pickle-5 skeleton + out-of-band
 # raw buffers instead of one inline pickle (~530 µs/unroll of pure
 # copying removed from the hot ingest path).
-PROTOCOL_VERSION = 4
+# v5: the param lane — clients fetch weight snapshots over a SECOND
+# connection opened with 'hello_params' (served by the chunked
+# non-blocking publisher, isolating blob traffic from unroll acks);
+# 'get_params' on the trajectory lane stays answered for the
+# handshake and protocol-level tests.
+PROTOCOL_VERSION = 5
 
 
 def _is_signature_leaf(x) -> bool:
@@ -401,23 +498,35 @@ class FastUnrollValidator:
 
 
 class _Conn:
-  """One actor connection: socket + send lock (the handler thread and
+  """One actor connection: socket + send lock (worker threads and
   close()'s 'bye' frame must not interleave writes mid-message)."""
 
-  def __init__(self, sock: socket.socket):
+  def __init__(self, sock: socket.socket, addr=None):
     self.sock = sock
+    self.addr = addr
     self.send_lock = threading.Lock()
+    # Per-connection ingest ledger (observability: the driver reports
+    # unrolls/sec per connection from deltas of these).
+    self.unrolls = 0
 
   def send(self, obj) -> None:
     with self.send_lock:
       _send_msg(self.sock, obj)
 
   def send_bytes(self, payload: bytes) -> None:
-    """Ship pre-serialized bytes (the cached param blob): handler
+    """Ship pre-serialized bytes (a cached plain frame): handler
     threads must not re-pickle the whole tree per request."""
     with self.send_lock:
       self.sock.sendall(_LEN.pack(len(payload) + 1)
                         + bytes((_FRAME_PLAIN,)) + payload)
+
+  def send_segments(self, segments) -> None:
+    """Ship a pre-built wire frame as its segments (the cached param
+    snapshot frame: head + raw buffers) without joining them into one
+    giant bytes object first."""
+    with self.send_lock:
+      for seg in segments:
+        self.sock.sendall(seg)
 
   def try_send(self, obj, timeout: float = 2.0) -> bool:
     """Bounded best-effort send: never blocks shutdown behind a stuck
@@ -439,6 +548,206 @@ class _Conn:
       self.send_lock.release()
 
 
+class _ParamLane:
+  """The weight fan-out plane: every `hello_params` subscriber socket,
+  multiplexed by ONE selector thread with chunked non-blocking sends.
+
+  Why not a thread per subscriber (the r5 design): 8 polling fetchers
+  measured the unroll pump at 29.9 unrolls/s against 838.6 alone (ack
+  p99 1.18 → 95.8 ms) — each fetch handler monopolizes the core in
+  blob-sized `sendall` slices and the tiny acks queue behind up to 8
+  of them. Here each ready subscriber advances at most `chunk_bytes`
+  per poll round, so the blob plane is one runnable thread with
+  bounded GIL holds no matter how many hosts subscribe, and the
+  trajectory lane's acks never wait behind a blob mid-send.
+
+  Requests are tiny (`get_params` frames); replies are the server's
+  cached per-version blob — the lane never pickles, it only slices
+  memoryviews of bytes the publisher already built.
+  """
+
+  def __init__(self, blob_fn, chunk_bytes: int = 128 * 1024):
+    self._blob_fn = blob_fn  # () -> cached COMPLETE frame segments
+    self._chunk = chunk_bytes
+    self._selector = selectors.DefaultSelector()
+    self._lock = threading.Lock()  # guards adopt vs close
+    self._closed = False
+    self._blobs_served = 0
+    self._bytes_sent = 0
+    # Self-pipe: adopt()/close() must wake a parked select().
+    self._wake_r, self._wake_w = socket.socketpair()
+    self._wake_r.setblocking(False)
+    self._selector.register(self._wake_r, selectors.EVENT_READ, None)
+    self._pending_adopts: List[socket.socket] = []
+    self._thread = threading.Thread(target=self._loop,
+                                    name='param-lane', daemon=True)
+    self._thread.start()
+
+  class _Sub:
+    """Per-subscriber state: request parse buffer + outgoing chunks."""
+
+    def __init__(self, sock):
+      self.sock = sock
+      self.rbuf = bytearray()
+      self.out: List[memoryview] = []  # remaining reply bytes
+
+  def adopt(self, sock: socket.socket) -> bool:
+    """Hand a connected socket to the lane (called from the accept
+    handler once the peer said 'hello_params'). False if closing."""
+    with self._lock:
+      if self._closed:
+        return False
+      self._pending_adopts.append(sock)
+    try:
+      self._wake_w.send(b'x')
+    except OSError:
+      pass
+    return True
+
+  def stats(self):
+    with self._lock:
+      return {'blobs': self._blobs_served, 'bytes': self._bytes_sent}
+
+  def _drop(self, sub):
+    try:
+      self._selector.unregister(sub.sock)
+    except (KeyError, ValueError):
+      pass
+    sub.sock.close()
+
+  def _queue_segments(self, sub, segments):
+    """Queue a pre-built wire frame (its segments verbatim)."""
+    sub.out.extend(memoryview(s) for s in segments)
+    self._selector.modify(sub.sock,
+                          selectors.EVENT_READ | selectors.EVENT_WRITE,
+                          sub)
+
+  def _queue_reply(self, sub, payload: bytes):
+    header = _LEN.pack(len(payload) + 1) + bytes((_FRAME_PLAIN,))
+    self._queue_segments(sub, (header, payload))
+
+  def _on_readable(self, sub) -> bool:
+    """Drain request bytes; False = connection is gone."""
+    try:
+      data = sub.sock.recv(4096)
+    except BlockingIOError:
+      return True
+    except OSError:
+      return False
+    if not data:
+      return False
+    sub.rbuf += data
+    while True:
+      if len(sub.rbuf) < _LEN.size:
+        return True
+      (length,) = _LEN.unpack_from(sub.rbuf)
+      if length > 1 << 20:  # param requests are tiny frames
+        log.warning('param lane: oversized request frame (%d bytes); '
+                    'dropping subscriber', length)
+        return False
+      if len(sub.rbuf) < _LEN.size + length:
+        return True
+      frame = bytes(sub.rbuf[_LEN.size:_LEN.size + length])
+      del sub.rbuf[:_LEN.size + length]
+      try:
+        if frame[0] != _FRAME_PLAIN:
+          raise ValueError(f'unexpected frame kind {frame[0]}')
+        msg = pickle.loads(frame[1:])
+        kind = msg[0]
+      except Exception as e:  # version-skewed peer: drop just it
+        log.warning('param lane: unparseable request (%r); dropping '
+                    'subscriber', e)
+        return False
+      if kind in ('get_params', 'hello_params'):
+        # hello_params may arrive here when the peer pipelined it with
+        # its first fetch; it needs no reply of its own.
+        if kind == 'get_params':
+          with self._lock:
+            self._blobs_served += 1
+          self._queue_segments(sub, self._blob_fn())
+      else:
+        self._queue_reply(sub, pickle.dumps(
+            ('error', f'param lane only serves get_params, got '
+             f'{kind!r}'), protocol=pickle.HIGHEST_PROTOCOL))
+    return True
+
+  def _on_writable(self, sub) -> bool:
+    """Send at most one chunk; False = connection is gone."""
+    while sub.out:
+      view = sub.out[0]
+      try:
+        sent = sub.sock.send(view[:self._chunk])
+      except BlockingIOError:
+        return True
+      except OSError:
+        return False
+      with self._lock:
+        self._bytes_sent += sent
+      if sent < len(view):
+        sub.out[0] = view[sent:]
+      else:
+        sub.out.pop(0)
+      # ONE bounded write per poll round: fairness across subscribers
+      # and a bounded GIL hold are the whole point of the lane.
+      return True
+    self._selector.modify(sub.sock, selectors.EVENT_READ, sub)
+    return True
+
+  def _loop(self):
+    try:
+      self._loop_body()
+    except Exception:
+      # A dead lane must be loud: every subscriber would silently
+      # hang on its next fetch otherwise.
+      log.exception('param lane died; subscribers will see drops')
+
+  def _loop_body(self):
+    while True:
+      with self._lock:
+        if self._closed:
+          return
+        adopts, self._pending_adopts = self._pending_adopts, []
+      for sock in adopts:
+        sock.setblocking(False)
+        try:
+          self._selector.register(sock, selectors.EVENT_READ,
+                                  self._Sub(sock))
+        except (KeyError, ValueError, OSError):
+          sock.close()
+      for key, events in self._selector.select(timeout=0.5):
+        if key.data is None:  # wake pipe
+          try:
+            self._wake_r.recv(4096)
+          except OSError:
+            pass
+          continue
+        sub = key.data
+        ok = True
+        if events & selectors.EVENT_READ:
+          ok = self._on_readable(sub)
+        if ok and events & selectors.EVENT_WRITE:
+          ok = self._on_writable(sub)
+        if not ok:
+          self._drop(sub)
+
+  def close(self):
+    with self._lock:
+      if self._closed:
+        return
+      self._closed = True
+    try:
+      self._wake_w.send(b'x')
+    except OSError:
+      pass
+    self._thread.join(timeout=5.0)
+    for key in list(self._selector.get_map().values()):
+      if key.data is not None:
+        key.fileobj.close()
+    self._selector.close()
+    self._wake_r.close()
+    self._wake_w.close()
+
+
 class TrajectoryIngestServer:
   """Learner-side: accepts remote-actor connections, lands their
   unrolls in the shared TrajectoryBuffer, serves param snapshots.
@@ -457,15 +766,23 @@ class TrajectoryIngestServer:
       against the signature before it can reach the buffer. None
       disables both checks (protocol-level tests).
     wire_dtype: 'bfloat16' casts float32 leaves of each published
-      snapshot for the wire (config.remote_params_dtype) — the blob
-      kind becomes 'params_bf16' and RemoteActorClient upcasts on
-      receipt, halving the egress term of the feed arithmetic
-      (docs/PERF.md). ''/None ships exact float32.
+      snapshot for the wire (config.publish_codec resolves here — bf16
+      is the production default; 'f32' opts out) — the blob kind
+      becomes 'params_bf16' and RemoteActorClient upcasts on receipt,
+      halving the egress term of the feed arithmetic (docs/PERF.md,
+      docs/TRANSPORT.md). ''/None ships exact float32.
+    ingest_workers: size of the validate/commit pool that drains the
+      reader threads' handoff queue (validation + buffer.put + ack off
+      the reader thread). 0 = auto (min(4, cpu count)). The handoff
+      queue needs no bound of its own: clients are request→reply
+      lockstep, so at most one unroll per live connection is ever in
+      flight between a reader and a worker.
   """
 
   def __init__(self, buffer, params, host: str = '127.0.0.1',
                port: int = 0, contract=None,
-               wire_dtype: Optional[str] = None):
+               wire_dtype: Optional[str] = None,
+               ingest_workers: int = 0):
     if wire_dtype not in (None, '', 'bfloat16'):
       raise ValueError(f'unsupported wire_dtype {wire_dtype!r}')
     self._wire_bf16 = wire_dtype == 'bfloat16'
@@ -481,11 +798,13 @@ class TrajectoryIngestServer:
     # get_params — at the advertised 150+-actor-host topology every
     # version bump otherwise costs O(hosts × tree) pickles.
     self._serializations = 0
-    self._params_blob = self._make_blob(self._version, params)
+    self._params_frame = self._make_blob(self._version, params)
     self._stats_lock = threading.Lock()
     self._unrolls = 0
     self._rejected = 0
     self._connections = 0
+    self._param_subscribers = 0  # cumulative hello_params adoptions
+    self._ack_reservoir = LatencyReservoir()
     self._closed = threading.Event()
     # Threads/conns are appended by the accept loop, pruned as peers
     # disconnect, snapshotted by close() — all under one lock (flapping
@@ -493,13 +812,38 @@ class TrajectoryIngestServer:
     self._threads: List[threading.Thread] = []
     self._conns: List[_Conn] = []
     self._conns_lock = threading.Lock()
+    # Trajectory-lane handoff: readers push (conn, unroll, t_recv);
+    # the worker pool validates, commits (backpressure lives in the
+    # blocking put) and acks. SimpleQueue put/get are single C calls —
+    # the GIL-atomic handoff that keeps readers off the buffer lock.
+    self._ingest_q: 'queue.SimpleQueue' = queue.SimpleQueue()
+    if ingest_workers <= 0:
+      ingest_workers = max(1, min(4, os.cpu_count() or 1))
+    self._workers = [
+        threading.Thread(target=self._ingest_worker,
+                         name=f'ingest-worker-{i}', daemon=True)
+        for i in range(ingest_workers)]
+    for w in self._workers:
+      w.start()
+    self._param_lane = _ParamLane(self._snapshot_frame)
     self._listener = socket.create_server((host, port))
     self.port = self._listener.getsockname()[1]
     self._accept_thread = threading.Thread(
         target=self._accept_loop, name='ingest-accept', daemon=True)
     self._accept_thread.start()
 
-  def _make_blob(self, version, params) -> bytes:
+  def _make_blob(self, version, params) -> List[bytes]:
+    """One published version as its COMPLETE wire frame, in segments
+    ready for sendall: [head (length prefix + OOB tag + skeleton +
+    buffer table), raw buffer, raw buffer, ...].
+
+    Out-of-band framing in the params direction too (round 6 — the
+    same lesson the r4 unroll framing measured at +90%): the frame IS
+    the arrays, so neither the server (per send) nor the client (per
+    fetch) copies them through the pickler — the client's
+    `_recv_msg` reconstructs zero-copy views, which matters doubly on
+    the param lane where 8 polling fetchers' unpickles used to share
+    the core with the unroll pump's acks."""
     with self._params_lock:
       self._serializations += 1  # test hook: must be once per version
     if self._wire_bf16:
@@ -508,10 +852,10 @@ class TrajectoryIngestServer:
       params = jax.tree_util.tree_map(
           lambda x: x.astype(ml_dtypes.bfloat16)
           if getattr(x, 'dtype', None) == np.float32 else x, params)
-      return pickle.dumps(('params_bf16', version, params),
-                          protocol=pickle.HIGHEST_PROTOCOL)
-    return pickle.dumps(('params', version, params),
-                        protocol=pickle.HIGHEST_PROTOCOL)
+      obj = ('params_bf16', version, params)
+    else:
+      obj = ('params', version, params)
+    return _oob_frame_segments(obj)
 
   def publish_params(self, params) -> int:
     """Swap in a new host param snapshot; returns the new version.
@@ -529,7 +873,7 @@ class TrajectoryIngestServer:
     blob = self._make_blob(version, params)
     with self._params_lock:
       if version > self._blob_version:
-        self._params_blob = blob
+        self._params_frame = blob
         self._blob_version = version
     return version
 
@@ -543,11 +887,67 @@ class TrajectoryIngestServer:
   def stats(self):
     with self._conns_lock:
       live = len(self._conns)
+      per_conn = {f'{c.addr}': c.unrolls for c in self._conns}
+    lane = self._param_lane.stats()
+    ack_p50, ack_p99 = self._ack_reservoir.percentiles(0.5, 0.99)
     with self._stats_lock:
       return {'unrolls': self._unrolls,
               'rejected': self._rejected,
               'connections': self._connections,  # cumulative
-              'live': live}
+              'live': live,
+              # Per-lane transport counters (round 6): the driver
+              # turns these into summary-interval rates/latencies.
+              'per_conn_unrolls': per_conn,
+              'ack_p50_ms': ack_p50 * 1e3,
+              'ack_p99_ms': ack_p99 * 1e3,
+              'param_blobs': lane['blobs'],
+              'param_bytes': lane['bytes'],
+              'param_subscribers': self._param_subscribers}
+
+  def _ingest_worker(self):
+    """Validate/commit/ack loop — the trajectory lane's half that must
+    not run on the reader thread (r5: recv + validate + put + ack
+    serialized per connection made 4 connections slower than 1)."""
+    while True:
+      job = self._ingest_q.get()
+      if job is None:
+        return
+      conn, unroll, t_recv = job
+      try:
+        if self._validate is not None:
+          problems = self._validate(unroll)
+          if problems:
+            # Reject WITHOUT touching the buffer (a malformed unroll
+            # must not poison training) but keep the connection: the
+            # actor decides whether this is fatal.
+            with self._stats_lock:
+              self._rejected += 1
+            conn.send(('error', 'unroll rejected: '
+                       + '; '.join(problems)))
+            continue
+        # Blocking put IS the backpressure: the delayed ack holds the
+        # remote pump exactly like the reference's remote enqueue
+        # into the capacity-1 queue. Poll so close() can interrupt.
+        while True:
+          try:
+            self._buffer.put(unroll, timeout=1.0)
+            break
+          except TimeoutError:
+            if self._closed.is_set():
+              return
+        with self._stats_lock:
+          self._unrolls += 1
+        conn.unrolls += 1
+        with self._params_lock:
+          version = self._version
+        conn.send(('ack', version))
+        self._ack_reservoir.record(time.monotonic() - t_recv)
+      except ring_buffer.Closed:
+        return  # learner shut down; readers see their conns drop
+      except (ConnectionError, OSError):
+        pass  # peer gone mid-ack; its reader notices and cleans up
+      except Exception:
+        log.exception('ingest worker failed on an unroll')
 
   def _accept_loop(self):
     while not self._closed.is_set():
@@ -556,7 +956,7 @@ class TrajectoryIngestServer:
       except OSError:
         return  # listener closed
       conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-      wrapped = _Conn(conn)
+      wrapped = _Conn(conn, addr=addr)
       t = threading.Thread(target=self._serve, args=(wrapped, addr),
                            name=f'ingest-{addr}', daemon=True)
       with self._conns_lock:
@@ -570,9 +970,14 @@ class TrajectoryIngestServer:
         self._connections += 1
       t.start()
 
-  def _snapshot_blob(self) -> bytes:
+  def _snapshot_frame(self) -> List[bytes]:
     with self._params_lock:
-      return self._params_blob
+      return self._params_frame
+
+  def snapshot_nbytes(self) -> int:
+    """Wire size of the current cached snapshot frame (bench +
+    egress-arithmetic hook)."""
+    return sum(len(s) for s in self._snapshot_frame())
 
   def _serve(self, conn: _Conn, addr):
     log.info('remote actor connected from %s', addr)
@@ -581,6 +986,7 @@ class TrajectoryIngestServer:
     # client re-handshakes — cheap, and it re-verifies after learner
     # restarts that may have changed the config).
     handshaken = self._contract is None
+    adopted = False
     try:
       while not self._closed.is_set():
         msg = _recv_msg(conn.sock)
@@ -596,9 +1002,23 @@ class TrajectoryIngestServer:
               conn.send(('reject', problem))
               return
             handshaken = True
-          conn.send_bytes(self._snapshot_blob())
+          conn.send_segments(self._snapshot_frame())
+        elif kind == 'hello_params':
+          # Re-route this whole connection to the param lane: the
+          # reader thread hands the raw socket over and exits — blob
+          # traffic must never share a thread (or a socket) with the
+          # trajectory lane's acks. Re-categorize the connection count
+          # ('connections' means ACTOR connections; subscribers get
+          # their own counter).
+          with self._stats_lock:
+            self._connections -= 1
+            self._param_subscribers += 1
+          adopted = self._param_lane.adopt(conn.sock)
+          return
         elif kind == 'get_params':
-          conn.send_bytes(self._snapshot_blob())
+          # Legacy/in-band path (pre-v5 peers, protocol tests): served,
+          # but production clients fetch over the param lane.
+          conn.send_segments(self._snapshot_frame())
         elif kind == 'unroll':
           if not handshaken:
             # 'error', not 'reject': legacy (protocol-1) clients only
@@ -609,32 +1029,11 @@ class TrajectoryIngestServer:
                        'unroll before a successful hello handshake — '
                        'upgrade/fix the actor host'))
             continue
-          if self._validate is not None:
-            problems = self._validate(msg[1])
-            if problems:
-              # Reject WITHOUT touching the buffer (a malformed unroll
-              # must not poison training) but keep the connection: the
-              # actor decides whether this is fatal.
-              with self._stats_lock:
-                self._rejected += 1
-              conn.send(('error', 'unroll rejected: '
-                         + '; '.join(problems)))
-              continue
-          # Blocking put IS the backpressure: the delayed ack holds the
-          # remote pump exactly like the reference's remote enqueue
-          # into the capacity-1 queue. Poll so close() can interrupt.
-          while True:
-            try:
-              self._buffer.put(msg[1], timeout=1.0)
-              break
-            except TimeoutError:
-              if self._closed.is_set():
-                return
-          with self._stats_lock:
-            self._unrolls += 1
-          with self._params_lock:
-            version = self._version
-          conn.send(('ack', version))
+          # Reader half of the trajectory lane ends here: validation,
+          # the backpressure put and the ack all happen on the worker
+          # pool, so this thread is back inside recv for the next
+          # frame immediately.
+          self._ingest_q.put((conn, msg[1], time.monotonic()))
         else:
           conn.send(('error', f'unknown message kind {kind!r}'))
     except ring_buffer.Closed:
@@ -652,11 +1051,13 @@ class TrajectoryIngestServer:
       if not self._closed.is_set():
         log.warning('remote actor %s dropped: %s', addr, e)
     finally:
-      conn.sock.close()
+      if not adopted:
+        conn.sock.close()
       with self._conns_lock:
         if conn in self._conns:
           self._conns.remove(conn)
-      log.info('remote actor %s disconnected', addr)
+      if not adopted:
+        log.info('remote actor %s disconnected', addr)
 
   def close(self, graceful: bool = True):
     """Shut the server down.
@@ -686,6 +1087,12 @@ class TrajectoryIngestServer:
       self._listener.close()
     except OSError:
       pass
+    # Drain the worker pool (one sentinel per worker) and the param
+    # lane before touching the trajectory conns: a worker mid-commit
+    # may still send one last ack, which try_send below tolerates.
+    for _ in self._workers:
+      self._ingest_q.put(None)
+    self._param_lane.close()
     with self._conns_lock:
       conns = list(self._conns)
       threads = list(self._threads)
@@ -710,17 +1117,27 @@ class TrajectoryIngestServer:
     if graceful:
       for conn in conns:
         conn.sock.close()
+    for w in self._workers:
+      w.join(timeout=2.0)
     self._accept_thread.join(timeout=2.0)
 
 
 class RemoteActorClient:
   """Actor-side connection to the learner's ingest server.
 
-  Strict request→reply; NOT thread-safe — one pump thread owns it.
+  Two sockets, one per lane: unrolls/acks ride the trajectory
+  connection opened here; `fetch_params` lazily opens a second
+  connection onto the server's param lane (`hello_params`) so blob
+  transfers never queue behind — or in front of — unroll acks.
+
+  Strict request→reply per socket; NOT thread-safe — one pump thread
+  owns it.
   """
 
   def __init__(self, address: str, connect_timeout_secs: float = 60.0):
     host, port = address.rsplit(':', 1)
+    self._addr = (host, int(port))
+    self._param_sock: Optional[socket.socket] = None
     deadline = time.monotonic() + connect_timeout_secs
     last_err = None
     while True:
@@ -787,12 +1204,53 @@ class RemoteActorClient:
   def handshake(self, contract) -> Tuple[int, object]:
     """Offer this host's trajectory contract; returns (version,
     params) on agreement, raises ContractMismatch (naming the
-    offending fields) when the learner refuses."""
+    offending fields) when the learner refuses. The handshake blob
+    rides the trajectory connection (once per connect — before any
+    unroll is in flight, so there is no ack to starve)."""
     return self._decode_params(self._rpc(('hello', contract)))
 
   def fetch_params(self) -> Tuple[int, object]:
-    """(version, host param pytree) — the current learner snapshot."""
-    return self._decode_params(self._rpc(('get_params',)))
+    """(version, host param pytree) — the current learner snapshot,
+    fetched over the dedicated param lane. A lane failure closes just
+    the param socket and surfaces as ConnectionError/OSError; the
+    caller's reconnect path rebuilds both lanes."""
+    if self._param_sock is None:
+      try:
+        sock = socket.create_connection(self._addr, timeout=10.0)
+      except OSError:
+        raise ConnectionError(
+            f'could not open the param lane to {self._addr}')
+      sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+      sock.settimeout(None)
+      _send_msg(sock, ('hello_params',))
+      self._param_sock = sock
+    try:
+      _send_msg(self._param_sock, ('get_params',))
+      reply = _recv_msg(self._param_sock)
+    except (ValueError, struct.error, pickle.UnpicklingError,
+            EOFError) as e:
+      self._close_param_sock()
+      raise ProtocolError(
+          f'unparseable param-lane reply ({e!r}) — likely a '
+          f'protocol-version skew (this client speaks '
+          f'v{PROTOCOL_VERSION}); upgrade both roles together') from e
+    except OSError:
+      self._close_param_sock()
+      raise
+    if reply is None:
+      self._close_param_sock()
+      raise ConnectionError('learner closed the param lane')
+    if reply[0] == 'error':
+      raise RuntimeError(f'learner rejected param fetch: {reply[1]}')
+    return self._decode_params(reply)
+
+  def _close_param_sock(self):
+    if self._param_sock is not None:
+      try:
+        self._param_sock.close()
+      except OSError:
+        pass
+      self._param_sock = None
 
   def send_unroll(self, unroll) -> int:
     """Ship one ActorOutput; returns the learner's params version.
@@ -802,6 +1260,7 @@ class RemoteActorClient:
     return reply[1]
 
   def close(self):
+    self._close_param_sock()
     try:
       self._sock.close()
     except OSError:

@@ -9,10 +9,13 @@ SURVEY §2.b "async pipeline"):
   bounds policy lag, exactly the reference's capacity-1 queue semantics
   (lag ≤ capacity + in-flight unroll + staged batch).
 - `BatchPrefetcher`: one thread that assembles [T+1, B] batches and
-  stages the NEXT device batch while the learner trains on the current
-  one (the StagingArea role). `place_fn` is where `jax.device_put` with
+  stages the next `depth` device batches while the learner trains on
+  the current one (the StagingArea role, default depth 2 —
+  config.staging_depth). `place_fn` is where `jax.device_put` with
   data-axis shardings happens, so staging overlaps host→HBM transfer
-  with TPU compute.
+  with TPU compute; with depth >= 2 consecutive transfers also
+  overlap each other (the r5 fed bench measured H2D as the dominant
+  feed-gap term).
 
 Episode stats ride inside the trajectories (StepOutputInfo), so there
 is no side channel to drain — consume them from the dequeued batch
@@ -134,11 +137,32 @@ class TrajectoryBuffer:
 
 
 class BatchPrefetcher:
-  """Stages the next device batch while the learner consumes the
-  current one (double-buffered HBM prefetch)."""
+  """Stages upcoming device batches while the learner consumes the
+  current one (the StagingArea role, generalized to `depth` slots).
+
+  depth is the number of staged batches that may be in flight at once
+  (config.staging_depth; default 2). With depth >= 2 the prefetcher
+  keeps TWO `place_fn` dispatches outstanding: `jax.device_put` is
+  async, so the transfers of batches N+1 and N+2 overlap each other
+  AND the step computing batch N — the r5 fed-learner bench measured
+  the host→device copy as the dominant feed-gap term (`h2d_ms` 1430.5
+  vs `stack_ms` 37.5, BENCH_r05), and a single staged slot can hide
+  at most one transfer behind one step. Raising depth trades policy
+  lag (each staged batch extends the lag bound by one batch) for
+  transfer overlap; keep it small.
+
+  `stats()` reports the overlap counters the acceptance gate reads:
+  `h2d_overlap_fraction` is the fraction of `get()` calls that found
+  a batch already staged (the step did NOT block on staging). It
+  conflates data starvation with transfer stalls by design — both are
+  "the learner waited" — so read it together with `buffer_unrolls`
+  (≈0 means starvation upstream of staging).
+  """
 
   def __init__(self, buffer: TrajectoryBuffer, batch_size: int,
-               place_fn: Callable = lambda x: x, depth: int = 1):
+               place_fn: Callable = lambda x: x, depth: int = 2):
+    if depth < 1:
+      raise ValueError('staging depth must be >= 1')
     self._buffer = buffer
     self._batch_size = batch_size
     self._place_fn = place_fn
@@ -149,6 +173,11 @@ class BatchPrefetcher:
     self._depth = depth
     self._closed = False
     self._error: Optional[BaseException] = None
+    # Overlap telemetry (all under self._lock).
+    self._staged = 0
+    self._gets = 0
+    self._blocked_gets = 0
+    self._wait_secs = 0.0
     self._thread = threading.Thread(target=self._loop,
                                     name='batch-prefetcher', daemon=True)
     self._thread.start()
@@ -164,6 +193,7 @@ class BatchPrefetcher:
           if self._closed:
             return
           self._out.append(staged)
+          self._staged += 1
           self._ready.notify()
     except Closed:
       with self._lock:
@@ -177,13 +207,21 @@ class BatchPrefetcher:
 
   def get(self, timeout: Optional[float] = None):
     deadline = None if timeout is None else time.monotonic() + timeout
+    t0 = time.monotonic()
     with self._ready:
+      self._gets += 1
+      blocked = not self._out and not self._closed
+      if blocked:
+        self._blocked_gets += 1
       while not self._out and not self._closed:
         remaining = (None if deadline is None
                      else deadline - time.monotonic())
         if remaining is not None and remaining <= 0:
+          self._wait_secs += time.monotonic() - t0
           raise TimeoutError('BatchPrefetcher.get timed out')
         self._ready.wait(remaining)
+      if blocked:
+        self._wait_secs += time.monotonic() - t0
       if self._error is not None:
         raise self._error
       if not self._out:
@@ -191,6 +229,22 @@ class BatchPrefetcher:
       item = self._out.popleft()
       self._space.notify()
       return item
+
+  def stats(self):
+    """Staging/overlap counters: staged batches, consumer gets, how
+    many blocked, total blocked seconds, and the headline
+    `h2d_overlap_fraction` (1.0 = no step ever waited on staging)."""
+    with self._lock:
+      gets = self._gets
+      return {
+          'depth': self._depth,
+          'staged_batches': self._staged,
+          'gets': gets,
+          'blocked_gets': self._blocked_gets,
+          'wait_secs': round(self._wait_secs, 4),
+          'h2d_overlap_fraction': (
+              (gets - self._blocked_gets) / gets if gets else 0.0),
+      }
 
   def close(self):
     with self._lock:

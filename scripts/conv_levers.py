@@ -271,6 +271,15 @@ def main():
   print(json.dumps({'check': 's1_argmax_vjp_parity',
                     'max_abs_err': dw_err, 'grad_scale': scale}),
         flush=True)
+  # Gate, not just telemetry (ADVICE r5 — CI runs the SMOKE path and
+  # previously only PRINTED this number): same tolerance discipline as
+  # scripts/pallas_conv_pool.py — bit-exact in SMOKE (both paths share
+  # the same max-tie policy and CPU lowering; measured 0.0), a few
+  # bf16 ulps relative to the gradient's own scale on the chip.
+  tol = 1e-6 if SMOKE else 0.02 * scale
+  assert dw_err <= tol, (
+      f's1_argmax VJP parity broke: max_abs_err {dw_err} > tol {tol} '
+      f'(grad_scale {scale})')
 
   measure('s1_baseline', s1_baseline, s1_params)
   measure('s1_strided', s1_strided, s1_params)

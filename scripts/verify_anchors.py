@@ -24,15 +24,59 @@ Not run in CI (upstream unavailable there) — tests/test_anchors.py
 covers the checksum/warning machinery instead.
 """
 
+import ast
 import json
 import os
-import runpy
 import sys
 
 # `python scripts/verify_anchors.py` puts scripts/ (not the repo root)
 # on sys.path — same preamble as the sibling scripts.
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+def extract_constant_tables(path, names):
+  """{name: dict} for the requested module-level assignments in an
+  UNTRUSTED python source, WITHOUT executing it (ADVICE r5: the
+  upstream checkout this script points at is exactly the kind of file
+  nobody audits before running; `runpy.run_path` executed it).
+
+  Parses with `ast` and `ast.literal_eval`s each assigned value.
+  Handles the two shapes upstream uses: plain dict literals and
+  `collections.OrderedDict([...])` (the call's single literal
+  argument is evaluated and dict()ed). A requested name bound to
+  anything else (a computation, a function call with non-literal
+  args) raises ValueError naming it — drift INTO executable table
+  definitions should fail loudly, not get silently skipped."""
+  with open(path) as f:
+    tree = ast.parse(f.read(), filename=path)
+  out = {}
+  for node in tree.body:  # module level only, like the import would see
+    if isinstance(node, ast.Assign):
+      targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+      value = node.value
+    elif (isinstance(node, ast.AnnAssign)
+          and isinstance(node.target, ast.Name) and node.value):
+      targets, value = [node.target.id], node.value
+    else:
+      continue
+    wanted = [t for t in targets if t in names]
+    if not wanted:
+      continue
+    if isinstance(value, ast.Call):
+      func = value.func
+      fname = (func.attr if isinstance(func, ast.Attribute)
+               else getattr(func, 'id', None))
+      if fname not in ('OrderedDict', 'dict') or len(value.args) != 1:
+        raise ValueError(
+            f'{wanted[0]} in {path} is built by a {fname!r} call this '
+            'script cannot evaluate without executing the file')
+      literal = dict(ast.literal_eval(value.args[0]))
+    else:
+      literal = ast.literal_eval(value)
+    for name in wanted:
+      out[name] = literal
+  return out
 
 
 def _fail(msg):
@@ -62,12 +106,13 @@ def _diff_tables(name, ours, upstream):
 def verify_dmlab30(upstream_path):
   """Returns (drift_count, module_path, our_tables)."""
   from scalable_agent_tpu.envs import dmlab30
-  # Upstream is a plain-constants module (no package-relative imports);
-  # runpy executes it without installing anything.
-  up = runpy.run_path(upstream_path)
+  # The upstream checkout is UNTRUSTED input: extract its three
+  # constant tables by ast-parsing the source instead of executing it
+  # (ADVICE r5 — this used to be runpy.run_path).
   tables = {'LEVEL_MAPPING': dict(dmlab30.LEVEL_MAPPING),
             'HUMAN_SCORES': dmlab30.HUMAN_SCORES,
             'RANDOM_SCORES': dmlab30.RANDOM_SCORES}
+  up = extract_constant_tables(upstream_path, set(tables))
   drift = 0
   for sym, ours in tables.items():
     if sym not in up:
@@ -102,7 +147,8 @@ def main(argv):
     drift, module_path, tables = (verify_dmlab30(upstream_path)
                                   if which == 'dmlab30'
                                   else verify_atari57(upstream_path))
-  except (OSError, json.JSONDecodeError, SyntaxError) as e:
+  except (OSError, json.JSONDecodeError, SyntaxError,
+          ValueError) as e:
     return _fail(f'could not load upstream source: {e!r}')
   if drift:
     print(f'{which}: {drift} drifted constant(s) — fix them in '

@@ -111,3 +111,46 @@ def test_verify_anchors_script_clean_and_drifted(tmp_path, capsys):
   out = capsys.readouterr().out
   assert rc == 1
   assert 'rooms_watermaze' in out and '55.5' in out
+
+
+def test_verify_anchors_never_executes_upstream(tmp_path, capsys):
+  """ADVICE r5: the upstream checkout is UNTRUSTED input — the script
+  must extract its tables by parsing, not by running it. An upstream
+  file whose top-level code would leave a marker (or crash) on
+  execution still verifies cleanly; a table built by arbitrary code
+  is refused loudly instead of being executed."""
+  import sys
+  sys.path.insert(0, 'scripts')
+  try:
+    import verify_anchors
+  finally:
+    sys.path.pop(0)
+  from scalable_agent_tpu.envs import dmlab30
+
+  marker = tmp_path / 'executed.marker'
+  lines = [
+      'import collections',
+      'import pathlib',
+      f'pathlib.Path({str(marker)!r}).write_text("owned")  # payload',
+      'raise SystemExit(42)  # would abort the script if executed',
+      f'LEVEL_MAPPING = collections.OrderedDict('
+      f'{list(dmlab30.LEVEL_MAPPING.items())!r})',
+      f'HUMAN_SCORES = {dmlab30.HUMAN_SCORES!r}',
+      f'RANDOM_SCORES = {dmlab30.RANDOM_SCORES!r}',
+  ]
+  upstream = tmp_path / 'dmlab30.py'
+  upstream.write_text('\n'.join(lines))
+  rc = verify_anchors.main(['prog', 'dmlab30', str(upstream)])
+  capsys.readouterr()
+  assert rc == 0                 # tables matched…
+  assert not marker.exists()     # …and the payload NEVER ran
+
+  # A requested table bound to executable construction is refused
+  # (exit 2 via the load-error path), not silently skipped.
+  upstream.write_text('\n'.join([
+      'import collections',
+      'LEVEL_MAPPING = dict(sorted(make_mapping()))',
+      f'HUMAN_SCORES = {dmlab30.HUMAN_SCORES!r}',
+      f'RANDOM_SCORES = {dmlab30.RANDOM_SCORES!r}',
+  ]))
+  assert verify_anchors.main(['prog', 'dmlab30', str(upstream)]) == 2

@@ -20,6 +20,38 @@ def test_transport_bench_smoke():
   assert ingest['mb_per_sec'] > 0
 
 
+def test_emit_writes_artifact_and_prints_headline_last(tmp_path,
+                                                       capsys):
+  """Satellite (VERDICT r5 weak #1): the round artifact must survive
+  the driver's tail capture — the FULL result goes to BENCH_OUT.json
+  and stdout ENDS with a compact, complete JSON headline line."""
+  import json
+  out = {
+      'metric': 'learner_env_frames_per_sec_per_chip',
+      'value': 123.4, 'vs_baseline': 0.01,
+      'e2e_fed': {'fps': 9000.0, 'h2d_overlap_fraction': 0.9},
+      'transport': {'ingest_1conn': {'unrolls_per_sec': 900.0},
+                    'ingest_4conn': {'unrolls_per_sec': 1500.0}},
+      'param_fanout': {
+          'pump_alone': {'unrolls_per_sec': 800.0, 'ack_p99_ms': 2.0},
+          'pump_with_8_fetchers': {'unrolls_per_sec': 400.0,
+                                   'ack_p99_ms': 5.0}},
+  }
+  path = tmp_path / 'BENCH_OUT.json'
+  bench._emit(out, path=str(path))
+  assert json.load(open(path)) == out          # full, self-contained
+  lines = capsys.readouterr().out.strip().splitlines()
+  assert json.loads(lines[0]) == out           # full line for humans
+  head = json.loads(lines[-1])                 # compact line LAST
+  assert head['artifact'] == 'BENCH_OUT.json'
+  assert head['value'] == 123.4
+  assert head['ingest_4conn'] == 1500.0
+  assert head['pump_contended_unrolls_per_sec'] == 400.0
+  assert head['pump_contended_ack_p99_ms'] == 5.0
+  assert head['h2d_overlap_fraction'] == 0.9
+  assert len(lines[-1]) < 1000  # compact: survives tail truncation
+
+
 def test_anakin_bench_smoke():
   results = bench.bench_anakin(smoke=True)
   assert results['env_frames_per_sec'] > 0

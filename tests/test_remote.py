@@ -119,7 +119,14 @@ def test_version_skewed_peer_dropped_cleanly():
     payload = pickle.dumps(('hello', None),
                            protocol=pickle.HIGHEST_PROTOCOL)
     legacy.sendall(remote._LEN.pack(len(payload)) + payload)  # no tag
-    assert legacy.recv(1) == b''  # server closed OUR conn, not itself
+    # Server closed OUR conn, not itself. A clean FIN (b'') or an RST
+    # (ECONNRESET — the v5 reader aborts on the bogus tag byte with
+    # the rest of the frame unread) both prove the drop; the server's
+    # own survival is asserted via the healthy client below.
+    try:
+      assert legacy.recv(1) == b''
+    except ConnectionResetError:
+      pass
     legacy.close()
 
     healthy = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
@@ -133,17 +140,22 @@ def test_version_skewed_peer_dropped_cleanly():
     buffer.close()
 
   # Client side: an untagged (pre-v4 style) reply raises ProtocolError.
+  # The v5 client fetches over a SECOND (param lane) connection, so
+  # the fake legacy peer accepts both and answers the fetch untagged.
   with socket.create_server(('127.0.0.1', 0)) as srv:
     port = srv.getsockname()[1]
 
     def serve_legacy():
-      conn, _ = srv.accept()
-      remote._recv_msg(conn)  # the tagged get_params request parses
+      main_conn, _ = srv.accept()       # the trajectory connection
+      param_conn, _ = srv.accept()      # the client's param lane
+      remote._recv_msg(param_conn)      # tagged 'hello_params' parses
+      remote._recv_msg(param_conn)      # tagged 'get_params' parses
       reply = pickle.dumps(('params', 1, {}),
                            protocol=pickle.HIGHEST_PROTOCOL)
-      conn.sendall(remote._LEN.pack(len(reply)) + reply)  # no tag
-      conn.recv(1)
-      conn.close()
+      param_conn.sendall(remote._LEN.pack(len(reply)) + reply)  # no tag
+      param_conn.recv(1)
+      param_conn.close()
+      main_conn.close()
 
     t = threading.Thread(target=serve_legacy, daemon=True)
     t.start()
@@ -352,7 +364,7 @@ def test_bf16_wire_dtype_halves_blob_and_upcasts():
 
     exact_blob = pickle.dumps(('params', 1, params),
                               protocol=pickle.HIGHEST_PROTOCOL)
-    assert len(server._snapshot_blob()) < 0.65 * len(exact_blob)
+    assert server.snapshot_nbytes() < 0.65 * len(exact_blob)
 
     # Version bumps keep working through the cast path.
     assert server.publish_params({'w': np.full(8, 2.5, np.float32),
@@ -361,6 +373,174 @@ def test_bf16_wire_dtype_halves_blob_and_upcasts():
     version, got = client.fetch_params()
     assert version == 2
     np.testing.assert_allclose(got['w'], 2.5, rtol=1e-2)
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
+
+
+def test_param_lane_chunked_blob_roundtrip_and_concurrency():
+  """Round 6 param-lane contract: `fetch_params` rides a SECOND
+  connection served by the chunked non-blocking publisher. A blob much
+  larger than the lane's 128 KiB chunk must round-trip bit-exact,
+  version bumps must propagate, the subscriber/blob counters must
+  account for the traffic, and the unroll pump must keep making
+  progress while subscribers poll (the r5 starvation shape)."""
+  buffer = ring_buffer.TrajectoryBuffer(8)
+  params = {'w': np.arange(1 << 20, dtype=np.float64)}  # 8 MB >> chunk
+  server = remote.TrajectoryIngestServer(buffer, params,
+                                         host='127.0.0.1')
+  clients = [remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                      connect_timeout_secs=10)
+             for _ in range(3)]
+  stop = threading.Event()
+  drained = []
+  try:
+    for c in clients:
+      version, got = c.fetch_params()
+      assert version == 1
+      np.testing.assert_array_equal(got['w'], params['w'])
+    stats = server.stats()
+    assert stats['param_subscribers'] == 3
+    assert stats['param_blobs'] == 3
+    assert stats['connections'] == 3   # three trajectory conns
+    assert server.publish_params({'w': np.full(4, 2.0)}) == 2
+    for c in clients:
+      version, got = c.fetch_params()
+      assert version == 2
+      np.testing.assert_array_equal(got['w'], np.full(4, 2.0))
+
+    # Pump + polling subscribers concurrently: both lanes progress.
+    def drain():
+      while not stop.is_set():
+        try:
+          drained.append(buffer.get(timeout=0.2))
+        except (TimeoutError, ring_buffer.Closed):
+          continue
+
+    fetches = [0]
+
+    def fetch_loop():
+      while not stop.is_set():
+        clients[1].fetch_params()
+        fetches[0] += 1
+
+    threads = [threading.Thread(target=drain, daemon=True),
+               threading.Thread(target=fetch_loop, daemon=True)]
+    for t in threads:
+      t.start()
+    pumped = 0
+    deadline = time.monotonic() + 0.8
+    while time.monotonic() < deadline:
+      clients[0].send_unroll(_tiny_unroll(pumped))
+      pumped += 1
+    stop.set()
+    for t in threads:
+      t.join(timeout=5)
+    assert pumped > 0 and fetches[0] > 0
+    assert server.stats()['unrolls'] == pumped
+  finally:
+    stop.set()
+    for c in clients:
+      c.close()
+    server.close()
+    buffer.close()
+
+
+def test_multi_connection_ingest_preserves_per_conn_order():
+  """Round 6 multi-reader ingest: per-connection reader threads hand
+  unrolls to the validate/commit worker pool. Every unroll from N
+  concurrent connections must land exactly once, in per-connection
+  FIFO order (cross-connection interleaving is free), with the
+  per-connection counters accounting for all of them — and the
+  bounded buffer en route exercises the backpressure path."""
+  buffer = ring_buffer.TrajectoryBuffer(4)  # << total: puts must block
+  server = remote.TrajectoryIngestServer(buffer, {'w': np.zeros(1)},
+                                         host='127.0.0.1',
+                                         ingest_workers=2)
+  n_conns, per_conn = 3, 15
+  landed = []
+  landed_done = threading.Event()
+
+  def drain():
+    while len(landed) < n_conns * per_conn:
+      try:
+        landed.append(buffer.get(timeout=5))
+      except (TimeoutError, ring_buffer.Closed):
+        return
+    landed_done.set()
+
+  drainer = threading.Thread(target=drain, daemon=True)
+  drainer.start()
+
+  def pump(conn_id, errors):
+    client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                      connect_timeout_secs=10)
+    try:
+      for seq in range(per_conn):
+        unroll = _tiny_unroll(seq)
+        unroll.env_outputs.reward[0] = conn_id * 1000 + seq  # tag
+        client.send_unroll(unroll)
+    except Exception as e:
+      errors.append(e)
+    finally:
+      client.close()
+
+  errors: list = []
+  pumps = [threading.Thread(target=pump, args=(i, errors), daemon=True)
+           for i in range(n_conns)]
+  try:
+    for t in pumps:
+      t.start()
+    for t in pumps:
+      t.join(timeout=60)
+    assert not errors, errors
+    assert landed_done.wait(30)
+    tags = [int(u.env_outputs.reward[0]) for u in landed]
+    assert len(tags) == n_conns * per_conn
+    assert len(set(tags)) == len(tags)  # exactly once
+    for conn_id in range(n_conns):
+      seqs = [t % 1000 for t in tags if t // 1000 == conn_id]
+      assert seqs == sorted(seqs), (conn_id, seqs)  # per-conn FIFO
+    stats = server.stats()
+    assert stats['unrolls'] == n_conns * per_conn
+    assert stats['ack_p99_ms'] > 0.0
+  finally:
+    server.close()
+    buffer.close()
+    drainer.join(timeout=5)
+
+
+def test_publish_codec_resolution_and_rounding():
+  """The bf16 publish codec is the DEFAULT (r5 measured: ratio 0.5 for
+  ~5 ms vs zlib-1's 0.926 for 209 ms); 'f32' opts out; the legacy
+  remote_params_dtype spelling still wins when set. The round trip
+  through the default codec is exact-to-bf16-rounding (rel err ≤
+  2^-8 — one bf16 ulp)."""
+  import pytest
+  from scalable_agent_tpu.config import Config
+  assert Config().resolved_wire_dtype == 'bfloat16'
+  assert Config(publish_codec='f32').resolved_wire_dtype == ''
+  assert Config(publish_codec='f32',
+                remote_params_dtype='bfloat16'
+                ).resolved_wire_dtype == 'bfloat16'
+  with pytest.raises(ValueError, match='publish_codec'):
+    _ = Config(publish_codec='zstd').resolved_wire_dtype
+
+  buffer = ring_buffer.TrajectoryBuffer(2)
+  params = {'w': (np.random.RandomState(0).randn(4096)
+                  .astype(np.float32))}
+  server = remote.TrajectoryIngestServer(
+      buffer, params, host='127.0.0.1',
+      wire_dtype=Config().resolved_wire_dtype)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    _, got = client.fetch_params()
+    assert got['w'].dtype == np.float32
+    rel = np.abs(got['w'] - params['w']) / np.maximum(
+        np.abs(params['w']), 1e-30)
+    assert float(rel.max()) <= 2.0 ** -8
   finally:
     client.close()
     server.close()

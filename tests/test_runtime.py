@@ -132,6 +132,84 @@ class TestTrajectoryBuffer:
     assert states == ['consumer-closed']
 
 
+class TestBatchPrefetcher:
+
+  @staticmethod
+  def _item(i=0):
+    from scalable_agent_tpu.structs import ActorOutput
+    return ActorOutput(np.int32(0),
+                       np.full((1, 2), i, np.float32),
+                       np.full((4,), i, np.float32),
+                       np.full((4,), i, np.float32))
+
+  def test_double_buffering_hides_staging(self):
+    """Acceptance (ISSUE 1): with staging depth >= 2 and producers
+    keeping up, no step blocks on `place_fn` (the device_put stand-in)
+    once the pipeline is primed — the overlap counters must show it."""
+    buf = TrajectoryBuffer(capacity_unrolls=8)
+    stop = threading.Event()
+
+    def produce():
+      while not stop.is_set():
+        try:
+          buf.put(self._item(), timeout=0.1)
+        except (TimeoutError, Closed):
+          continue
+
+    producer = threading.Thread(target=produce, daemon=True)
+    producer.start()
+
+    def slow_place(batch):  # simulated H2D: 20 ms per staged batch
+      time.sleep(0.02)
+      return batch
+
+    pf = BatchPrefetcher(buf, batch_size=2, place_fn=slow_place,
+                         depth=2)
+    try:
+      pf.get(timeout=10)  # prime the pipeline (this one MAY block)
+      for _ in range(10):
+        time.sleep(0.03)  # simulated step: longer than one staging
+        pf.get(timeout=10)
+      stats = pf.stats()
+      assert stats['depth'] == 2
+      assert stats['gets'] == 11
+      assert stats['staged_batches'] >= 11
+      # Steady state never waited: at most the priming get blocked.
+      assert stats['blocked_gets'] <= 1, stats
+      assert stats['h2d_overlap_fraction'] >= 0.8, stats
+    finally:
+      stop.set()
+      pf.close()
+      producer.join(timeout=5)
+
+  def test_depth_bounds_staged_batches(self):
+    """depth bounds the staged-ahead pipeline (each slot extends the
+    policy-lag bound by one batch, so the prefetcher must not run
+    ahead of it): `depth` queued batches plus the one the thread has
+    already dispatched and is parking — never more."""
+    buf = TrajectoryBuffer(capacity_unrolls=8)
+    for i in range(8):
+      buf.put(self._item(i))
+    staged = []
+    pf = BatchPrefetcher(buf, batch_size=1,
+                         place_fn=lambda b: staged.append(b) or b,
+                         depth=3)
+    try:
+      deadline = time.monotonic() + 5
+      while len(staged) < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+      time.sleep(0.1)  # would overfill if depth were not enforced
+      assert len(staged) == 4  # 3 queued + 1 parked at the full gate
+      pf.get(timeout=5)
+      deadline = time.monotonic() + 5
+      while len(staged) < 5 and time.monotonic() < deadline:
+        time.sleep(0.01)
+      time.sleep(0.05)
+      assert len(staged) == 5  # one slot freed -> exactly one more
+    finally:
+      pf.close()
+
+
 class TestInferenceServer:
 
   def test_actors_share_batched_inference(self):
