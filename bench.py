@@ -299,8 +299,11 @@ class _SyntheticFleet:
   def check_health(self, stall_timeout_secs=None):
     pass
 
-  def stats(self):
-    return {'alive': len(self._threads), 'respawns': 0}
+  def stats(self, healthy_horizon_secs: float = 60.0):
+    # Synthetic producers never wedge: healthy == alive by definition.
+    alive = len(self._threads)
+    return {'alive': alive, 'respawns': 0, 'healthy': alive,
+            'healthy_fraction': 1.0, 'unrolls': 0}
 
   def stop(self, timeout=10.0):
     self._stop.set()

@@ -203,6 +203,42 @@ flags.DEFINE_enum('publish_codec', _DEFAULTS.publish_codec,
 flags.DEFINE_integer('ingest_workers', _DEFAULTS.ingest_workers,
                      'Validate/commit workers behind the remote-'
                      'ingest reader threads (0 = auto).')
+flags.DEFINE_bool('health_watchdog', _DEFAULTS.health_watchdog,
+                  'Learner failure domain (health.py): skip '
+                  'non-finite updates on device, roll back to the '
+                  'last-known-good checkpoint after K consecutive '
+                  'bad steps, halt with a diagnostic bundle after '
+                  'the rollback budget (docs/ROBUSTNESS.md).')
+flags.DEFINE_integer('health_check_every_steps',
+                     _DEFAULTS.health_check_every_steps,
+                     'Host-side sentinel read cadence (each check is '
+                     'one tiny device_get; the device-side skip '
+                     'protects params regardless).')
+flags.DEFINE_integer('health_window', _DEFAULTS.health_window,
+                     'Recent health checks retained (sliding window '
+                     'for the relative detectors + the halt '
+                     "bundle's metrics tail).")
+flags.DEFINE_integer('health_min_window', _DEFAULTS.health_min_window,
+                     'Good samples required before the relative '
+                     'detectors (loss explosion, sigma divergence) '
+                     'arm.')
+flags.DEFINE_integer('health_rollback_after',
+                     _DEFAULTS.health_rollback_after,
+                     'Consecutive bad steps before an automatic '
+                     'checkpoint rollback.')
+flags.DEFINE_integer('health_max_rollbacks',
+                     _DEFAULTS.health_max_rollbacks,
+                     'Rollbacks granted before the watchdog halts '
+                     'the run with a diagnostic bundle.')
+flags.DEFINE_float('health_loss_explosion_factor',
+                   _DEFAULTS.health_loss_explosion_factor,
+                   'Finite-loss explosion threshold: |loss| beyond '
+                   'this multiple of the window median flags the '
+                   'step bad.')
+flags.DEFINE_float('health_sigma_divergence_factor',
+                   _DEFAULTS.health_sigma_divergence_factor,
+                   'PopArt sigma_max beyond this multiple of its '
+                   'window median flags the step bad.')
 flags.DEFINE_string('profile_dir', _DEFAULTS.profile_dir,
                     'Capture a jax.profiler trace of a few learner '
                     'steps into this directory.')

@@ -16,15 +16,20 @@ and restores to the same placements when given the live state as the
 abstract target.
 """
 
+import json
+import logging
 import os
 import time
-from typing import Optional
+from typing import List, Optional, Tuple
 
 import jax
 
 import orbax.checkpoint as ocp
 
 from scalable_agent_tpu.learner import TrainState
+from scalable_agent_tpu.runtime import faults as faults_lib
+
+log = logging.getLogger('scalable_agent_tpu')
 
 
 class CheckpointStructureError(ValueError):
@@ -36,12 +41,31 @@ class CheckpointStructureError(ValueError):
 # partial files, missing arrays, I/O errors): only these earn the
 # config-flag guidance — flag advice on a genuinely corrupt checkpoint
 # sends operators down the wrong path (ADVICE r3). Deliberately
-# NARROW: generic words like 'missing'/'key' also appear in
-# partial-save messages ('missing commit file'), which must get the
-# corruption wording.
+# NARROW: generic words like 'missing'/'key'/'mismatch' also appear in
+# partial-save messages ('missing commit file', 'checksum mismatch'),
+# which must get the corruption wording. 'dict key mismatch' is the
+# newer-Orbax spelling of the restore-target/on-disk tree diff
+# (jax tree_util raises it before any file is read).
 _STRUCTURE_MARKERS = (
     'structure', 'tree', 'pytree', 'not found in checkpoint',
-    'do not match')
+    'do not match', 'dict key mismatch')
+
+
+def _looks_structural(e) -> bool:
+  """Whether a restore failure looks like a tree-STRUCTURE mismatch
+  (config-flag guidance, no fallback — older steps share the config)
+  rather than corrupt/partial files (corruption guidance, and the
+  restore ladder retries the previous retained step). KeyError is
+  structural by TYPE (its str is just the missing key, which need not
+  contain any marker) — EXCEPT Orbax's missing-ITEM KeyError ('Item
+  "default" was not found ... Available items: []'), which means the
+  step directory lost its payload (partial save/eviction): that is
+  per-step damage the ladder must fall back past, not a config
+  mismatch."""
+  msg = str(e).lower()
+  if isinstance(e, KeyError):
+    return 'available items' not in msg
+  return any(marker in msg for marker in _STRUCTURE_MARKERS)
 
 
 def _wrap_structure_error(e, directory, step):
@@ -55,11 +79,7 @@ def _wrap_structure_error(e, directory, step):
   don't get misleading flag advice."""
   base = (f'could not restore checkpoint step {step} from {directory}: '
           f'{e}\n')
-  msg = str(e).lower()
-  # KeyError is structural by TYPE (its str is just the missing key,
-  # which need not contain any marker).
-  if isinstance(e, KeyError) or any(
-      marker in msg for marker in _STRUCTURE_MARKERS):
+  if _looks_structural(e):
     guidance = (
         'This looks like a tree-structure mismatch: the param tree is '
         'a function of the config. Usual cause: --use_instruction '
@@ -101,25 +121,95 @@ class Checkpointer:
             max_to_keep=max_to_keep, create=True))
     self._save_interval_secs = save_interval_secs
     self._last_save_time: Optional[float] = None
+    self._last_good_path = os.path.join(self._directory, 'LAST_GOOD')
+    # Integrity-ladder observability (driver summaries + tests).
+    self.save_errors = 0
+    self.last_save_error: Optional[BaseException] = None
+    self.restore_fallbacks = 0
 
   def save(self, state: TrainState, step: Optional[int] = None,
            force: bool = False) -> bool:
-    """Save now. `step` defaults to the state's own update counter.
+    """Save now and VERIFY completion. `step` defaults to the state's
+    own update counter.
 
-    Returns whether a checkpoint was actually written. A step that
-    already exists is skipped (returns False, even with force=True —
-    Orbax raises StepAlreadyExistsError rather than overwriting); the
-    throttle clock only resets on a real write so `maybe_save` stays
-    truthful."""
+    Returns whether a checkpoint was written and finalized. A step
+    that already exists is skipped (returns False, even with
+    force=True — Orbax raises StepAlreadyExistsError rather than
+    overwriting); the throttle clock only resets on a real write so
+    `maybe_save` stays truthful.
+
+    This blocks on `wait_until_finished` so save-side errors surface
+    HERE (logged + recorded on `save_errors`/`last_save_error`)
+    instead of getting lost until close(); a failed save does not
+    raise — older retained steps still cover a restore, which is the
+    integrity ladder's whole point. Only a save that completed without
+    error advances the LAST_GOOD marker, so 'restorable' and 'newest'
+    stay distinguishable (restore_last_good reads the marker)."""
     if step is None:
       step = int(jax.device_get(state.update_steps))
     if step in self._manager.all_steps():
       return False  # force=True raises StepAlreadyExistsError otherwise
     saved = bool(self._manager.save(
         step, args=ocp.args.StandardSave(state), force=force))
-    if saved:
-      self._last_save_time = time.monotonic()
-    return saved
+    if not saved:
+      return False
+    # Fault-injection site (runtime/faults.py 'checkpoint_save'): a
+    # fired fault simulates the process dying mid-write — the step's
+    # files are damaged on disk and the marker does NOT advance.
+    fault = faults_lib.fire('checkpoint_save')
+    try:
+      self._manager.wait_until_finished()
+    except Exception as e:
+      # Throttle clock deliberately NOT reset on this path: the next
+      # maybe_save retries immediately instead of training another
+      # full save_interval_secs with no checkpoint after a transient
+      # storage blip.
+      self.save_errors += 1
+      self.last_save_error = e
+      log.exception(
+          'checkpoint save at step %d FAILED to finalize (marker not '
+          'advanced; older retained steps remain restorable)', step)
+      return False
+    self._last_save_time = time.monotonic()
+    if fault is not None:
+      damaged = faults_lib.corrupt_checkpoint_step(self._directory,
+                                                   step)
+      self.save_errors += 1
+      self.last_save_error = faults_lib.InjectedFault(
+          f'checkpoint_save interrupted at step {step}')
+      log.warning('injected checkpoint-save interrupt at step %d '
+                  '(%d files damaged, LAST_GOOD not advanced)', step,
+                  len(damaged))
+      return True
+    self._mark_last_good(step)
+    return True
+
+  def _mark_last_good(self, step: int) -> None:
+    """Atomically advance the LAST_GOOD marker (tmp + rename): only a
+    save that verifiably finished earns it. Multi-host: process 0
+    writes (shared checkpoint dirs must have one writer — same
+    convention as the driver's config.json)."""
+    if jax.process_index() != 0:
+      return
+    tmp = self._last_good_path + '.tmp'
+    try:
+      with open(tmp, 'w') as f:
+        json.dump({'step': int(step),
+                   'wall_time': round(time.time(), 3)}, f)
+      os.replace(tmp, self._last_good_path)
+    except OSError:
+      log.exception('could not write LAST_GOOD marker for step %d',
+                    step)
+
+  def last_good_step(self) -> Optional[int]:
+    """The step the LAST_GOOD marker names, if it is still retained
+    (pruning can outrun the marker on long runs); None otherwise."""
+    try:
+      with open(self._last_good_path) as f:
+        step = int(json.load(f)['step'])
+    except (OSError, ValueError, KeyError, TypeError):
+      return None
+    return step if step in self._manager.all_steps() else None
 
   def should_save(self) -> bool:
     """Whether the save interval has elapsed (host-local wall clock).
@@ -149,17 +239,99 @@ class Checkpointer:
   def latest_step(self) -> Optional[int]:
     return self._manager.latest_step()
 
+  def _restore_ladder(self, steps: List[int], restore_fn
+                      ) -> Tuple[Optional[object], Optional[int]]:
+    """Try `restore_fn(step)` down the given step list (newest first).
+
+    The integrity ladder: a corrupt/partial step is logged and the
+    previous retained step is tried (the dead-end `restore_latest`
+    used to hit on a save interrupted mid-write); a STRUCTURE mismatch
+    raises immediately with the config-flag guidance — older steps
+    were written by the same config, so falling back cannot help and
+    would only bury the real cause. Exhausting every step raises with
+    the corruption guidance for the newest failure."""
+    last_err: Optional[Tuple[int, BaseException]] = None
+    for tried, step in enumerate(steps):
+      try:
+        restored = restore_fn(step)
+      except Exception as e:
+        if _looks_structural(e):
+          _wrap_structure_error(e, self._directory, step)
+        log.warning(
+            'checkpoint step %d failed to restore (%s: %s); falling '
+            'back to the previous retained step', step,
+            type(e).__name__, e)
+        if last_err is None:
+          last_err = (step, e)
+        continue
+      if tried:
+        self.restore_fallbacks += tried
+        log.warning('restored checkpoint step %d after %d newer '
+                    'corrupt/partial step(s)', step, tried)
+      return restored, step
+    _wrap_structure_error(last_err[1], self._directory, last_err[0])
+
   def restore_latest(self, target: TrainState) -> Optional[TrainState]:
-    """Restore the most recent checkpoint, or None if none exists.
+    """Restore the most recent RESTORABLE checkpoint, or None if none
+    exists. A corrupt/partial newest step falls back through older
+    retained steps (see `_restore_ladder`).
 
     `target` is a concrete (or abstract shape/dtype/sharding) TrainState
     matching the saved structure — build it with `make_train_state` on
     the right mesh first; restored arrays land on the same placements.
     """
-    step = self._manager.latest_step()
-    if step is None:
+    steps = sorted(self._manager.all_steps(), reverse=True)
+    if not steps:
       return None
+    restored, _ = self._restore_ladder(
+        steps, self._make_full_restore_fn(target))
+    return restored
 
+  def restore_last_good(self, target: TrainState
+                        ) -> Optional[TrainState]:
+    """Rollback restore (health.py's escalation ladder): the step the
+    LAST_GOOD marker names first — 'known restorable', not merely
+    'newest' — then every other retained step, newest first. None when
+    nothing is restorable at all (the driver then halts)."""
+    steps = sorted(self._manager.all_steps(), reverse=True)
+    good = self.last_good_step()
+    if good is not None:
+      steps = [good] + [s for s in steps if s != good]
+    if not steps:
+      return None
+    try:
+      restored, step = self._restore_ladder(
+          steps, self._make_full_restore_fn(target))
+    except CheckpointStructureError:
+      log.exception('rollback restore failed on every retained step')
+      return None
+    log.info('rolled back to checkpoint step %d', step)
+    return restored
+
+  def rollback_step_choice(self) -> int:
+    """The step a rollback SHOULD restore: last-known-good, else the
+    newest retained, else -1 (nothing restorable). Multi-host rollback
+    coordination: process 0's choice is broadcast and every host
+    restores exactly that step via `restore_step` — the per-host
+    ladder could diverge on host-local I/O errors, and a sharded
+    restore is a cross-process collective that deadlocks if hosts
+    enter it with different steps."""
+    good = self.last_good_step()
+    if good is not None:
+      return good
+    steps = self._manager.all_steps()
+    return max(steps) if steps else -1
+
+  def restore_step(self, step: int, target: TrainState) -> TrainState:
+    """Single-step restore, NO ladder (the multi-host rollback path:
+    every host must attempt the SAME step; a failure raises on all
+    hosts together — the same exposure as the startup restore)."""
+    try:
+      return self._make_full_restore_fn(target)(step)
+    except Exception as e:
+      _wrap_structure_error(e, self._directory, step)
+
+  def _make_full_restore_fn(self, target: TrainState):
     def to_abstract(x):
       # Pin the TARGET's sharding so restored leaves land exactly on
       # its placements (mesh-sharded or single-device alike).
@@ -169,11 +341,8 @@ class Checkpointer:
       return ocp.utils.to_shape_dtype_struct(x)
 
     abstract = jax.tree_util.tree_map(to_abstract, target)
-    try:
-      return self._manager.restore(
-          step, args=ocp.args.StandardRestore(abstract))
-    except (ValueError, KeyError, TypeError) as e:
-      _wrap_structure_error(e, self._directory, step)
+    return lambda step: self._manager.restore(
+        step, args=ocp.args.StandardRestore(abstract))
 
   def restore_latest_params(self, params, make_state):
     """Restore ONLY params (+ the update_steps counter) from the latest
@@ -193,34 +362,47 @@ class Checkpointer:
       make_state: params → TrainState (e.g. a make_train_state
         closure); evaluated under eval_shape only.
     """
-    step = self._manager.latest_step()
-    if step is None:
+    steps = sorted(self._manager.all_steps(), reverse=True)
+    if not steps:
       return None
 
     abstract = jax.eval_shape(make_state, params)
     as_abstract = lambda c: jax.ShapeDtypeStruct(  # noqa: E731
         c.shape, c.dtype, sharding=c.sharding)
     dev_sharding = jax.tree_util.tree_leaves(params)[0].sharding
-    placeholder = lambda t: jax.tree_util.tree_map(  # noqa: E731
-        lambda _: ocp.PLACEHOLDER, t)
-    target = abstract._replace(
-        params=jax.tree_util.tree_map(as_abstract, params),
-        update_steps=jax.ShapeDtypeStruct(
-            abstract.update_steps.shape, abstract.update_steps.dtype,
-            sharding=dev_sharding),
-        opt_state=placeholder(abstract.opt_state),
-        popart=placeholder(abstract.popart))
+    if hasattr(ocp, 'PLACEHOLDER'):
+      placeholder = lambda t: jax.tree_util.tree_map(  # noqa: E731
+          lambda _: ocp.PLACEHOLDER, t)
+      target = abstract._replace(
+          params=jax.tree_util.tree_map(as_abstract, params),
+          update_steps=jax.ShapeDtypeStruct(
+              abstract.update_steps.shape, abstract.update_steps.dtype,
+              sharding=dev_sharding),
+          opt_state=placeholder(abstract.opt_state),
+          popart=placeholder(abstract.popart))
+    else:
+      # Orbax builds without PLACEHOLDER (< 0.9): restore the FULL
+      # abstract state and drop everything but params/update_steps.
+      # The optimizer moments materialize for the duration of the call
+      # (≈2× params of transient HBM) — a documented availability-
+      # over-optimization fallback: a dead eval path is a failure
+      # domain too. Non-params leaves land on the params' placements.
+      target = jax.tree_util.tree_map(
+          lambda c: jax.ShapeDtypeStruct(c.shape, c.dtype,
+                                         sharding=dev_sharding),
+          abstract)
+      target = target._replace(
+          params=jax.tree_util.tree_map(as_abstract, params))
     # PLACEHOLDER is a PyTreeRestore feature (StandardRestore rejects
     # it), and a manager that already did a StandardSave has its item
     # handler pinned — restore through a FRESH manager so the step
-    # layout stays Orbax's concern, not ours.
+    # layout stays Orbax's concern, not ours. Same integrity ladder as
+    # restore_latest: eval must survive a corrupt newest step too.
     manager = ocp.CheckpointManager(self._directory)
     try:
-      try:
-        restored = manager.restore(step,
-                                   args=ocp.args.PyTreeRestore(target))
-      except (ValueError, KeyError, TypeError) as e:
-        _wrap_structure_error(e, self._directory, step)
+      restored, _ = self._restore_ladder(
+          steps, lambda step: manager.restore(
+              step, args=ocp.args.PyTreeRestore(target)))
     finally:
       manager.close()
     return restored.params, int(jax.device_get(restored.update_steps))

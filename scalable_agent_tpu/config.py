@@ -155,6 +155,29 @@ class Config:
   # the ack run here, off the per-connection reader threads).
   # 0 = auto (min(4, cpu count)).
   ingest_workers: int = 0
+  # --- Learner failure domain (health.py, round 7). ---
+  # Training-health watchdog: the train step skips non-finite updates
+  # on device (params carry over unchanged) and the driver escalates
+  # bad steps: skip-and-count → rollback to the last-known-good
+  # checkpoint after `health_rollback_after` consecutive bad steps →
+  # halt with a diagnostic bundle after `health_max_rollbacks`
+  # rollbacks. False removes the in-graph guard and the host monitor
+  # entirely (exact pre-round-7 step semantics).
+  health_watchdog: bool = True
+  # Host-side sentinel read cadence. The read is ONE-STEP DELAYED
+  # (the stacked scalars of step N are fetched after step N+1 was
+  # dispatched, so the device_get reads completed values instead of
+  # syncing the dispatch pipeline); the device-side skip protects
+  # params regardless of cadence — this only bounds rollback/halt
+  # latency.
+  health_check_every_steps: int = 1
+  health_window: int = 64                 # retained recent checks
+  health_min_window: int = 16             # samples before relative
+                                          # detectors arm
+  health_rollback_after: int = 5          # K consecutive bad steps
+  health_max_rollbacks: int = 3           # then halt
+  health_loss_explosion_factor: float = 100.0
+  health_sigma_divergence_factor: float = 10.0
 
   @property
   def frames_per_step(self):

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax.experimental import multihost_utils
 
 from scalable_agent_tpu import checkpoint as checkpoint_lib
+from scalable_agent_tpu import health as health_lib
 from scalable_agent_tpu import learner as learner_lib
 from scalable_agent_tpu import observability
 from scalable_agent_tpu.config import Config
@@ -44,6 +45,7 @@ from scalable_agent_tpu.envs import factory, suites
 from scalable_agent_tpu.models import ImpalaAgent, init_params
 from scalable_agent_tpu.parallel import mesh as mesh_lib
 from scalable_agent_tpu.parallel import train_parallel
+from scalable_agent_tpu.runtime import faults as faults_lib
 from scalable_agent_tpu.runtime import ring_buffer
 from scalable_agent_tpu.runtime.actor import Actor
 from scalable_agent_tpu.runtime.fleet import ActorFleet
@@ -98,6 +100,9 @@ def make_fleet(config: Config, agent, policy, buffer, levels,
                                  is_test=is_test)
     env, process = factory.build_environment(
         spec, use_py_process=config.use_py_process)
+    # Fault-injection seam (runtime/faults.py): identity unless an
+    # installed plan targets env_step.
+    env = faults_lib.maybe_wrap_env(env)
     actor = Actor(env, policy, agent.initial_state(1),
                   unroll_length=config.unroll_length,
                   num_action_repeats=config.num_action_repeats,
@@ -158,7 +163,8 @@ class TrainRun:
   """All live objects of a training run (for inspection/tests)."""
 
   def __init__(self, config, agent, state, fleet, prefetcher, server,
-               checkpointer, writer, stats, fps_meter, ingest=None):
+               checkpointer, writer, stats, fps_meter, ingest=None,
+               health=None):
     self.config = config
     self.agent = agent
     self.state = state
@@ -170,6 +176,7 @@ class TrainRun:
     self.stats = stats
     self.fps_meter = fps_meter
     self.ingest = ingest
+    self.health = health  # HealthMonitor (None when watchdog is off)
 
   @property
   def frames(self) -> int:
@@ -301,6 +308,7 @@ def train(config: Config, max_steps: Optional[int] = None,
   fleet = None
   prefetcher = None
   writer = None
+  incidents = None
   try:
     # --- Trajectory buffer + remote ingest, BEFORE inference warmup:
     # remote actor hosts connect and fetch params while this host
@@ -395,6 +403,13 @@ def train(config: Config, max_steps: Optional[int] = None,
                     else f'summaries_p{process_index}.jsonl')
     writer = observability.SummaryWriter(config.logdir,
                                          filename=summary_name)
+    # Structured incident stream (observability.EventLog): bad-step
+    # bursts, rollbacks, halts, fault injections — what the scalar
+    # summaries can't narrate. chaos.py reads this for its SLOs.
+    incidents = observability.EventLog(
+        config.logdir,
+        filename=('incidents.jsonl' if process_index == 0
+                  else f'incidents_p{process_index}.jsonl'))
     # Reproducibility: the exact config of every run lives next to its
     # checkpoints/summaries (the reference leaves flags only in shell
     # history).
@@ -408,9 +423,17 @@ def train(config: Config, max_steps: Optional[int] = None,
                    if config.level_name in suites.SUITES else None),
         writer=writer)
     fps_meter = observability.FpsMeter()
+    # Training-health watchdog (health.py): the device-side guard in
+    # the train step already skips non-finite updates; this host
+    # monitor escalates — skip-and-count → rollback → halt. Verdicts
+    # are a deterministic function of the (replicated) step metrics,
+    # so multi-host processes reach rollback/halt decisions in
+    # lockstep — the rollback restore stays a valid collective.
+    health = (health_lib.monitor_from_config(config)
+              if config.health_watchdog else None)
     run = TrainRun(config, agent, state, fleet, prefetcher, server,
                    checkpointer, writer, stats, fps_meter,
-                   ingest=ingest)
+                   ingest=ingest, health=health)
     fleet.start()
   except BaseException:
     # Best-effort bounded teardown, most-critical-first: the ingest
@@ -437,12 +460,20 @@ def train(config: Config, max_steps: Optional[int] = None,
       _try(lambda: fleet.stop(timeout=2.0))
     if writer is not None:
       _try(writer.close)
+    if incidents is not None:
+      _try(incidents.close)
     _try(checkpointer.close)
     raise
 
   steps_done = 0
   profiling = False
   errors: List[BaseException] = []
+  # Watchdog loop state: the stashed (step, SentinelHandle) awaiting
+  # its delayed read, and the bad-step count of the current burst
+  # (driver-side: the monitor's consecutive counter resets on
+  # rollback, so it cannot bracket bursts).
+  pending_sentinel = None
+  bad_count_in_burst = 0
   action_counts_acc = np.zeros((num_actions,), np.int64)
   last_remote_publish = float('-inf')
   last_inference_snap = {'calls': 0, 'requests': 0}
@@ -501,6 +532,15 @@ def train(config: Config, max_steps: Optional[int] = None,
           jax.profiler.stop_trace()
           profiling = False
           log.info('profiler trace written to %s', config.profile_dir)
+      # Fault-injection seam (runtime/faults.py 'nan_burst'): rewards
+      # become NaN on the staged device batch, driving a non-finite
+      # loss through the REAL loss/grad path — what organic divergence
+      # looks like to the watchdog.
+      batch_device, poisoned = faults_lib.maybe_poison_batch(
+          batch_device)
+      if poisoned:
+        incidents.event('fault_nan_burst',
+                        step=steps_done + _initial_steps + 1)
       state, metrics = train_step(run.state, batch_device)
       run.state = state
       steps_done += 1
@@ -514,6 +554,93 @@ def train(config: Config, max_steps: Optional[int] = None,
           stats_view, step_now):
         log.info('episode %s return=%.2f frames=%d', name, ep_return,
                  ep_frames)
+
+      # --- Escalation ladder (health.py): skip-and-count (the device
+      # guard already withheld a non-finite update) → roll back to the
+      # last-known-good checkpoint after K consecutive bad steps →
+      # halt with a diagnostic bundle instead of training through
+      # divergence. The sentinel read is ONE-STEP DELAYED: step N's
+      # stacked scalars are fetched after step N+1 was dispatched, so
+      # the device_get reads already-computed values instead of
+      # syncing the dispatch pipeline every step (per-step coverage at
+      # zero sync cost; the in-graph skip protects params with no
+      # latency either way). ---
+      if health is not None:
+        prev_sentinel = pending_sentinel
+        pending_sentinel = None
+        if steps_done % config.health_check_every_steps == 0:
+          pending_sentinel = (step_now,
+                              health_lib.stack_sentinels(metrics))
+      if health is not None and prev_sentinel is not None:
+        obs_step, handle = prev_sentinel
+        verdict = health.observe_values(
+            obs_step, health_lib.read_handle(handle))
+        # Burst bracketing is driver-side state: the monitor resets
+        # its consecutive count on a ROLLBACK verdict, so 'burst
+        # ended' must be judged by verdicts, not that counter (a
+        # burst whose length is an exact multiple of K would
+        # otherwise never emit health_recovered).
+        bad_count_in_burst += (verdict != health_lib.OK)
+        if verdict != health_lib.OK and bad_count_in_burst == 1:
+          incidents.event('health_bad_burst_start', step=obs_step,
+                          reason=health.last_reason)
+          log.warning('unhealthy training step %d: %s', obs_step,
+                      health.last_reason)
+        elif verdict == health_lib.OK and bad_count_in_burst > 0:
+          incidents.event('health_recovered', step=obs_step,
+                          bad_steps=bad_count_in_burst)
+          bad_count_in_burst = 0
+        if verdict == health_lib.ROLLBACK:
+          if num_processes == 1:
+            rolled = checkpointer.restore_last_good(state)
+          else:
+            # Hosts must enter the (collective) restore with the SAME
+            # step: the per-host ladder could diverge on host-local
+            # I/O errors. Process 0 chooses; everyone follows — the
+            # broadcast is safe here because verdicts are a
+            # deterministic function of the replicated metrics, so
+            # every host reaches this branch in lockstep.
+            choice = int(multihost_utils.broadcast_one_to_all(
+                jnp.asarray(checkpointer.rollback_step_choice(),
+                            jnp.int32)))
+            rolled = (checkpointer.restore_step(choice, state)
+                      if choice >= 0 else None)
+          if rolled is None:
+            verdict = health_lib.HALT
+            health.rollbacks -= 1  # granted but could not be honored
+            health.last_reason = (f'{health.last_reason}; rollback '
+                                  'requested but no restorable '
+                                  'checkpoint exists')
+          else:
+            # Keep the CURRENT update counter: frames/steps count
+            # consumed env data and must stay monotone through a
+            # rollback (checkpoint step numbers and the LR schedule
+            # never move backwards; only params/opt/popart revert).
+            restored_step = int(jax.device_get(rolled.update_steps))
+            state = rolled._replace(update_steps=state.update_steps)
+            run.state = state
+            published = actor_params(state.params)
+            server.update_params(published)
+            if ingest is not None:
+              ingest.publish_params(jax.device_get(published))
+            incidents.event('rollback', step=step_now,
+                            restored_checkpoint_step=restored_step,
+                            reason=health.last_reason)
+            log.warning(
+                'health rollback at step %d: restored checkpoint '
+                'step %d (params/optimizer/popart revert; step '
+                'counter keeps running)', step_now, restored_step)
+        if verdict == health_lib.HALT:
+          bundle = health.write_halt_bundle(
+              config.logdir, config, step_now,
+              reason=health.last_reason)
+          incidents.event('health_halt', step=step_now,
+                          reason=health.last_reason, bundle=bundle)
+          raise health_lib.TrainingDivergence(
+              f'training halted at step {step_now} after '
+              f'{health.rollbacks} rollback escalation(s): '
+              f'{health.last_reason}. Diagnostic bundle: {bundle}',
+              bundle_path=bundle)
 
       if steps_done % config.publish_params_every == 0:
         # actor_params is a cross-host collective in multi-host-TP
@@ -543,10 +670,28 @@ def train(config: Config, max_steps: Optional[int] = None,
             {k: float(jax.device_get(v)) for k, v in metrics.items()},
             step_now)
         writer.scalar('env_frames_per_sec', fps_meter.fps(), step_now)
-        fleet_stats = fleet.stats()
+        fleet_stats = fleet.stats(
+            healthy_horizon_secs=(stall_timeout_secs
+                                  if stall_timeout_secs else 60.0))
         writer.scalar('actors_alive', fleet_stats['alive'], step_now)
+        # alive vs healthy (round 7): a wedged actor is alive without
+        # producing — the quorum fraction is the honest fleet signal.
+        writer.scalar('actors_healthy', fleet_stats['healthy'],
+                      step_now)
+        writer.scalar('fleet_healthy_fraction',
+                      fleet_stats['healthy_fraction'], step_now)
         writer.scalar('actor_respawns', fleet_stats['respawns'],
                       step_now)
+        # Learner failure-domain counters (health.py / checkpoint.py).
+        if health is not None:
+          hs = health.stats()
+          writer.scalar('skipped_steps', hs['skipped_steps'], step_now)
+          writer.scalar('flagged_steps', hs['flagged_steps'], step_now)
+          writer.scalar('rollbacks', hs['rollbacks'], step_now)
+        writer.scalar('checkpoint_save_errors',
+                      checkpointer.save_errors, step_now)
+        writer.scalar('checkpoint_restore_fallbacks',
+                      checkpointer.restore_fallbacks, step_now)
         # Buffer occupancy: ~0 means the learner is starved (env/
         # inference bound); ~capacity means actors are throttled by
         # backpressure (learner bound).
@@ -587,6 +732,10 @@ def train(config: Config, max_steps: Optional[int] = None,
           # decides severity), so without this counter a host whose
           # every unroll is being refused is invisible here.
           writer.scalar('remote_rejected', ing['rejected'], step_now)
+          # Connections dropped for unparseable/garbage frames — the
+          # wire-level quarantine (a corrupting peer must not be able
+          # to take the learner down, only itself).
+          writer.scalar('quarantined', ing['quarantined'], step_now)
           # Per-lane transport counters (round 6). Ack latency is the
           # end-to-end backpressure signal remote pumps feel; the
           # per-connection rate spread separates one starved host
@@ -620,15 +769,41 @@ def train(config: Config, max_steps: Optional[int] = None,
       # checkpoint_check_every_steps — the cadence check itself must
       # not tax the hot loop (at worst the save lands that many steps
       # late, noise against checkpoint_secs=600).
+      # Saves are WITHHELD mid-burst: finite divergence (loss
+      # explosion) mutates params every step, and saving them would
+      # both advance LAST_GOOD onto the diverged state (making the
+      # rollback a no-op) and evict the healthy retained steps the
+      # rollback needs. The gate is lockstep across hosts (verdicts
+      # are a function of the replicated metrics).
+      healthy_now = health is None or (bad_count_in_burst == 0)
       if num_processes == 1:
-        checkpointer.maybe_save(state)
+        if healthy_now:
+          checkpointer.maybe_save(state)
       elif steps_done % config.checkpoint_check_every_steps == 0:
         decision = bool(multihost_utils.broadcast_one_to_all(
-            jnp.asarray(checkpointer.should_save())))
+            jnp.asarray(checkpointer.should_save()))) and healthy_now
         checkpointer.maybe_save(state, decision=decision)
       fleet.check_health(stall_timeout_secs=stall_timeout_secs)
   finally:
     exiting_clean = sys.exc_info()[0] is None
+    # One robustness roll-up while the fleet still runs (stats after
+    # stop() would read an all-dead fleet): what the run's failure
+    # domain absorbed, in the same counters the summaries carry.
+    try:
+      fleet_stats = fleet.stats(
+          healthy_horizon_secs=(stall_timeout_secs
+                                if stall_timeout_secs else 60.0))
+      hs = health.stats() if health is not None else {}
+      ing_q = ingest.stats()['quarantined'] if ingest is not None else 0
+      log.info(
+          'robustness summary: skipped_steps=%d rollbacks=%d '
+          'quarantined=%d respawns=%d fleet_healthy_fraction=%.2f '
+          'checkpoint_save_errors=%d restore_fallbacks=%d',
+          hs.get('skipped_steps', 0), hs.get('rollbacks', 0), ing_q,
+          fleet_stats['respawns'], fleet_stats['healthy_fraction'],
+          checkpointer.save_errors, checkpointer.restore_fallbacks)
+    except Exception:
+      log.exception('robustness summary failed')
     if profiling:
       jax.profiler.stop_trace()
     elif (config.profile_dir and
@@ -653,8 +828,18 @@ def train(config: Config, max_steps: Optional[int] = None,
       # host-local exception, other hosts are still inside the
       # collective train step — entering the Orbax barrier here would
       # deadlock the job instead of surfacing the error; periodic
-      # checkpoints cover the tail.
-      if num_processes == 1 or exiting_clean:
+      # checkpoints cover the tail. An UNHEALTHY exit (divergence
+      # halt, or any unwind mid-bad-burst) must not save either:
+      # finite divergence mutates params, and checkpointing them here
+      # would advance LAST_GOOD onto the diverged state and evict the
+      # healthy steps — the restarted run would restore the poison
+      # and halt again, a crash loop with no way back.
+      unhealthy_exit = health is not None and bad_count_in_burst > 0
+      if unhealthy_exit:
+        log.warning('skipping final checkpoint: training was '
+                    'unhealthy at exit (the retained last-known-good '
+                    'checkpoint covers the resume)')
+      elif num_processes == 1 or exiting_clean:
         checkpointer.save(run.state, force=True)
       else:
         log.warning('skipping final collective checkpoint on '
@@ -662,6 +847,7 @@ def train(config: Config, max_steps: Optional[int] = None,
     finally:
       checkpointer.close()
       writer.close()
+      incidents.close()
   return run
 
 

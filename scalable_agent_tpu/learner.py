@@ -247,6 +247,25 @@ def make_train_step_fn(agent, config: Config):
       sig = popart_lib.sigma(new_popart)
       metrics['popart_sigma_min'] = jnp.min(sig)
       metrics['popart_sigma_max'] = jnp.max(sig)
+    if config.health_watchdog:
+      # Device-side sentinel + skip (health.py): a non-finite loss or
+      # grad norm means this update would poison the params — keep the
+      # old state wholesale instead. One `where` per leaf; identity on
+      # healthy steps, no host sync. The step counter still advances
+      # (the batch's frames were consumed either way), so the
+      # step/frame accounting stays monotone through skips.
+      step_ok = (jnp.isfinite(total_loss) &
+                 jnp.isfinite(metrics['grad_norm']))
+
+      def keep(new, old):
+        return jax.tree_util.tree_map(
+            lambda n, o: jnp.where(step_ok, n, o), new, old)
+
+      new_params = keep(new_params, state.params)
+      new_opt_state = keep(new_opt_state, state.opt_state)
+      if new_popart is not None:
+        new_popart = keep(new_popart, state.popart)
+      metrics['step_ok'] = step_ok.astype(jnp.float32)
     new_state = TrainState(new_params, new_opt_state,
                            state.update_steps + 1, new_popart)
     metrics['learning_rate'] = schedule(state.update_steps)

@@ -28,10 +28,12 @@ import numpy as np
 from scalable_agent_tpu.envs import suites
 
 
-class SummaryWriter:
-  """Append-only JSONL scalar writer (thread-safe)."""
+class _JsonlAppender:
+  """Shared line-buffered append-only JSONL plumbing (thread-safe):
+  the one place that owns open/lock/write-line/close for both the
+  scalar summaries and the incident stream."""
 
-  def __init__(self, logdir: str, filename: str = 'summaries.jsonl'):
+  def __init__(self, logdir: str, filename: str):
     os.makedirs(logdir, exist_ok=True)
     self._path = os.path.join(logdir, filename)
     self._file = open(self._path, 'a', buffering=1)
@@ -41,11 +43,24 @@ class SummaryWriter:
   def path(self):
     return self._path
 
-  def scalar(self, tag: str, value, step: int):
-    event = {'wall_time': round(time.time(), 3), 'step': int(step),
-             'tag': tag, 'value': float(value)}
+  def _write(self, record: dict, **dumps_kwargs):
     with self._lock:
-      self._file.write(json.dumps(event) + '\n')
+      self._file.write(json.dumps(record, **dumps_kwargs) + '\n')
+
+  def close(self):
+    with self._lock:
+      self._file.close()
+
+
+class SummaryWriter(_JsonlAppender):
+  """Append-only JSONL scalar writer (thread-safe)."""
+
+  def __init__(self, logdir: str, filename: str = 'summaries.jsonl'):
+    super().__init__(logdir, filename)
+
+  def scalar(self, tag: str, value, step: int):
+    self._write({'wall_time': round(time.time(), 3),
+                 'step': int(step), 'tag': tag, 'value': float(value)})
 
   def scalars(self, values: Dict[str, float], step: int):
     for tag, value in values.items():
@@ -64,12 +79,30 @@ class SummaryWriter:
              'counts': [int(c) for c in np.asarray(counts).ravel()]}
     if edges is not None:
       event['edges'] = [float(e) for e in np.asarray(edges).ravel()]
-    with self._lock:
-      self._file.write(json.dumps(event) + '\n')
+    self._write(event)
 
-  def close(self):
-    with self._lock:
-      self._file.close()
+
+class EventLog(_JsonlAppender):
+  """Append-only JSONL of structured INCIDENT events (thread-safe).
+
+  Scalar summaries answer 'how much'; during a failure the operator
+  (and scripts/chaos.py's SLO asserts) need 'what happened when':
+  bad-step bursts, checkpoint rollbacks, watchdog halts, fault
+  injections. One object per line — {wall_time, kind, step, ...} —
+  in `incidents.jsonl` next to the summaries. Quiet runs produce an
+  empty (or absent) file; the log is written on incident, not on a
+  cadence.
+  """
+
+  def __init__(self, logdir: str, filename: str = 'incidents.jsonl'):
+    super().__init__(logdir, filename)
+
+  def event(self, kind: str, step: Optional[int] = None, **fields):
+    record = {'wall_time': round(time.time(), 3), 'kind': str(kind)}
+    if step is not None:
+      record['step'] = int(step)
+    record.update(fields)
+    self._write(record, default=str)
 
 
 class FpsMeter:

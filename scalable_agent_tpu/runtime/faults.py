@@ -1,0 +1,347 @@
+"""Deterministic cross-layer fault injection.
+
+The robustness layer (health watchdog, checkpoint integrity ladder,
+fleet respawn, transport reconnect) is only trustworthy if its failure
+paths EXECUTE — in CI, deterministically, not just in a post-mortem.
+This module is the one place that knows how to break the pipeline on
+purpose:
+
+- `FaultPlan`: a seedable schedule of `Fault`s keyed by (site, event
+  index). Each injection site keeps a monotone event counter; a fault
+  fires when the counter hits its index. Same plan + same workload ⇒
+  same faults, every run (`scripts/chaos.py` asserts recovery SLOs on
+  top of this).
+- Injection sites threaded through the real code paths (no mocks — the
+  production error handling is what executes):
+
+    env_step          FaultyEnv wrapper (driver.make_fleet wraps when a
+                      plan covers the site): 'raise' kills the actor
+                      (fleet must respawn), 'hang' wedges it for
+                      `param` seconds (stall detection must respawn).
+    transport_send    RemoteActorClient._rpc: 'drop' closes the socket,
+                      'garbage'/'truncate' first ship a corrupt frame
+                      the learner's ingest must survive (and
+                      quarantine), then drop. All surface as OSError so
+                      the actor's reconnect/backoff path runs.
+    checkpoint_save   Checkpointer.save: the just-written newest step
+                      is corrupted on disk and the last-known-good
+                      marker is NOT advanced — a save interrupted
+                      mid-write. `restore_latest` must fall back.
+    nan_burst         driver.train: the staged batch's rewards become
+                      NaN for the step — the loss/grads go non-finite
+                      and the learner's device-side guard + watchdog
+                      ladder must skip/roll back.
+
+The plan is installed process-globally (`install`/`clear`); sites are
+consulted via `fire(site)` which is a no-op returning None when no
+plan is active (zero overhead on production paths). Multi-process
+topologies (remote actor children) ship the plan through the
+`SA_FAULT_PLAN` env var as JSON (`to_json`/`from_json`) and install it
+themselves at startup.
+
+Determinism note: event counters are global per site. When several
+actor threads share a site ('env_step'), WHICH thread draws the firing
+index depends on scheduling, but the NUMBER and KIND of faults fired
+is exactly the schedule — the property the chaos SLOs assert on.
+"""
+
+import dataclasses
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+SITES = ('env_step', 'transport_send', 'checkpoint_save', 'nan_burst')
+
+_LEN = struct.Struct('>Q')
+
+
+class InjectedFault(RuntimeError):
+  """An exception raised by fault injection (never by real code) —
+  recovery paths can tell scripted damage from organic failures."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+  site: str    # one of SITES
+  index: int   # the site's event counter value at which to fire
+  kind: str    # site-specific: raise|hang|drop|garbage|truncate|
+               # interrupt|nan
+  param: float = 0.0  # kind-specific (hang seconds, ...)
+
+  def __post_init__(self):
+    if self.site not in SITES:
+      raise ValueError(f'unknown fault site {self.site!r} '
+                       f'(sites: {SITES})')
+
+
+class FaultPlan:
+  """A deterministic schedule of faults + per-site event counters.
+
+  Thread-safe: `fire` is called from actor threads, the learner loop,
+  and checkpoint saves concurrently.
+  """
+
+  def __init__(self, faults: List[Fault], seed: int = 0):
+    self._seed = int(seed)
+    self._table: Dict[str, Dict[int, Fault]] = {}
+    for f in faults:
+      self._table.setdefault(f.site, {})[int(f.index)] = f
+    self._counters: Dict[str, int] = {site: 0 for site in SITES}
+    self._fired: Dict[str, int] = {site: 0 for site in SITES}
+    self._lock = threading.Lock()
+
+  @property
+  def seed(self) -> int:
+    return self._seed
+
+  def faults(self) -> List[Fault]:
+    return sorted((f for per in self._table.values()
+                   for f in per.values()),
+                  key=lambda f: (f.site, f.index))
+
+  def covers(self, site: str) -> bool:
+    """Whether any fault targets `site` (drives e.g. whether envs get
+    wrapped at all — uncovered sites stay zero-cost)."""
+    return bool(self._table.get(site))
+
+  def fire(self, site: str) -> Optional[Fault]:
+    """Advance `site`'s event counter; return the fault scheduled at
+    the pre-advance index, if any."""
+    with self._lock:
+      idx = self._counters[site]
+      self._counters[site] = idx + 1
+      fault = self._table.get(site, {}).get(idx)
+      if fault is not None:
+        self._fired[site] += 1
+      return fault
+
+  def stats(self) -> Dict[str, Dict[str, int]]:
+    with self._lock:
+      return {site: {'events': self._counters[site],
+                     'fired': self._fired[site],
+                     'scheduled': len(self._table.get(site, {}))}
+              for site in SITES}
+
+  # --- serialization (cross-process: SA_FAULT_PLAN env var) ---
+
+  def to_json(self) -> str:
+    return json.dumps({'seed': self._seed,
+                       'faults': [dataclasses.asdict(f)
+                                  for f in self.faults()]})
+
+  @classmethod
+  def from_json(cls, payload: str) -> 'FaultPlan':
+    obj = json.loads(payload)
+    return cls([Fault(**f) for f in obj['faults']],
+               seed=obj.get('seed', 0))
+
+  @classmethod
+  def storm(cls, seed: int,
+            env_raise_at: Optional[int] = None,
+            env_hang_at: Optional[int] = None,
+            env_hang_secs: float = 3.0,
+            transport: Optional[List[str]] = None,
+            transport_start: int = 3,
+            transport_stride: int = 4,
+            nan_burst_at: Optional[int] = None,
+            nan_burst_len: int = 0,
+            checkpoint_interrupt_at: Optional[int] = None
+            ) -> 'FaultPlan':
+    """The scripted multi-fault storm chaos.py runs: one builder so
+    the schedule is a pure function of its arguments (+ seed, which
+    only perturbs garbage payload content, not the schedule)."""
+    faults: List[Fault] = []
+    if env_raise_at is not None:
+      faults.append(Fault('env_step', env_raise_at, 'raise'))
+    if env_hang_at is not None:
+      faults.append(Fault('env_step', env_hang_at, 'hang',
+                          param=env_hang_secs))
+    for i, kind in enumerate(transport or []):
+      faults.append(Fault('transport_send',
+                          transport_start + i * transport_stride, kind))
+    for i in range(nan_burst_len):
+      faults.append(Fault('nan_burst', (nan_burst_at or 0) + i, 'nan'))
+    if checkpoint_interrupt_at is not None:
+      faults.append(Fault('checkpoint_save', checkpoint_interrupt_at,
+                          'interrupt'))
+    return cls(faults, seed=seed)
+
+
+# --- process-global registry ---
+
+_active_lock = threading.Lock()
+_active: Optional[FaultPlan] = None
+
+PLAN_ENV_VAR = 'SA_FAULT_PLAN'
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+  global _active
+  with _active_lock:
+    _active = plan
+
+
+def clear() -> None:
+  install(None)
+
+
+def active() -> Optional[FaultPlan]:
+  return _active
+
+
+def install_from_env() -> Optional[FaultPlan]:
+  """Install the plan serialized in SA_FAULT_PLAN, if any (chaos.py's
+  remote-actor child calls this before run_remote_actor)."""
+  payload = os.environ.get(PLAN_ENV_VAR)
+  if not payload:
+    return None
+  plan = FaultPlan.from_json(payload)
+  install(plan)
+  return plan
+
+
+def fire(site: str) -> Optional[Fault]:
+  """Consult the active plan; None when no plan is installed (the
+  common production case — one global read, no lock)."""
+  plan = _active
+  if plan is None:
+    return None
+  return plan.fire(site)
+
+
+# --- site: env_step ---
+
+
+class FaultyEnv:
+  """Environment wrapper consulting the plan on every step.
+
+  'raise' propagates an InjectedFault out of env.step — exactly the
+  shape of an organic env crash (the fleet's respawn path runs).
+  'hang' sleeps `param` seconds while the step is in flight — the
+  shape of a wedged simulator (heartbeats go stale; stall detection
+  must orphan the thread and respawn the slot).
+  """
+
+  def __init__(self, env):
+    self._env = env
+
+  def initial(self):
+    return self._env.initial()
+
+  def step(self, action):
+    fault = fire('env_step')
+    if fault is not None:
+      if fault.kind == 'raise':
+        raise InjectedFault('env_step: injected crash')
+      if fault.kind == 'hang':
+        time.sleep(float(fault.param))
+      # unknown kinds fall through: a typo'd schedule should not
+      # silently change the no-fault behavior mid-run
+    return self._env.step(action)
+
+  def close(self):
+    return self._env.close()
+
+  def __getattr__(self, name):
+    return getattr(self._env, name)
+
+
+def maybe_wrap_env(env):
+  """Wrap `env` iff the active plan targets env_step (otherwise the
+  production object is returned untouched — zero indirection)."""
+  plan = _active
+  if plan is not None and plan.covers('env_step'):
+    return FaultyEnv(env)
+  return env
+
+
+# --- site: transport_send ---
+
+
+def apply_transport_fault(fault: Fault, sock: socket.socket,
+                          seed: int = 0) -> None:
+  """Damage `sock` per `fault` and raise the OSError the caller's
+  reconnect path expects. 'garbage' ships a well-framed message of
+  seeded random bytes (the receiver must fail parsing and quarantine
+  the connection, not crash); 'truncate' claims more bytes than it
+  sends (the receiver sees EOF mid-message); 'drop' just dies
+  mid-conversation."""
+  import numpy as np
+  try:
+    if fault.kind == 'garbage':
+      rng = np.random.RandomState((seed + fault.index) % (2 ** 31))
+      payload = rng.bytes(256)
+      sock.sendall(_LEN.pack(len(payload)) + payload)
+    elif fault.kind == 'truncate':
+      rng = np.random.RandomState((seed + fault.index) % (2 ** 31))
+      payload = rng.bytes(128)
+      sock.sendall(_LEN.pack(len(payload) * 4) + payload)
+    # 'drop' and unknown kinds: no bytes, just the close below.
+  except OSError:
+    pass  # the peer may already be gone; the raise below still runs
+  try:
+    sock.close()
+  except OSError:
+    pass
+  raise ConnectionError(
+      f'injected transport fault: {fault.kind} (index {fault.index})')
+
+
+# --- site: checkpoint_save ---
+
+
+def corrupt_checkpoint_step(directory: str, step: int) -> List[str]:
+  """Simulate a save killed mid-write: truncate every non-trivial file
+  of the step's directory to half its bytes (metadata/commit markers
+  are left in place, so the step still LISTS as the newest — the
+  dead-end `restore_latest` used to hit). Returns the damaged paths.
+  Shared by the checkpoint_save site and the checkpoint tests."""
+  step_dir = None
+  for name in os.listdir(directory):
+    path = os.path.join(directory, name)
+    if os.path.isdir(path) and name.split('.')[-1] == str(step):
+      step_dir = path
+      break
+    if os.path.isdir(path) and name == str(step):
+      step_dir = path
+      break
+  if step_dir is None:
+    raise FileNotFoundError(
+        f'no step directory for step {step} under {directory}')
+  damaged = []
+  for root, _, files in os.walk(step_dir):
+    for fname in files:
+      fpath = os.path.join(root, fname)
+      size = os.path.getsize(fpath)
+      if size >= 32:
+        with open(fpath, 'r+b') as f:
+          f.truncate(size // 2)
+        damaged.append(fpath)
+  return damaged
+
+
+# --- site: nan_burst ---
+
+
+def poison_batch(batch):
+  """Return `batch` with its rewards replaced by NaN (device-side op:
+  the batch is already staged). Drives a non-finite loss/grad through
+  the REAL loss, so the watchdog sees exactly what organic divergence
+  produces."""
+  import jax.numpy as jnp
+  env_outputs = batch.env_outputs._replace(
+      reward=jnp.full_like(batch.env_outputs.reward, jnp.nan))
+  return batch._replace(env_outputs=env_outputs)
+
+
+def maybe_poison_batch(batch):
+  """Consult the nan_burst site once (one learner step = one event);
+  poison when scheduled."""
+  fault = fire('nan_burst')
+  if fault is not None:
+    return poison_batch(batch), True
+  return batch, False

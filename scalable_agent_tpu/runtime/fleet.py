@@ -157,13 +157,33 @@ class ActorFleet:
     with self._lock:
       return [s.error for s in self._slots if s.error is not None]
 
-  def stats(self):
+  def stats(self, healthy_horizon_secs: float = 60.0):
+    """Fleet health counters.
+
+    `alive` counts slots whose CURRENT thread is running — but a
+    wedged actor (blocked in env.step) or one whose error hasn't been
+    collected yet is alive without producing, and a stalled thread
+    orphaned by respawn keeps running as a daemon invisibly. `healthy`
+    is the honest signal: the slot's current-generation thread is
+    alive, has no recorded error, AND heartbeat-fresh within
+    `healthy_horizon_secs` (align it with the driver's stall timeout).
+    `healthy_fraction` is the quorum the driver logs — the scheduler-
+    facing 'how much of my fleet is actually feeding' number.
+    """
+    now = time.monotonic()
     with self._lock:
+      alive = [s for s in self._slots
+               if s.thread is not None and s.thread.is_alive()]
+      healthy = [s for s in alive
+                 if s.error is None and
+                 now - s.last_heartbeat <= healthy_horizon_secs]
       return {
           'unrolls': sum(s.unrolls_done for s in self._slots),
           'respawns': sum(s.respawns for s in self._slots),
-          'alive': sum(1 for s in self._slots
-                       if s.thread is not None and s.thread.is_alive()),
+          'alive': len(alive),
+          'healthy': len(healthy),
+          'healthy_fraction': (len(healthy) / len(self._slots)
+                               if self._slots else 1.0),
       }
 
   def stop(self, timeout: float = 10.0):

@@ -65,6 +65,7 @@ import logging
 import os
 import pickle
 import queue
+import random
 import selectors
 import socket
 import struct
@@ -76,6 +77,7 @@ from scalable_agent_tpu.observability import LatencyReservoir
 
 import numpy as np
 
+from scalable_agent_tpu.runtime import faults as faults_lib
 from scalable_agent_tpu.runtime import ring_buffer
 
 log = logging.getLogger('scalable_agent_tpu')
@@ -254,6 +256,52 @@ class ProtocolError(RuntimeError):
   always a version-skewed peer (e.g. a pre-v4 role whose frames are
   untagged). Terminal: retrying against the same peer cannot succeed,
   so actors surface this instead of burning their reconnect window."""
+
+
+class Backoff:
+  """Capped exponential backoff with FULL jitter for retry loops.
+
+  The fixed `time.sleep(0.3)` the connect/reconnect loops used to run
+  meant a learner restart got the whole actor fleet back in lockstep:
+  every host lost its connection at the same instant, so every host
+  retried at the same instant, forever 0.3 s apart — a thundering herd
+  against a listener with a finite accept backlog. Full jitter
+  (delay ~ U[0, min(cap, base·2^attempt)]) decorrelates the fleet
+  while still backing off a learner that stays down.
+
+  The client loops construct a FRESH Backoff per incident (each
+  connect/reconnect window starts from the fast end by construction);
+  `reset()` exists for callers that hold one instance across
+  incidents. `rng` is injectable for deterministic tests.
+  """
+
+  def __init__(self, base: float = 0.2, cap: float = 5.0, rng=None):
+    if base <= 0 or cap <= 0:
+      raise ValueError('base and cap must be > 0')
+    self._base = base
+    self._cap = cap
+    self._rng = rng if rng is not None else random
+    self._attempt = 0
+
+  @property
+  def attempt(self) -> int:
+    return self._attempt
+
+  def next_delay(self) -> float:
+    ceiling = min(self._cap, self._base * (2 ** self._attempt))
+    # Attempts stop growing once the cap is the binding term (2^n
+    # would overflow floats long before a long outage ends).
+    if self._base * (2 ** self._attempt) < self._cap:
+      self._attempt += 1
+    return self._rng.uniform(0.0, ceiling)
+
+  def sleep(self) -> float:
+    delay = self.next_delay()
+    time.sleep(delay)
+    return delay
+
+  def reset(self) -> None:
+    self._attempt = 0
 
 
 # Bumped whenever the wire format or the handshake contract changes.
@@ -802,6 +850,7 @@ class TrajectoryIngestServer:
     self._stats_lock = threading.Lock()
     self._unrolls = 0
     self._rejected = 0
+    self._quarantined = 0  # connections dropped for unparseable frames
     self._connections = 0
     self._param_subscribers = 0  # cumulative hello_params adoptions
     self._ack_reservoir = LatencyReservoir()
@@ -893,6 +942,11 @@ class TrajectoryIngestServer:
     with self._stats_lock:
       return {'unrolls': self._unrolls,
               'rejected': self._rejected,
+              # Connections dropped after an unparseable/garbage frame
+              # (protocol error path): the wire-level quarantine — a
+              # corrupting peer loses its connection, the server and
+              # every other connection keep going.
+              'quarantined': self._quarantined,
               'connections': self._connections,  # cumulative
               'live': live,
               # Per-lane transport counters (round 6): the driver
@@ -1040,13 +1094,18 @@ class TrajectoryIngestServer:
       pass  # learner shut down; dropping the conn tells the actor
     except (ValueError, struct.error, pickle.UnpicklingError,
             EOFError) as e:
-      # Unparseable frame — almost always a version-skewed peer (a
-      # pre-v4 client's untagged pickle starts with opcode 0x80 =
-      # "frame kind 128"). Must not kill the handler thread silently:
-      # log the likely cause and drop just this connection.
+      # Unparseable frame — a version-skewed peer (a pre-v4 client's
+      # untagged pickle starts with opcode 0x80 = "frame kind 128") or
+      # garbage on the wire. Must not kill the handler thread
+      # silently: log the likely cause and QUARANTINE just this
+      # connection (counted — chaos.py's SLO asserts corrupt peers
+      # get dropped while the learner keeps training).
+      with self._stats_lock:
+        self._quarantined += 1
       log.warning(
-          'protocol/frame error from %s (version-skewed peer? this '
-          'learner speaks v%d): %s', addr, PROTOCOL_VERSION, e)
+          'protocol/frame error from %s — connection quarantined '
+          '(version-skewed peer? this learner speaks v%d): %s', addr,
+          PROTOCOL_VERSION, e)
     except (ConnectionError, OSError) as e:
       if not self._closed.is_set():
         log.warning('remote actor %s dropped: %s', addr, e)
@@ -1140,6 +1199,11 @@ class RemoteActorClient:
     self._param_sock: Optional[socket.socket] = None
     deadline = time.monotonic() + connect_timeout_secs
     last_err = None
+    # Capped exponential backoff + full jitter: after a learner
+    # restart, hundreds of actor hosts all lose their connection at
+    # the same instant — fixed-interval retries would hammer the new
+    # listener in lockstep (thundering herd).
+    backoff = Backoff(base=0.2, cap=5.0)
     while True:
       try:
         self._sock = socket.create_connection((host, int(port)),
@@ -1158,12 +1222,20 @@ class RemoteActorClient:
         if time.monotonic() > deadline:
           raise ConnectionError(
               f'could not reach learner at {address}: {e}') from e
-        time.sleep(0.3)
+        backoff.sleep()
     self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     self._sock.settimeout(None)
     log.info('connected to learner at %s (after %s)', address, last_err)
 
   def _rpc(self, msg, oob: bool = False):
+    fault = faults_lib.fire('transport_send')
+    if fault is not None:
+      # Scripted transport damage (runtime/faults.py): ship garbage/
+      # truncated bytes the learner must survive, then surface the
+      # OSError this client's reconnect path expects.
+      plan = faults_lib.active()
+      faults_lib.apply_transport_fault(
+          fault, self._sock, seed=plan.seed if plan else 0)
     if oob:
       _send_oob(self._sock, msg)
     else:
@@ -1356,6 +1428,11 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
       nonlocal client, version
       client.close()
       deadline = time.monotonic() + reconnect_secs
+      # Jittered backoff between whole connect+handshake cycles: the
+      # fleet must not re-handshake against a restarting learner in
+      # lockstep (the constructor's connect loop jitters its own
+      # retries; this covers handshake-level failures).
+      backoff = Backoff(base=0.2, cap=5.0)
       while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
@@ -1375,7 +1452,7 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
           raise
         except (OSError, RuntimeError):
           new_client.close()
-          time.sleep(0.3)
+          backoff.sleep()
           continue
         client = new_client
         version = v

@@ -1,0 +1,291 @@
+"""Chaos harness: scripted fault storms against the full local pipeline.
+
+Runs REAL training (driver.train on the contextual-bandit backend:
+actor fleet → inference batcher → buffer → prefetcher → train step →
+checkpoints, plus a live remote-actor child feeding over TCP) under a
+seeded `runtime.faults.FaultPlan` storm covering every injection
+layer —
+
+  env hang            a wedged simulator (stall detection → respawn)
+  env raise           a crashing env (fleet respawn)
+  socket garbage      a corrupting remote peer (ingest quarantines the
+                      connection; the actor child reconnects with
+                      jittered backoff)
+  NaN burst           non-finite loss/grads (device-side skip →
+                      watchdog rollback to the last-known-good
+                      checkpoint)
+  interrupted save    a checkpoint save killed mid-write (the newest
+                      step is corrupt; restore must ladder past it)
+
+— and asserts the recovery SLOs on the way out:
+
+  * ZERO learner crashes (train() returns),
+  * >= 1 automatic checkpoint rollback,
+  * a monotone, fully-accounted frame counter,
+  * bounded rollback loss (params revert at most to the last
+    checkpoint; step/frame counters never move backwards),
+  * bounded time-to-recover (first bad step → next healthy step),
+  * the corrupt remote connection quarantined while remote unrolls
+    keep flowing afterwards,
+  * health/fault counters present in summaries.jsonl + incidents.jsonl.
+
+Writes CHAOS_OUT (default CHAOS.json at the repo root). Invocation:
+
+    python scripts/chaos.py               # full storm, ~2-4 min CPU
+    CHAOS_SMOKE=1 python scripts/chaos.py # CI smoke, < 60 s
+    CHAOS_SEED=7 python scripts/chaos.py  # different garbage bytes
+
+The schedule is a pure function of the arguments (the seed only
+perturbs garbage payload content), so a failure reproduces exactly.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SMOKE = bool(os.environ.get('CHAOS_SMOKE'))
+SEED = int(os.environ.get('CHAOS_SEED', '1'))
+OUT_PATH = os.environ.get('CHAOS_OUT',
+                          os.path.join(REPO, 'CHAOS.json'))
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+
+def _free_port() -> int:
+  with socket.create_server(('127.0.0.1', 0)) as s:
+    return s.getsockname()[1]
+
+
+def _read_jsonl(path):
+  if not os.path.exists(path):
+    return []
+  with open(path) as f:
+    return [json.loads(line) for line in f if line.strip()]
+
+
+def _spawn_actor_child(address, overrides, plan_json):
+  """The production remote-actor role as a child process, with a
+  client-side transport-fault plan shipped via SA_FAULT_PLAN (plans
+  are process-local; the child installs its own)."""
+  from scalable_agent_tpu.runtime import faults as faults_lib
+  env = {k: v for k, v in os.environ.items()
+         if k not in ('XLA_FLAGS', 'JAX_PLATFORMS')}
+  existing = env.get('PYTHONPATH', '')
+  env['PYTHONPATH'] = (REPO + os.pathsep + existing if existing
+                       else REPO)
+  env[faults_lib.PLAN_ENV_VAR] = plan_json
+  body = (
+      'import json, os, sys\n'
+      'from scalable_agent_tpu.config import Config\n'
+      'from scalable_agent_tpu.runtime import faults, remote\n'
+      'faults.install_from_env()\n'
+      'cfg = Config(**json.loads(sys.argv[2]))\n'
+      'sent = remote.run_remote_actor(cfg, sys.argv[1], task=0,\n'
+      '                               platform="cpu")\n'
+      'print("CHILD_OK", sent, flush=True)\n')
+  return subprocess.Popen(
+      [sys.executable, '-c', body, address, json.dumps(overrides)],
+      cwd=REPO, env=env, stdout=subprocess.PIPE,
+      stderr=subprocess.STDOUT, text=True)
+
+
+def run_storm(logdir: str, smoke: bool = SMOKE, seed: int = SEED):
+  """Run the storm; returns (results dict, hard-assert errors list)."""
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.runtime import faults as faults_lib
+
+  max_steps = 30 if smoke else 80
+  burst_len = 5
+  cfg_kwargs = dict(
+      logdir=logdir,
+      env_backend='bandit',
+      num_actors=2,
+      batch_size=2,
+      unroll_length=5,
+      num_action_repeats=1,
+      episode_length=4,
+      height=24, width=32,
+      torso='shallow',
+      use_py_process=False,
+      use_instruction=False,
+      total_environment_frames=10 ** 9,
+      inference_timeout_ms=5,
+      checkpoint_secs=0,        # a save every maybe_save window: the
+                                # burst always has a rollback target
+      summary_secs=0,
+      remote_actor_port=_free_port(),
+      actor_reconnect_secs=120.0,
+      health_rollback_after=3,  # K: the burst (5) must cross it
+      health_min_window=8,
+      seed=seed)
+  cfg = Config(**cfg_kwargs)
+
+  # Learner-side plan: env faults early (respawn machinery), the NaN
+  # burst mid-run (after checkpoints exist), one interrupted save
+  # after that (the next restore must ladder past it).
+  plan = faults_lib.FaultPlan.storm(
+      seed,
+      env_raise_at=40,          # ~unroll 8 of the fleet's env steps
+      env_hang_at=200,
+      env_hang_secs=8.0,        # > stall timeout: must trigger respawn
+      nan_burst_at=10, nan_burst_len=burst_len,
+      checkpoint_interrupt_at=16)
+  # Child-side plan: transport damage on the unroll pump (garbage →
+  # learner quarantine; truncate/drop → reconnect-with-backoff).
+  child_plan = faults_lib.FaultPlan(
+      [faults_lib.Fault('transport_send', 4, 'garbage'),
+       faults_lib.Fault('transport_send', 9, 'truncate'),
+       faults_lib.Fault('transport_send', 14, 'drop')],
+      seed=seed)
+
+  child_overrides = {k: v for k, v in cfg_kwargs.items()
+                     if k not in ('logdir', 'remote_actor_port')}
+  child_overrides['logdir'] = logdir + '/actor_child'
+  child = _spawn_actor_child(
+      f'127.0.0.1:{cfg.remote_actor_port}', child_overrides,
+      child_plan.to_json())
+
+  faults_lib.install(plan)
+  t0 = time.monotonic()
+  crash = None
+  run = None
+  try:
+    run = driver.train(cfg, max_steps=max_steps,
+                       stall_timeout_secs=5.0)
+  except BaseException as e:  # SLO: zero learner crashes
+    crash = f'{type(e).__name__}: {e}'
+  finally:
+    faults_lib.clear()
+  wall_secs = time.monotonic() - t0
+  child.terminate()
+  try:
+    child_out = child.communicate(timeout=20)[0]
+  except subprocess.TimeoutExpired:
+    child.kill()
+    child_out = child.communicate()[0]
+
+  summaries = _read_jsonl(os.path.join(logdir, 'summaries.jsonl'))
+  incidents = _read_jsonl(os.path.join(logdir, 'incidents.jsonl'))
+  tags = {e['tag'] for e in summaries if 'tag' in e}
+  plan_stats = plan.stats()
+
+  errors = []
+  results = {
+      'smoke': smoke,
+      'seed': seed,
+      'max_steps': max_steps,
+      'wall_secs': round(wall_secs, 2),
+      'crash': crash,
+      'fault_plan': plan_stats,
+      'child_tail': child_out[-600:] if child_out else '',
+  }
+  if crash is not None:
+    errors.append(f'learner crashed: {crash}')
+    return results, errors
+
+  health = run.health
+  ing = run.ingest.stats() if run.ingest is not None else {}
+  # train() has already stopped the fleet, so the liveness fields
+  # would read an all-dead fleet — keep only the cumulative counters
+  # (the live healthy_fraction is asserted via the summaries tag).
+  fleet_raw = run.fleet.stats()
+  fleet_stats = {k: fleet_raw[k] for k in ('respawns', 'unrolls')}
+
+  # --- SLO: monotone, fully-accounted frame counter. The device
+  # counter must equal steps consumed (skips included — a skipped
+  # step still consumed its batch), and the summaries' step fields
+  # must never decrease.
+  import jax
+  device_steps = int(jax.device_get(run.state.update_steps))
+  if device_steps != max_steps:
+    errors.append(f'frame counter not monotone/complete: device '
+                  f'update_steps={device_steps}, expected {max_steps}')
+  steps_seq = [e['step'] for e in summaries if 'step' in e]
+  if any(b < a for a, b in zip(steps_seq, steps_seq[1:])):
+    errors.append('summary step sequence decreased')
+
+  # --- SLO: the watchdog skipped the burst and rolled back >= once.
+  hs = health.stats()
+  if hs['skipped_steps'] < burst_len:
+    errors.append(f"skipped_steps={hs['skipped_steps']} < burst "
+                  f'{burst_len}')
+  if hs['rollbacks'] < 1:
+    errors.append('no automatic checkpoint rollback happened')
+
+  # --- SLO: bounded time-to-recover (first bad step -> next healthy
+  # step), from the incident stream.
+  ttr = None
+  t_bad = None
+  for ev in incidents:
+    # First burst start only: a rollback mid-burst must not restart
+    # the clock — TTR is first-bad-step → first healthy step.
+    if ev['kind'] == 'health_bad_burst_start' and t_bad is None:
+      t_bad = ev['wall_time']
+    if (ev['kind'] == 'health_recovered' and ttr is None
+        and t_bad is not None):
+      ttr = round(ev['wall_time'] - t_bad, 3)
+  recover_slo = 60.0
+  if ttr is None:
+    errors.append('no health_recovered incident (burst never ended?)')
+  elif ttr > recover_slo:
+    errors.append(f'time-to-recover {ttr}s > SLO {recover_slo}s')
+
+  # --- SLO: the garbage connection was quarantined, and remote
+  # unrolls kept flowing (the child reconnected and resumed).
+  if ing.get('quarantined', 0) < 1:
+    errors.append('ingest quarantined no connection despite garbage')
+  if ing.get('unrolls', 0) < 1:
+    errors.append('no remote unrolls landed')
+
+  # --- SLO: the interrupted save left a corrupt newest step the
+  # integrity ladder can see (save_errors recorded), without killing
+  # the run; counters surfaced in summaries.
+  if run.checkpointer.save_errors < 1:
+    errors.append('interrupted save not recorded in save_errors')
+  for tag in ('skipped_steps', 'rollbacks', 'quarantined',
+              'fleet_healthy_fraction'):
+    if tag not in tags:
+      errors.append(f'summary tag {tag!r} missing')
+
+  results.update({
+      'health': hs,
+      'ingest': {k: ing.get(k) for k in
+                 ('unrolls', 'quarantined', 'rejected', 'connections')},
+      'fleet': fleet_stats,
+      'checkpoint': {'save_errors': run.checkpointer.save_errors,
+                     'restore_fallbacks':
+                         run.checkpointer.restore_fallbacks,
+                     'last_good_step':
+                         run.checkpointer.last_good_step()},
+      'device_update_steps': device_steps,
+      'time_to_recover_secs': ttr,
+      'incident_kinds': sorted({e['kind'] for e in incidents}),
+  })
+  return results, errors
+
+
+def main():
+  with tempfile.TemporaryDirectory(prefix='chaos_') as logdir:
+    results, errors = run_storm(logdir)
+  results['slo_violations'] = errors
+  results['ok'] = not errors
+  with open(OUT_PATH, 'w') as f:
+    json.dump(results, f, indent=2, sort_keys=True)
+  print(json.dumps({'chaos_ok': results['ok'],
+                    'wall_secs': results['wall_secs'],
+                    'violations': errors,
+                    'out': OUT_PATH}))
+  if errors:
+    sys.exit(1)
+
+
+if __name__ == '__main__':
+  main()

@@ -28,6 +28,11 @@ echo '== churn-soak smoke (env kill + respawn + resource sampling'
 echo '   mechanics; the real >=20 min churn soak runs on the chip) =='
 SOAK_SMOKE=1 SOAK_CHURN=1 python scripts/soak.py
 
+echo '== chaos smoke (deterministic fault storm: env hang/crash +'
+echo '   socket garbage + NaN burst + interrupted save; asserts zero'
+echo '   learner crashes, >=1 rollback, monotone frames — <60 s) =='
+CHAOS_SMOKE=1 python scripts/chaos.py
+
 echo '== byte-attribution smoke (cost_analysis mechanics) =='
 SMOKE=1 python scripts/attribute_bytes.py
 

@@ -31,6 +31,16 @@ jax.config.update('jax_platforms', 'cpu')
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+  config.addinivalue_line(
+      'markers', 'slow: long-running; excluded from tier-1 '
+      '(-m "not slow")')
+  config.addinivalue_line(
+      'markers', 'chaos: deterministic fault-injection coverage '
+      '(runtime/faults.py) — kept fast so tier-1 (-m "not slow") '
+      'exercises at least one injected fault per layer')
+
+
 @pytest.fixture
 def batcher_options_spy(monkeypatch):
   """Intercept dynamic_batching.batch_fn_with_options and record each

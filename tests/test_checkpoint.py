@@ -211,6 +211,9 @@ def test_wrap_error_sniffs_structure_vs_corruption():
                  'metadata tree structures do not match.'),
       KeyError('params/instruction/embed/kernel'),  # bare key str
       TypeError('Custom PyTree node mismatch'),
+      # Newer-Orbax spelling (jax tree_util raises it before any file
+      # is read).
+      ValueError("Dict key mismatch; expected keys: ['a']; dict: {}"),
   ]
   for e in structural:
     with pytest.raises(ckpt_lib.CheckpointStructureError,
@@ -229,6 +232,118 @@ def test_wrap_error_sniffs_structure_vs_corruption():
     msg = str(exc_info.value)
     assert 'use_instruction' not in msg
     assert 'corrupt' in msg and 'previous retained step' in msg
+
+
+def _save_steps(ckpt, state, steps):
+  for step in steps:
+    assert ckpt.save(state, step=step, force=True)
+
+
+def test_restore_latest_falls_back_past_truncated_newest(setup,
+                                                         tmp_path):
+  """Integrity ladder: files of the newest step truncated (a save
+  killed mid-write) → restore_latest logs, retries the previous
+  retained step, and succeeds instead of dead-ending."""
+  from scalable_agent_tpu.runtime import faults as faults_lib
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(
+      jax.tree_util.tree_map(jnp.copy, params), cfg)
+  ckpt = Checkpointer(str(tmp_path / 'ladder'), save_interval_secs=0)
+  try:
+    _save_steps(ckpt, state, (1, 2))
+    assert ckpt.last_good_step() == 2
+    faults_lib.corrupt_checkpoint_step(str(tmp_path / 'ladder'), 2)
+    restored = ckpt.restore_latest(state)
+    assert restored is not None
+    _tree_equal(restored.params, state.params)
+    assert ckpt.restore_fallbacks >= 1
+  finally:
+    ckpt.close()
+
+
+def test_restore_latest_falls_back_past_deleted_step_files(setup,
+                                                           tmp_path):
+  """Same ladder for wholesale-missing array files (partial rsync,
+  eviction): the newest step still LISTS but cannot restore."""
+  import os
+  import shutil
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(
+      jax.tree_util.tree_map(jnp.copy, params), cfg)
+  directory = str(tmp_path / 'deleted')
+  ckpt = Checkpointer(directory, save_interval_secs=0)
+  try:
+    _save_steps(ckpt, state, (1, 2))
+    step_dir = os.path.join(directory, '2')
+    assert os.path.isdir(step_dir)
+    # Delete the saved ARRAY payloads, keep the step dir listing.
+    for root, dirs, files in os.walk(step_dir):
+      for name in dirs:
+        if name == 'default':
+          shutil.rmtree(os.path.join(root, name))
+    restored = ckpt.restore_latest(state)
+    assert restored is not None
+    _tree_equal(restored.params, state.params)
+  finally:
+    ckpt.close()
+
+
+def test_restore_raises_corruption_guidance_when_all_steps_bad(
+    setup, tmp_path):
+  """Exhausting the ladder keeps the corruption (not flag-hunt)
+  wording — the structure-vs-corruption message split stays intact."""
+  from scalable_agent_tpu import checkpoint as ckpt_lib
+  from scalable_agent_tpu.runtime import faults as faults_lib
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(
+      jax.tree_util.tree_map(jnp.copy, params), cfg)
+  directory = str(tmp_path / 'allbad')
+  ckpt = Checkpointer(directory, save_interval_secs=0)
+  try:
+    _save_steps(ckpt, state, (1, 2))
+    for step in (1, 2):
+      from scalable_agent_tpu.runtime.faults import (
+          corrupt_checkpoint_step)
+      corrupt_checkpoint_step(directory, step)
+    with pytest.raises(ckpt_lib.CheckpointStructureError) as exc_info:
+      ckpt.restore_latest(state)
+    msg = str(exc_info.value)
+    assert 'use_instruction' not in msg
+    assert 'corrupt' in msg
+  finally:
+    ckpt.close()
+
+
+def test_last_good_marker_roundtrip(setup, tmp_path):
+  """LAST_GOOD distinguishes 'restorable' from merely 'newest':
+  advanced only by verified saves, pruned entries invalidate it, and
+  restore_last_good prefers it."""
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(
+      jax.tree_util.tree_map(jnp.copy, params), cfg)
+  ckpt = Checkpointer(str(tmp_path / 'marker'), max_to_keep=2,
+                      save_interval_secs=0)
+  try:
+    assert ckpt.last_good_step() is None
+    _save_steps(ckpt, state, (1,))
+    assert ckpt.last_good_step() == 1
+    _save_steps(ckpt, state, (2, 3))   # step 1 pruned (max_to_keep=2)
+    assert ckpt.last_good_step() == 3
+    restored = ckpt.restore_last_good(state)
+    assert restored is not None
+    _tree_equal(restored.params, state.params)
+  finally:
+    ckpt.close()
+
+
+def test_restore_last_good_none_when_empty(setup, tmp_path):
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(params, cfg)
+  ckpt = Checkpointer(str(tmp_path / 'emptygood'))
+  try:
+    assert ckpt.restore_last_good(state) is None
+  finally:
+    ckpt.close()
 
 
 def test_sharded_state_roundtrip(setup, tmp_path):

@@ -1,0 +1,230 @@
+"""Fault-injection framework (runtime/faults.py) + the recovery paths
+it drives — at least one injected fault per layer runs in tier-1 (the
+chaos storm composes them all; scripts/chaos.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from scalable_agent_tpu.envs.fake import FakeEnv
+from scalable_agent_tpu.runtime import faults as faults_lib
+from scalable_agent_tpu.runtime import remote, ring_buffer
+from scalable_agent_tpu.runtime.actor import Actor
+from scalable_agent_tpu.runtime.fleet import ActorFleet
+
+H, W, A = 8, 8, 3
+
+# Deliberately NOT slow-marked: tier-1 (-m 'not slow') must exercise
+# at least one injected fault per layer on every run.
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+  yield
+  faults_lib.clear()
+
+
+class TestFaultPlan:
+
+  def test_schedule_is_deterministic(self):
+    a = faults_lib.FaultPlan.storm(3, env_raise_at=2, nan_burst_at=5,
+                                   nan_burst_len=3,
+                                   transport=['garbage', 'drop'])
+    b = faults_lib.FaultPlan.from_json(a.to_json())
+    assert a.faults() == b.faults()
+    assert b.seed == 3
+    # Firing sequence is a pure function of the event counters.
+    fired_a = [bool(a.fire('env_step')) for _ in range(5)]
+    fired_b = [bool(b.fire('env_step')) for _ in range(5)]
+    assert fired_a == fired_b == [False, False, True, False, False]
+
+  def test_unknown_site_rejected(self):
+    with pytest.raises(ValueError, match='unknown fault site'):
+      faults_lib.Fault('warp_core', 0, 'breach')
+
+  def test_fire_without_plan_is_noop(self):
+    faults_lib.clear()
+    assert faults_lib.fire('env_step') is None
+
+  def test_stats_count_fired(self):
+    plan = faults_lib.FaultPlan([faults_lib.Fault('env_step', 1,
+                                                  'raise')])
+    plan.fire('env_step')
+    plan.fire('env_step')
+    stats = plan.stats()
+    assert stats['env_step'] == {'events': 2, 'fired': 1,
+                                 'scheduled': 1}
+
+
+class TestEnvLayer:
+
+  def test_wrap_only_when_site_covered(self):
+    env = FakeEnv(height=H, width=W, num_actions=A)
+    faults_lib.install(faults_lib.FaultPlan(
+        [faults_lib.Fault('nan_burst', 0, 'nan')]))
+    assert faults_lib.maybe_wrap_env(env) is env
+    faults_lib.install(faults_lib.FaultPlan(
+        [faults_lib.Fault('env_step', 0, 'raise')]))
+    assert isinstance(faults_lib.maybe_wrap_env(env),
+                      faults_lib.FaultyEnv)
+
+  def test_injected_env_crash_respawns_the_actor(self):
+    """env_step 'raise' through the REAL fleet respawn path."""
+    faults_lib.install(faults_lib.FaultPlan(
+        [faults_lib.Fault('env_step', 6, 'raise')]))
+    buffer = ring_buffer.TrajectoryBuffer(8)
+
+    def policy(prev_action, env_output, core_state):
+      from scalable_agent_tpu.structs import AgentOutput
+      return AgentOutput(action=np.int32(0),
+                         policy_logits=np.zeros(A, np.float32),
+                         baseline=np.float32(0.0)), core_state
+
+    def make_actor(i):
+      env = faults_lib.maybe_wrap_env(
+          FakeEnv(height=H, width=W, num_actions=A, seed=i))
+      actor = Actor(env, policy,
+                    (np.zeros((1, 4), np.float32),) * 2,
+                    unroll_length=4)
+      return env, None, actor
+
+    fleet = ActorFleet(make_actor, buffer, num_actors=1)
+    fleet.start()
+    try:
+      deadline = time.monotonic() + 30
+      respawned = False
+      got = 0
+      while time.monotonic() < deadline and not (respawned and got >= 3):
+        try:
+          buffer.get(timeout=0.2)
+          got += 1
+        except TimeoutError:
+          pass
+        fleet.check_health()
+        respawned = respawned or fleet.stats()['respawns'] >= 1
+      assert respawned, 'injected env crash never triggered a respawn'
+      assert got >= 3, 'fleet did not keep producing after respawn'
+    finally:
+      fleet.stop()
+
+  def test_env_hang_stalls_then_recovers(self):
+    faults_lib.install(faults_lib.FaultPlan(
+        [faults_lib.Fault('env_step', 1, 'hang', param=0.5)]))
+    env = faults_lib.maybe_wrap_env(
+        FakeEnv(height=H, width=W, num_actions=A))
+    env.step(0)
+    t0 = time.monotonic()
+    env.step(0)  # the hang
+    assert time.monotonic() - t0 >= 0.5
+    env.step(0)  # and life goes on
+
+
+class TestTransportLayer:
+
+  def test_garbage_quarantines_connection_but_server_survives(self):
+    """A corrupt frame must cost the sender its connection — and
+    nothing else: fresh connections keep working."""
+    import socket as socket_lib
+    buffer = ring_buffer.TrajectoryBuffer(4)
+    server = remote.TrajectoryIngestServer(buffer, {'w': np.ones(3)})
+    try:
+      fault = faults_lib.Fault('transport_send', 0, 'garbage')
+      sock = socket_lib.create_connection(('127.0.0.1', server.port))
+      with pytest.raises(ConnectionError, match='injected'):
+        faults_lib.apply_transport_fault(fault, sock, seed=1)
+      deadline = time.monotonic() + 10
+      while (server.stats()['quarantined'] < 1
+             and time.monotonic() < deadline):
+        time.sleep(0.05)
+      assert server.stats()['quarantined'] == 1
+      # The server still serves a well-behaved client afterwards.
+      client = remote.RemoteActorClient(f'127.0.0.1:{server.port}')
+      version, params = client.fetch_params()
+      assert version == 1
+      np.testing.assert_array_equal(params['w'], np.ones(3))
+      client.close()
+    finally:
+      server.close()
+      buffer.close()
+
+  def test_client_rpc_fault_surfaces_as_connection_error(self):
+    buffer = ring_buffer.TrajectoryBuffer(4)
+    server = remote.TrajectoryIngestServer(buffer, {'w': np.ones(3)})
+    try:
+      faults_lib.install(faults_lib.FaultPlan(
+          [faults_lib.Fault('transport_send', 0, 'truncate')]))
+      client = remote.RemoteActorClient(f'127.0.0.1:{server.port}')
+      with pytest.raises(OSError):
+        client._rpc(('hello', None))
+      client.close()
+    finally:
+      faults_lib.clear()
+      server.close()
+      buffer.close()
+
+
+class TestCheckpointLayer:
+
+  def test_interrupted_save_falls_back_on_restore(self, tmp_path):
+    """checkpoint_save 'interrupt': the newest step is corrupt on
+    disk, LAST_GOOD stays behind, and restore_latest ladders back to
+    the previous retained step instead of dead-ending."""
+    import jax
+    from scalable_agent_tpu import learner as learner_lib
+    from scalable_agent_tpu.checkpoint import Checkpointer
+    from scalable_agent_tpu.config import Config
+    from scalable_agent_tpu.models import ImpalaAgent, init_params
+    from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+
+    cfg = Config(batch_size=2, unroll_length=3, torso='shallow')
+    agent = ImpalaAgent(num_actions=4, torso='shallow')
+    params = init_params(agent, jax.random.PRNGKey(0),
+                         {'frame': (24, 32, 3),
+                          'instr_len': MAX_INSTRUCTION_LEN})
+    state = learner_lib.make_train_state(params, cfg)
+    ckpt = Checkpointer(str(tmp_path / 'ckpt'), save_interval_secs=0)
+    try:
+      assert ckpt.save(state, step=1, force=True)
+      faults_lib.install(faults_lib.FaultPlan(
+          [faults_lib.Fault('checkpoint_save', 0, 'interrupt')]))
+      assert ckpt.save(state, step=2, force=True)
+      faults_lib.clear()
+      assert ckpt.save_errors == 1
+      assert ckpt.last_good_step() == 1  # marker did not advance
+      assert ckpt.latest_step() == 2     # ...but step 2 lists newest
+
+      restored = ckpt.restore_latest(state)
+      assert restored is not None
+      assert ckpt.restore_fallbacks >= 1
+      assert int(jax.device_get(restored.update_steps)) == \
+          int(jax.device_get(state.update_steps))
+    finally:
+      ckpt.close()
+
+
+class TestBackoff:
+
+  def test_full_jitter_bounded_and_growing(self):
+    rng = np.random.RandomState(0)
+
+    class _Rng:
+      def uniform(self, lo, hi):
+        return float(rng.uniform(lo, hi))
+
+    b = remote.Backoff(base=0.1, cap=2.0, rng=_Rng())
+    ceilings = []
+    for attempt in range(12):
+      expected_ceiling = min(2.0, 0.1 * (2 ** attempt))
+      delay = b.next_delay()
+      assert 0.0 <= delay <= expected_ceiling
+      ceilings.append(expected_ceiling)
+    assert ceilings[-1] == 2.0  # capped
+    b.reset()
+    assert b.next_delay() <= 0.1  # back to the fast end
+
+  def test_jitter_decorrelates_instances(self):
+    delays = {round(remote.Backoff(base=1.0, cap=1.0).next_delay(), 6)
+              for _ in range(16)}
+    assert len(delays) > 1  # a fixed sleep would be a single value

@@ -88,6 +88,56 @@ def test_fleet_detects_and_respawns_crashed_actor():
   assert fleet.stats()['respawns'] >= 1
 
 
+def test_stats_alive_vs_healthy_quorum():
+  """Round 7 satellite: a wedged actor's thread is `alive` but must
+  NOT count as `healthy` — the quorum fraction is the honest signal
+  the driver logs."""
+  buffer = ring_buffer.TrajectoryBuffer(8)
+  stall = threading.Event()
+
+  class StallingEnv(FakeEnv):
+    def __init__(self, stall_me=False, **kw):
+      super().__init__(**kw)
+      self._stall_me = stall_me
+
+    def step(self, action):
+      if self._stall_me and stall.is_set():
+        time.sleep(30)
+      return super().step(action)
+
+  def env_factory(i):
+    return StallingEnv(stall_me=(i == 0), height=H, width=W,
+                       num_actions=A, seed=i)
+
+  fleet = ActorFleet(_make_actor_factory(env_factory), buffer,
+                     num_actors=2)
+  fleet.start()
+  # Both healthy first: drain a couple of unrolls so heartbeats beat.
+  for _ in range(2):
+    buffer.get(timeout=10)
+  stats = fleet.stats(healthy_horizon_secs=60.0)
+  assert stats['alive'] == 2
+  assert stats['healthy'] == 2
+  assert stats['healthy_fraction'] == 1.0
+
+  stall.set()
+  deadline = time.monotonic() + 10
+  while time.monotonic() < deadline:
+    # Keep the healthy actor's heartbeat fresh by draining its output.
+    try:
+      buffer.get(timeout=0.2)
+    except TimeoutError:
+      pass
+    stats = fleet.stats(healthy_horizon_secs=0.5)
+    if stats['healthy'] == 1:
+      break
+  assert stats['alive'] == 2          # the wedged thread still runs
+  assert stats['healthy'] == 1        # ...but it is not healthy
+  assert stats['healthy_fraction'] == 0.5
+  stall.clear()
+  fleet.stop(timeout=2)
+
+
 def test_fleet_detects_stalled_actor():
   buffer = ring_buffer.TrajectoryBuffer(2)
 

@@ -1,0 +1,246 @@
+"""Training-health watchdog (health.py): device-side skip, detectors,
+escalation ladder, driver integration — SURVEY §5.3/5.4 greenfield
+(the reference trains through NaNs until the job dies)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from scalable_agent_tpu import driver
+from scalable_agent_tpu import health as health_lib
+from scalable_agent_tpu import learner as learner_lib
+from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.models import ImpalaAgent, init_params
+from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+from scalable_agent_tpu.runtime import faults as faults_lib
+from scalable_agent_tpu.testing import make_example_batch
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+  yield
+  faults_lib.clear()
+
+
+def _vals(step_ok=1.0, loss=1.0, grad=1.0, sigma=None):
+  return {'step_ok': step_ok, 'total_loss': loss, 'grad_norm': grad,
+          'popart_sigma_min': None, 'popart_sigma_max': sigma}
+
+
+class TestMonitor:
+
+  def test_finite_steps_are_ok(self):
+    m = health_lib.HealthMonitor()
+    for step in range(20):
+      assert m.observe_values(step, _vals()) == health_lib.OK
+    assert m.stats()['skipped_steps'] == 0
+
+  def test_device_skip_counts_and_ladder_escalates(self):
+    m = health_lib.HealthMonitor(rollback_after=3, max_rollbacks=1)
+    verdicts = [m.observe_values(i, _vals(step_ok=0.0,
+                                          loss=float('nan')))
+                for i in range(7)]
+    # 2 bad, rollback at the 3rd; 2 bad, HALT at the next rollback
+    # request (max_rollbacks=1 → the 2nd request halts).
+    assert verdicts[:3] == [health_lib.BAD, health_lib.BAD,
+                            health_lib.ROLLBACK]
+    assert health_lib.HALT in verdicts[3:]
+    assert m.skipped_steps >= 6
+    # `rollbacks` counts rollbacks PERFORMED (1, the budget); the
+    # request past the budget registers as a halt, not a rollback.
+    assert m.rollbacks == 1
+    assert m.halts == 1
+
+  def test_recovery_resets_the_consecutive_count(self):
+    m = health_lib.HealthMonitor(rollback_after=3)
+    m.observe_values(0, _vals(step_ok=0.0))
+    m.observe_values(1, _vals(step_ok=0.0))
+    assert m.observe_values(2, _vals()) == health_lib.OK
+    assert m.consecutive_bad == 0
+    m.observe_values(3, _vals(step_ok=0.0))
+    assert m.consecutive_bad == 1  # no carry-over across recovery
+
+  def test_loss_explosion_detected_when_finite(self):
+    m = health_lib.HealthMonitor(min_window=8,
+                                 loss_explosion_factor=100.0)
+    for step in range(10):
+      m.observe_values(step, _vals(loss=1.0 + 0.01 * step))
+    v = m.observe_values(10, _vals(loss=1e5))
+    assert v == health_lib.BAD
+    assert 'explosion' in m.last_reason
+    # Device did NOT skip it (finite), so flagged but not skipped.
+    assert m.flagged_steps == 1 and m.skipped_steps == 0
+
+  def test_popart_sigma_divergence_detected(self):
+    m = health_lib.HealthMonitor(min_window=8,
+                                 sigma_divergence_factor=10.0)
+    for step in range(10):
+      m.observe_values(step, _vals(sigma=2.0))
+    assert m.observe_values(10, _vals(sigma=50.0)) == health_lib.BAD
+    assert 'sigma divergence' in m.last_reason
+
+  def test_popart_sigma_collapse_detected(self):
+    m = health_lib.HealthMonitor(min_window=8,
+                                 sigma_divergence_factor=10.0)
+    for step in range(10):
+      vals = _vals(sigma=2.0)
+      vals['popart_sigma_min'] = 1.0
+      m.observe_values(step, vals)
+    vals = _vals(sigma=2.0)
+    vals['popart_sigma_min'] = 0.01  # 100x below the window median
+    assert m.observe_values(10, vals) == health_lib.BAD
+    assert 'sigma collapse' in m.last_reason
+
+  def test_missing_popart_keys_keep_detector_off(self):
+    m = health_lib.HealthMonitor(min_window=2)
+    for step in range(20):
+      assert m.observe_values(step, _vals(sigma=None)) == health_lib.OK
+
+  def test_halt_bundle_contents(self, tmp_path):
+    cfg = Config(logdir=str(tmp_path))
+    m = health_lib.HealthMonitor()
+    m.observe_values(7, _vals(step_ok=0.0, loss=float('nan')))
+    path = m.write_halt_bundle(str(tmp_path), cfg, 7, reason='test')
+    with open(path) as f:
+      bundle = json.load(f)
+    assert bundle['reason'] == 'test'
+    assert bundle['config']['logdir'] == str(tmp_path)
+    assert bundle['versions']['jax']
+    assert bundle['window'][-1]['step'] == 7
+    assert bundle['counters']['skipped_steps'] == 1
+
+
+class TestDeviceGuard:
+  """learner.py's in-graph skip: a non-finite step must leave params,
+  optimizer state, and the step metrics' step_ok flag consistent."""
+
+  @pytest.fixture(scope='class')
+  def setup(self):
+    cfg = Config(batch_size=2, unroll_length=3, torso='shallow',
+                 total_environment_frames=10 ** 6)
+    agent = ImpalaAgent(num_actions=4, torso='shallow')
+    params = init_params(agent, jax.random.PRNGKey(0),
+                         {'frame': (24, 32, 3),
+                          'instr_len': MAX_INSTRUCTION_LEN})
+    batch = make_example_batch(cfg.unroll_length + 1, cfg.batch_size,
+                               24, 32, 4, MAX_INSTRUCTION_LEN)
+    return cfg, agent, params, batch
+
+  def test_nan_batch_skips_update(self, setup):
+    cfg, agent, params, batch = setup
+    params = jax.tree_util.tree_map(jnp.copy, params)
+    step = learner_lib.make_train_step(agent, cfg)
+    state = learner_lib.make_train_state(params, cfg)
+    before = jax.tree_util.tree_map(np.asarray, state.params)
+    poisoned = faults_lib.poison_batch(batch)
+    state2, metrics = step(state, poisoned)
+    assert float(metrics['step_ok']) == 0.0
+    after = jax.tree_util.tree_map(np.asarray, state2.params)
+    for a, b in zip(jax.tree_util.tree_leaves(before),
+                    jax.tree_util.tree_leaves(after)):
+      np.testing.assert_array_equal(a, b)
+    assert np.all(np.isfinite(
+        np.concatenate([np.ravel(x) for x in
+                        jax.tree_util.tree_leaves(after)])))
+    # The step counter still advanced (frames were consumed).
+    assert int(state2.update_steps) == 1
+
+  def test_good_batch_updates_and_reports_ok(self, setup):
+    cfg, agent, params, batch = setup
+    params = jax.tree_util.tree_map(jnp.copy, params)
+    step = learner_lib.make_train_step(agent, cfg)
+    state = learner_lib.make_train_state(params, cfg)
+    before = np.asarray(
+        jax.tree_util.tree_leaves(state.params)[0]).copy()
+    state2, metrics = step(state, batch)
+    assert float(metrics['step_ok']) == 1.0
+    after = np.asarray(jax.tree_util.tree_leaves(state2.params)[0])
+    assert not np.array_equal(before, after)
+
+  def test_watchdog_off_removes_guard(self, setup):
+    cfg, agent, params, batch = setup
+    cfg = Config(**{**cfg.__dict__, 'health_watchdog': False})
+    params = jax.tree_util.tree_map(jnp.copy, params)
+    step = learner_lib.make_train_step(agent, cfg)
+    state = learner_lib.make_train_state(params, cfg)
+    _, metrics = step(state, batch)
+    assert 'step_ok' not in metrics
+
+
+def _config(tmp_path, **kw):
+  base = dict(
+      logdir=str(tmp_path), env_backend='bandit', num_actors=2,
+      batch_size=2, unroll_length=5, num_action_repeats=1,
+      episode_length=4, height=24, width=32, torso='shallow',
+      use_py_process=False, use_instruction=False,
+      total_environment_frames=10 ** 6, inference_timeout_ms=5,
+      checkpoint_secs=0, summary_secs=0, seed=3)
+  base.update(kw)
+  return Config(**base)
+
+
+@pytest.mark.chaos
+class TestDriverIntegration:
+
+  def test_nan_burst_skips_rolls_back_and_recovers(self, tmp_path):
+    """The acceptance shape: a NaN burst crossing K gets the params
+    rolled back to the last-known-good checkpoint, the run finishes
+    with a monotone step counter, and the counters land in summaries
+    + incidents."""
+    cfg = _config(tmp_path, health_rollback_after=3)
+    plan = faults_lib.FaultPlan.storm(seed=0, nan_burst_at=5,
+                                      nan_burst_len=4)
+    faults_lib.install(plan)
+    try:
+      run = driver.train(cfg, max_steps=12, stall_timeout_secs=60)
+    finally:
+      faults_lib.clear()
+    assert int(run.state.update_steps) == 12  # monotone through burst
+    hs = run.health.stats()
+    assert hs['skipped_steps'] == 4
+    assert hs['rollbacks'] == 1
+    with open(os.path.join(str(tmp_path), 'summaries.jsonl')) as f:
+      tags = {json.loads(line)['tag'] for line in f}
+    assert {'skipped_steps', 'rollbacks',
+            'fleet_healthy_fraction'} <= tags
+    with open(os.path.join(str(tmp_path), 'incidents.jsonl')) as f:
+      kinds = [json.loads(line)['kind'] for line in f]
+    assert 'rollback' in kinds
+    assert 'health_recovered' in kinds
+    # Params stayed finite end-to-end.
+    for leaf in jax.tree_util.tree_leaves(run.state.params):
+      assert np.all(np.isfinite(np.asarray(leaf)))
+
+  def test_halt_without_checkpoint_writes_bundle(self, tmp_path):
+    """Rollback requested with NO restorable checkpoint → halt with a
+    diagnostic bundle instead of training through divergence."""
+    cfg = _config(tmp_path, health_rollback_after=2,
+                  checkpoint_secs=10 ** 6)  # never saves
+    plan = faults_lib.FaultPlan.storm(seed=0, nan_burst_at=2,
+                                      nan_burst_len=6)
+    faults_lib.install(plan)
+    try:
+      with pytest.raises(health_lib.TrainingDivergence) as exc_info:
+        driver.train(cfg, max_steps=12, stall_timeout_secs=60)
+    finally:
+      faults_lib.clear()
+    bundle_path = exc_info.value.bundle_path
+    assert bundle_path and os.path.exists(bundle_path)
+    with open(bundle_path) as f:
+      bundle = json.load(f)
+    assert 'no restorable checkpoint' in bundle['reason']
+    assert bundle['config']['health_rollback_after'] == 2
+    # The unwind must NOT have force-saved the diverged state as a
+    # final checkpoint (it would become LAST_GOOD and crash-loop the
+    # restarted run).
+    from scalable_agent_tpu.checkpoint import Checkpointer
+    ckpt = Checkpointer(str(tmp_path) + '/checkpoints')
+    try:
+      assert ckpt.latest_step() is None
+    finally:
+      ckpt.close()
