@@ -117,8 +117,12 @@ flags.DEFINE_enum('torso', _DEFAULTS.torso,
                   ['deep', 'deep_fast', 'shallow'],
                   'Agent torso: deep ResNet (reference), deep_fast '
                   '(stride-2 convs replace the max-pools — the HBM-'
-                  'bandwidth operating point, docs/PERF.md), or the '
-                  "paper's shallow CNN.")
+                  'bandwidth operating point, docs/PERF.md; '
+                  'THROUGHPUT VARIANT, UNVALIDATED RETURNS: a '
+                  'different function whose learning evidence is '
+                  'bandit-grade only — run '
+                  'scripts/compare_torsos.py before trusting it on '
+                  "a real task), or the paper's shallow CNN.")
 flags.DEFINE_enum('compute_dtype', _DEFAULTS.compute_dtype,
                   ['float32', 'bfloat16'], 'On-device compute dtype.')
 flags.DEFINE_integer('model_parallelism', _DEFAULTS.model_parallelism,
@@ -163,6 +167,26 @@ flags.DEFINE_float('pixel_control_discount',
 flags.DEFINE_integer('pixel_control_cell_size',
                      _DEFAULTS.pixel_control_cell_size,
                      'UNREAL pixel-control spatial cell size.')
+flags.DEFINE_bool('pixel_control_integer_rewards',
+                  _DEFAULTS.pixel_control_integer_rewards,
+                  'Integer-domain pixel-control pseudo-rewards '
+                  '(uint8 diff + int32 cell sums; no full-resolution '
+                  'float frame temporaries — parity-gated byte '
+                  'lever, docs/PERF.md r6). Auto-falls back to the '
+                  'f32 form for non-uint8 observations.')
+flags.DEFINE_enum('pixel_control_head_impl',
+                  _DEFAULTS.pixel_control_head_impl,
+                  ['deconv', 'd2s'],
+                  'Pixel-control Q-head deconv implementation: '
+                  'deconv (nn.ConvTranspose reference form, default) '
+                  'or d2s (depth-to-space recast — parameter-'
+                  'identical, checkpoint-interchangeable, parity-'
+                  'gated; measured per round by bench.py pc_levers).')
+flags.DEFINE_bool('pixel_control_q_f32', _DEFAULTS.pixel_control_q_f32,
+                  'Cast the pixel-control Q-map to float32 at the '
+                  'head (default). False keeps it in the compute '
+                  'dtype until the loss gather/max — a byte lever '
+                  'that bf16-rounds the Q-values the loss sees.')
 flags.DEFINE_float('grad_clip_norm', _DEFAULTS.grad_clip_norm,
                    'Global gradient-norm clip (None = off, the '
                    'reference behavior).')

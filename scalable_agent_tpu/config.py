@@ -88,6 +88,38 @@ class Config:
   pixel_control_cost: float = 0.0         # >0 enables UNREAL aux task
   pixel_control_discount: float = 0.9
   pixel_control_cell_size: int = 4
+  # --- Pixel-control fast path (round 6, docs/PERF.md itemization).
+  # Three candidate levers, each parity-gated (tests/test_unreal.py)
+  # and measured head-to-head by bench.py's `pc_levers` stage every
+  # round. DEFAULTS STAY AT THE r5 REFERENCE FORMS: per the repo's
+  # measured accept/reject discipline a default only flips on CHIP
+  # numbers, and the round-6 build host had no chip — the CPU-backend
+  # compile evidence (scripts/attribute_bytes.py) actually favors the
+  # reference forms there (the CPU emitter single-pass-fuses the f32
+  # reward reduce and materializes the d2s interleave), which is
+  # precisely why these were not flipped blind. BENCH_rN's pc_levers
+  # rows carry the on-chip call.
+  #
+  # Integer-domain pseudo-rewards: uint8 |Δ| + int32 cell sums, f32
+  # only at the tiny [T, B, Hc, Wc] output — no full-resolution float
+  # frame temporary can exist, where the f32 form leaves that choice
+  # to the backend's fusion. Mathematically identical (exact integer
+  # sum + one correctly-rounded scale); auto-falls back to the f32
+  # form for non-uint8 frames.
+  pixel_control_integer_rewards: bool = False
+  # Q-head deconv implementation: 'deconv' (the r5 nn.ConvTranspose
+  # reference form) | 'd2s' (the stride-2 4x4 deconv re-expressed as
+  # one dense 2x2 conv + depth-to-space interleave — parameter-
+  # identical, checkpoint-interchangeable, numerics-parity-gated; no
+  # zero-stuffed fractionally-strided conv, at the price of an
+  # explicit interleave relayout).
+  pixel_control_head_impl: str = 'deconv'  # deconv | d2s
+  # Cast the pixel-control Q-map to float32 at the head output (the
+  # r5 form). False keeps it in the compute dtype until the loss's
+  # gather/max — halves the [T+1·B, Hc, Wc, A] head-output bytes at
+  # the cost of bf16-rounding the Q-values the loss sees
+  # (numerics-AFFECTING: opt-in, measured by pc_levers).
+  pixel_control_q_f32: bool = True
   grad_clip_norm: Optional[float] = None
   checkpoint_secs: int = 600              # reference save_checkpoint_secs
   # Learner steps between cross-host checkpoint-cadence broadcasts

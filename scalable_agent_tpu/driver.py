@@ -77,6 +77,8 @@ def build_agent(config: Config, num_actions: int,
                                        else 0),
                      use_pixel_control=config.pixel_control_cost > 0,
                      pixel_control_cell_size=config.pixel_control_cell_size,
+                     pixel_control_head_impl=config.pixel_control_head_impl,
+                     pixel_control_q_f32=config.pixel_control_q_f32,
                      scan_unroll=config.scan_unroll,
                      dtype=dtype)
 

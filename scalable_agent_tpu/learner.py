@@ -159,8 +159,12 @@ def loss_fn(params, agent, batch: ActorOutput, config: Config,
     # deltas; action on the t→t+1 transition is agent_outputs[t+1]
     # (the [1:] slice — same alignment as the policy inputs).
     frames = batch.env_outputs.observation[0]
+    # The opt-in integer-domain rewards need uint8 frames; any float
+    # observation source falls back to the f32 reference form.
+    use_int = (config.pixel_control_integer_rewards and
+               frames.dtype == jnp.uint8)
     pc_rewards = unreal.pixel_control_rewards(
-        frames, config.pixel_control_cell_size)
+        frames, config.pixel_control_cell_size, integer_path=use_int)
     pc_loss = unreal.pixel_control_loss(
         pc_q, inputs.actions, pc_rewards,
         jnp.asarray(batch.env_outputs.done)[1:],

@@ -61,6 +61,12 @@ class ImpalaAgent(nn.Module):
   # UNREAL pixel control (unreal.py): adds the auxiliary deconv Q-head.
   use_pixel_control: bool = False
   pixel_control_cell_size: int = 4
+  # Q-head deconv implementation ('deconv' | 'd2s') and output dtype —
+  # the round-6 fast-path knobs (config.pixel_control_head_impl /
+  # pixel_control_q_f32; parity-gated in tests/test_unreal.py). Both
+  # impls share one param tree, so checkpoints are interchangeable.
+  pixel_control_head_impl: str = 'deconv'
+  pixel_control_q_f32: bool = True
   # Partial unrolling of the LSTM time scan (XLA loop unroll factor):
   # amortizes per-iteration loop overhead on TPU; must divide nothing
   # (lax.scan handles remainders). 1 = plain scan.
@@ -137,6 +143,8 @@ class ImpalaAgent(nn.Module):
       hc, wc = frame.shape[2] // cell, frame.shape[3] // cell
       pc_q = PixelControlHead(self.num_actions, (hc, wc),
                               dtype=self.dtype,
+                              head_impl=self.pixel_control_head_impl,
+                              out_f32=self.pixel_control_q_f32,
                               name='pixel_control')(flat_core)
       self.sow('intermediates', 'pixel_control_q',
                pc_q.reshape(t, b, hc, wc, self.num_actions))

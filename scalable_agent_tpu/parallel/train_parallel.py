@@ -44,12 +44,19 @@ def make_sharded_train_state(params, config: Config, mesh: Mesh,
 
 
 def make_sharded_train_step(agent, config: Config, mesh: Mesh,
-                            example_batch):
+                            example_batch, donate: bool = True):
   """Jit the learner step with explicit in/out shardings over the mesh.
 
   Returns (train_step, place_batch): `place_batch` device_puts a host
   batch with the data-axis sharding — the host→device edge of the
   trajectory transport (the reference's StagingArea role).
+
+  donate: donate the input state for in-place HBM update (the
+  production default). False exists for environments whose jaxlib
+  mis-sizes donation aliases of TP-sharded leaves ("Expected aliased
+  input ... to have the same size" — the pre-existing bug xfail'd in
+  tests/test_parallel.py); __graft_entry__'s dryrun falls back to it
+  so the parity gate still runs there.
   """
   train_step = learner_lib.make_train_step_fn(agent, config)
   batch_shard = mesh_lib.batch_shardings(
@@ -61,7 +68,7 @@ def make_sharded_train_step(agent, config: Config, mesh: Mesh,
       train_step,
       in_shardings=(None, batch_shard),  # state keeps its placement
       out_shardings=(None, replicated),
-      donate_argnums=(0,))
+      donate_argnums=(0,) if donate else ())
 
   def place_batch(host_batch):
     """Host numpy → globally-sharded device arrays. Each process passes

@@ -33,7 +33,23 @@ echo '   socket garbage + NaN burst + interrupted save; asserts zero'
 echo '   learner crashes, >=1 rollback, monotone frames — <60 s) =='
 CHAOS_SMOKE=1 python scripts/chaos.py
 
-echo '== byte-attribution smoke (cost_analysis mechanics) =='
+echo '== pixel-control fast-path parity (integer rewards + d2s head'
+echo '   + bf16-Q levers vs the r5 reference forms — <60 s CPU) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_unreal.py -q \
+  -k 'parity or fast_path or bf16' -p no:cacheprovider
+
+echo '== v5e-16 AOT memory-fit smoke (compiled per-device HBM check'
+echo '   mechanics on 8 virtual devices; flagship check runs in the'
+echo '   multi-chip dry-run artifact — <60 s CPU) =='
+SMOKE=1 JAX_PLATFORMS=cpu python scripts/aot_fit.py
+
+echo '== torso return-comparison smoke (deep vs deep_fast harness'
+echo '   mechanics; the real head-to-head is scripts/compare_torsos.py'
+echo '   on the chip) =='
+SMOKE=1 JAX_PLATFORMS=cpu python scripts/compare_torsos.py
+
+echo '== byte-attribution smoke (cost_analysis mechanics + the'
+echo '   round-6 feature itemization rows) =='
 SMOKE=1 python scripts/attribute_bytes.py
 
 echo '== conv-lever smoke (variant mechanics + argmax-VJP parity) =='

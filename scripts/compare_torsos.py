@@ -1,0 +1,125 @@
+"""Head-to-head RETURN-quality harness: `deep` vs `deep_fast`.
+
+`deep_fast` ships a 34–46% throughput carrot (docs/PERF.md r5) but is
+a DIFFERENT FUNCTION (receptive field 3 vs 5 per section, no max
+nonlinearity), and its only learning evidence is the trivial bandit —
+VERDICT r5 weak #5: "an operator has a 46% carrot and no
+return-quality data". Until this harness has been run on real
+hardware and the curves recorded, README and `--torso` advertise
+deep_fast as *throughput variant, unvalidated returns*.
+
+This script is the one-command way to earn (or revoke) the demotion:
+both torsos train head-to-head on `cue_memory` — the CI task built to
+require vision + MEMORY (the cue is only visible on the first frame;
+see envs/fake.py CueMemoryEnv) — through the PRODUCTION pipeline
+(driver.train: batcher → buffer → prefetcher → learner), same seed
+and frame budget, and the per-episode return curves land in
+TORSO_COMPARE.json.
+
+    python scripts/compare_torsos.py             # real run (chip)
+    SMOKE=1 python scripts/compare_torsos.py     # mechanics, CPU <60 s
+
+The artifact records curves and final means; it asserts only
+mechanics (episodes finished, curves non-empty) — the accept/reject
+call on return parity is a human judgment documented in docs/PERF.md
+and README, with this JSON as the evidence.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _return_curve(logdir, buckets=10):
+  """[(step, ep_return)] from summaries.jsonl, bucketed into step
+  deciles (mean per bucket) — the curve shape without per-episode
+  noise."""
+  events = []
+  with open(os.path.join(logdir, 'summaries.jsonl')) as f:
+    for line in f:
+      e = json.loads(line)
+      if e.get('tag', '').endswith('/episode_return'):
+        events.append((e['step'], e['value']))
+  if not events:
+    return [], 0.0
+  events.sort()
+  max_step = max(s for s, _ in events) or 1
+  sums = [[0.0, 0] for _ in range(buckets)]
+  for step, value in events:
+    i = min(step * buckets // (max_step + 1), buckets - 1)
+    sums[i][0] += value
+    sums[i][1] += 1
+  curve = [round(s / n, 3) if n else None for s, n in sums]
+  tail = [v for v in curve[-3:] if v is not None]
+  return curve, round(sum(tail) / max(len(tail), 1), 3)
+
+
+def run_one(torso, smoke, seed=11):
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu.config import Config
+
+  cfg = Config(
+      logdir=tempfile.mkdtemp(prefix=f'torso_cmp_{torso}_'),
+      env_backend='cue_memory',
+      num_actions=3,
+      num_actors=4 if not smoke else 2,
+      batch_size=4 if not smoke else 2,
+      unroll_length=16 if not smoke else 8,
+      num_action_repeats=1,
+      height=72 if not smoke else 24,
+      width=96 if not smoke else 32,
+      torso=torso,
+      compute_dtype='bfloat16' if not smoke else 'float32',
+      use_py_process=False,     # in-process envs; the driver path
+                                # (batcher/buffer/prefetcher) is the
+                                # pipeline under test, not the IPC
+      use_instruction=False,
+      learning_rate=0.003, entropy_cost=0.01, discounting=0.9,
+      total_environment_frames=10**8,
+      checkpoint_secs=10**6, summary_secs=2 if not smoke else 1,
+      seed=seed)
+  max_steps = 400 if not smoke else 8
+  run = driver.train(cfg, max_steps=max_steps, stall_timeout_secs=180)
+  curve, tail_mean = _return_curve(cfg.logdir)
+  return {
+      'torso': torso,
+      'steps': int(run.state.update_steps),
+      'frames': int(run.frames),
+      'return_curve_deciles': curve,
+      'tail_mean_return': tail_mean,
+  }
+
+
+def main():
+  smoke = os.environ.get('SMOKE') == '1'
+  if smoke:
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+  results = {'task': 'cue_memory',
+             'note': ('memory policy 3.0, best memoryless 2.33 — a '
+                      'torso that cannot feed the LSTM usable '
+                      'features plateaus below 2.6 '
+                      '(tests/test_e2e_smoke.py)'),
+             'runs': [run_one(t, smoke) for t in ('deep', 'deep_fast')]}
+  for run in results['runs']:
+    assert run['steps'] > 0, run
+    if not smoke:
+      assert run['return_curve_deciles'], (
+          f"no episodes finished for {run['torso']} — window too "
+          'short for the curve to exist')
+  out = os.environ.get('TORSO_COMPARE_OUT', os.path.join(
+      os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+      'TORSO_COMPARE.json'))
+  with open(out, 'w') as f:
+    json.dump(results, f, indent=1)
+  print(json.dumps(results))
+  print('compare_torsos OK ->', out)
+
+
+if __name__ == '__main__':
+  from scalable_agent_tpu.runtime.py_process import warm_forkserver
+  warm_forkserver()
+  main()

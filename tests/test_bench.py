@@ -29,6 +29,17 @@ def test_emit_writes_artifact_and_prints_headline_last(tmp_path,
   out = {
       'metric': 'learner_env_frames_per_sec_per_chip',
       'value': 123.4, 'vs_baseline': 0.01,
+      # Round-6 itemization: the popart/pc/instruction split must ride
+      # the clip-safe last line (ISSUE-3 satellite).
+      'no_instruction_fps': 130.0,
+      'popart_only_fps': 125.0,
+      'pc_only_fps': 110.0,
+      'full_feature_fps': 100.0,
+      'deep_fast_fps': 180.0,
+      'pc_levers': {
+          'r5_reference': {'median': 100.0},
+          'int_rewards_d2s': {'median': 120.0},
+          'default': 'int_rewards_d2s'},
       'e2e_fed': {'fps': 9000.0, 'h2d_overlap_fraction': 0.9},
       'transport': {'ingest_1conn': {'unrolls_per_sec': 900.0},
                     'ingest_4conn': {'unrolls_per_sec': 1500.0}},
@@ -49,6 +60,12 @@ def test_emit_writes_artifact_and_prints_headline_last(tmp_path,
   assert head['pump_contended_unrolls_per_sec'] == 400.0
   assert head['pump_contended_ack_p99_ms'] == 5.0
   assert head['h2d_overlap_fraction'] == 0.9
+  # The itemized split survives the clip-safe line.
+  assert head['full_feature_fps'] == 100.0
+  assert head['popart_only_fps'] == 125.0
+  assert head['pc_only_fps'] == 110.0
+  assert head['pc_levers'] == {'r5_reference': 100.0,
+                               'int_rewards_d2s': 120.0}
   assert len(lines[-1]) < 1000  # compact: survives tail truncation
 
 

@@ -169,6 +169,33 @@ def test_full_feature_sharded_matches_single_device(model_parallelism):
                                rtol=5e-4, atol=5e-6)
 
 
+def test_aot_memory_fit_mechanics():
+  """The compiled v5e-16 HBM fit check (parallel/fit.py, ISSUE-3):
+  abstract-lower + compile the full-feature step over a pure-DP mesh
+  and read per-device buffer sizes from memory_analysis — no param or
+  batch buffer may be needed. Tiny shapes on the 8-device test mesh;
+  the flagship figures land in the MULTICHIP artifact via
+  __graft_entry__.dryrun_multichip."""
+  from scalable_agent_tpu.parallel import fit
+  result = fit.aot_memory_fit(devices=jax.devices(), batch_size=8,
+                              unroll_length=4, height=24, width=32,
+                              num_tasks=3)
+  assert result['mesh'] == {'data': 8}
+  assert result['per_device_batch'] == 1
+  assert result['live_bytes'] > 0
+  assert result['live_bytes'] == (
+      result['argument_bytes'] + result['output_bytes'] +
+      result['temp_bytes'] - result['alias_bytes'])
+  # Tiny shapes fit with enormous margin; `fits` is the gate the
+  # dryrun asserts at flagship shapes.
+  assert result['fits']
+  assert 'GiB' in fit.format_fit(result)
+  # Indivisible batch is a usage error, not a silent reshard.
+  with pytest.raises(ValueError, match='divide'):
+    fit.aot_memory_fit(devices=jax.devices(), batch_size=3,
+                       unroll_length=4, height=24, width=32)
+
+
 def test_param_sharding_rules():
   """TP must actually cut the bulk of the params — the LSTM core and
   the torso Convs, not just anonymous Dense projections (VERDICT W2:
