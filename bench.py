@@ -302,6 +302,11 @@ def _run_e2e_window(cfg, smoke, label):
       'steady_secs': round(span, 1),
       'inference_mean_batch': round(
           last.get('inference_mean_batch', 0.0), 2),
+      # Per-merged-call service latency (round 7 summaries): read with
+      # mean_batch — merge going up while p99 explodes means the floor
+      # is buying batch size with actor stall time.
+      'inference_p99_ms': round(
+          last.get('inference_latency_p99_ms', 0.0), 2),
       'buffer_unrolls': last.get('buffer_unrolls', 0.0),
       'frames': int(run.frames),
   }
@@ -349,6 +354,134 @@ def bench_e2e(smoke):
       sweep.append(w)
     result['batcher_sweep'] = sweep
   return result
+
+
+def bench_inference_plane(smoke):
+  """The actor-plane instrument (round 7): drive the InferenceServer
+  with a synthetic actor fleet — threads looping policy() on canned
+  observations, NO env stepping — and itemize policy-calls/s plus
+  per-call latency p50/p99 across {carry-passing vs state-cache} ×
+  {pipeline depth 1 vs 2} × fleet size. The e2e bench showed the
+  pipeline actor/inference-bound (`inference_mean_batch` the governing
+  knob); these rows isolate the server itself so the cache and
+  pipeline defaults are accepted/rejected on measurement, per the
+  repo's discipline (config.py inference_state_cache rationale).
+
+  Every cell runs pad_batch_to=fleet (ONE compiled bucket per server —
+  the merge floor is AUTO'd to the fleet anyway, so steady merges land
+  in that bucket) and the flagship inference shapes (deep torso, 72x96
+  uint8 frames, bf16 compute; tiny shallow shapes in smoke).
+  Latencies are client-side (submit → answer, batcher wait included);
+  the server-side merged-call latency rides along from stats().
+  """
+  import threading
+  import numpy as np
+  import jax
+  import jax.numpy as jnp
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.models import ImpalaAgent, init_params
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+  from scalable_agent_tpu.ops.dynamic_batching import BatcherCancelled
+  from scalable_agent_tpu.runtime.inference import (InferenceServer,
+                                                    percentile_ms)
+  from scalable_agent_tpu.structs import StepOutput, StepOutputInfo
+
+  h, w = (72, 96) if not smoke else (24, 32)
+  torso = 'deep' if not smoke else 'shallow'
+  dtype = jnp.bfloat16 if not smoke else jnp.float32
+  dur = 5.0 if not smoke else 0.6
+  fleet_sizes = (8, 32) if not smoke else (3,)
+  num_actions = 9
+  obs_spec = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+  agent = ImpalaAgent(num_actions=num_actions, torso=torso,
+                      use_instruction=False, dtype=dtype)
+  params = init_params(agent, jax.random.PRNGKey(0), obs_spec)
+  rng = np.random.RandomState(0)
+  frame = rng.randint(0, 255, (h, w, 3)).astype(np.uint8)
+  instr = np.zeros((MAX_INSTRUCTION_LEN,), np.int32)
+
+  def run_cell(fleet, cache, depth):
+    cfg = Config(inference_min_batch=0, inference_max_batch=max(64, fleet),
+                 inference_timeout_ms=20, inference_state_cache=cache,
+                 inference_pipeline_depth=depth)
+    server = InferenceServer(agent, params, cfg, seed=7,
+                             pad_batch_to=fleet, fleet_size=fleet)
+    server.warmup(obs_spec, sizes=[fleet])
+    counts = [0] * fleet
+    lats = [[] for _ in range(fleet)]
+    measuring = threading.Event()
+    stop = threading.Event()
+
+    def run(i):
+      state = server.initial_core_state()
+      prev = np.int32(i % num_actions)
+      step = 0
+      try:
+        while not stop.is_set():
+          env_out = StepOutput(
+              reward=np.float32(0.1),
+              info=StepOutputInfo(np.float32(0), np.int32(0)),
+              done=np.bool_(step > 0 and step % 23 == 0),
+              observation=(frame, instr))
+          t0 = time.perf_counter()
+          out, state = server.policy(prev, env_out, state)
+          dt = time.perf_counter() - t0
+          counts[i] += 1
+          if measuring.is_set():
+            lats[i].append(dt)
+          prev = np.int32(out.action)
+          step += 1
+      except BatcherCancelled:
+        pass
+      finally:
+        if hasattr(state, 'release'):
+          state.release()
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(fleet)]
+    for t in threads:
+      t.start()
+    # Warm until every thread is feeding (startup must not eat the
+    # window — same rule as the transport stages).
+    deadline = time.perf_counter() + (60 if not smoke else 120)
+    while (not all(c > 0 for c in counts)
+           and time.perf_counter() < deadline):
+      time.sleep(0.05)
+    base = sum(counts)
+    measuring.set()
+    dt = _count_window(lambda: sum(counts), base, dur,
+                       min_count=fleet * 4)
+    got = sum(counts) - base
+    measuring.clear()
+    stop.set()
+    for t in threads:
+      t.join(timeout=15)
+    stats = server.stats()
+    server.close()
+    for t in threads:
+      t.join(timeout=5)
+    if got == 0:
+      raise RuntimeError(
+          f'inference_plane moved no calls (cache={cache} depth='
+          f'{depth} fleet={fleet})')
+    window = sorted(x for lat in lats for x in lat)
+    return {
+        'policy_calls_per_sec': round(got / dt, 1),
+        'lat_p50_ms': round(percentile_ms(window, 0.5, 1e3), 2),
+        'lat_p99_ms': round(percentile_ms(window, 0.99, 1e3), 2),
+        'mean_batch': round(stats['mean_batch'], 2),
+        'merged_call_p50_ms': stats['latency_p50_ms'],
+        'merged_call_p99_ms': stats['latency_p99_ms'],
+        'inflight_peak': stats['inflight_peak'],
+    }
+
+  results = {'fleet_sizes': list(fleet_sizes)}
+  for fleet in fleet_sizes:
+    for cache in (False, True):
+      for depth in (1, 2):
+        name = f"{'cache' if cache else 'carry'}_d{depth}_f{fleet}"
+        results[name] = run_cell(fleet, cache, depth)
+  return results
 
 
 class _SyntheticFleet:
@@ -962,13 +1095,13 @@ def bench_param_fanout(smoke):
               'mb_per_sec': round(fetched * wire_mb / dt, 1)}
     pump_stats = None
     if with_pump and window_lat:
+      # The shared nearest-rank percentile (runtime.inference): the
+      # bench rows and the live stats() must compute identically.
+      from scalable_agent_tpu.runtime.inference import percentile_ms
       pump_stats = {
           'unrolls_per_sec': round(pumped / dt, 1),
-          'ack_p50_ms': round(
-              window_lat[len(window_lat) // 2] * 1e3, 2),
-          'ack_p99_ms': round(
-              window_lat[int(len(window_lat) * 0.99)
-                         if len(window_lat) > 1 else -1] * 1e3, 2),
+          'ack_p50_ms': round(percentile_ms(window_lat, 0.5, 1e3), 2),
+          'ack_p99_ms': round(percentile_ms(window_lat, 0.99, 1e3), 2),
       }
     return fanout, pump_stats
 
@@ -1042,6 +1175,23 @@ def main():
     import jax
     jax.config.update('jax_platforms', 'cpu')
 
+  # BENCH_ONLY=inference_plane: run just the actor-plane stage (the
+  # scripts/ci.sh smoke — the full bench's compile budget doesn't fit
+  # a CI lane; the stage's mechanics must still be exercised there).
+  if os.environ.get('BENCH_ONLY') == 'inference_plane':
+    infer = bench_inference_plane(smoke)
+    best = max((row['policy_calls_per_sec']
+                for row in infer.values() if isinstance(row, dict)),
+               default=0.0)
+    _emit({
+        'metric': 'inference_plane_policy_calls_per_sec',
+        'value': best,
+        'unit': ('policy calls/sec, best variant%s'
+                 % (' (SMOKE)' if smoke else '')),
+        'inference_plane': infer,
+    })
+    return
+
   rows = bench_synthetic(smoke)
   cfg = rows['config']
   stats = rows['synthetic']
@@ -1059,6 +1209,9 @@ def main():
   anakin = None
   if os.environ.get('BENCH_SKIP_ANAKIN') != '1':
     anakin = bench_anakin(smoke)
+  infer = None
+  if os.environ.get('BENCH_SKIP_INFERENCE') != '1':
+    infer = bench_inference_plane(smoke)
 
   baseline_per_chip = 200_000.0 / 16.0  # north star / v5e-16 chips
   out = {
@@ -1092,6 +1245,8 @@ def main():
     out['param_fanout'] = fanout
   if anakin is not None:
     out['anakin'] = anakin
+  if infer is not None:
+    out['inference_plane'] = infer
   _emit(out)
 
 
@@ -1102,7 +1257,7 @@ def _headline(out):
   head = {
       'metric': out['metric'],
       'value': out['value'],
-      'vs_baseline': out['vs_baseline'],
+      'vs_baseline': out.get('vs_baseline'),
       'artifact': 'BENCH_OUT.json',
   }
   # The full-feature itemization (round 6): the popart/pc/instruction
@@ -1137,6 +1292,18 @@ def _headline(out):
     if fanout.get('pump_alone'):
       head['pump_alone_unrolls_per_sec'] = (
           fanout['pump_alone']['unrolls_per_sec'])
+  # The actor-plane itemization (round 7): the cache×pipeline call
+  # — calls/s + latency p50/p99 at the largest fleet — must ride the
+  # clip-safe last line (any state-cache / pipeline-depth default flip
+  # is justified by exactly these rows).
+  infer = out.get('inference_plane')
+  if infer:
+    fmax = max(infer.get('fleet_sizes') or [0])
+    head['inference_plane'] = {
+        name: {'cps': row['policy_calls_per_sec'],
+               'p50': row['lat_p50_ms'], 'p99': row['lat_p99_ms']}
+        for name, row in infer.items()
+        if isinstance(row, dict) and name.endswith(f'_f{fmax}')}
   return head
 
 

@@ -155,6 +155,24 @@ flags.DEFINE_integer('inference_max_batch', _DEFAULTS.inference_max_batch,
 flags.DEFINE_integer('inference_timeout_ms',
                      _DEFAULTS.inference_timeout_ms,
                      'Dynamic batcher flush timeout.')
+flags.DEFINE_bool('inference_state_cache',
+                  _DEFAULTS.inference_state_cache,
+                  'Keep each actor\'s LSTM carry in a device-resident '
+                  'state arena (gather/scatter by slot id in-graph) '
+                  'instead of shipping it host<->device every step. '
+                  'Numerics-identical (parity-gated); measured per '
+                  'round by bench.py inference_plane '
+                  '(docs/INFERENCE.md).')
+flags.DEFINE_integer('inference_pipeline_depth',
+                     _DEFAULTS.inference_pipeline_depth,
+                     'Merged inference batches in flight on device: '
+                     '2 overlaps batch assembly/H2D with the previous '
+                     'batch\'s compute; 1 = serial dispatch.')
+flags.DEFINE_integer('inference_state_slots',
+                     _DEFAULTS.inference_state_slots,
+                     'State-arena capacity in slots (state-cache '
+                     'mode). 0 = auto: 2x the fleet size (respawn '
+                     'headroom).')
 flags.DEFINE_integer('num_actions', _DEFAULTS.num_actions,
                      'Policy head size override (None = backend '
                      'default; Atari: 18 full set, fewer = minimal '

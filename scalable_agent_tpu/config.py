@@ -142,6 +142,32 @@ class Config:
   inference_min_batch: int = 0
   inference_max_batch: int = 1024
   inference_timeout_ms: int = 100
+  # --- Actor-plane inference overhaul (round 7; docs/INFERENCE.md).
+  # Device-resident recurrent-state cache: each actor owns a slot in
+  # an on-device [slots, hidden] arena; the jitted step gathers the
+  # carry by slot id and scatters the new one in-graph (Podracer,
+  # arXiv:2104.06272), so the per-step wire drops to (action, reward,
+  # done, frame, instr, slot_id) and the LSTM carry crosses the host
+  # boundary once per UNROLL (the learner's agent_state snapshot)
+  # instead of twice per STEP. Numerics-identical to carry-passing
+  # (golden parity gate, tests/test_runtime.py — done edges, respawn
+  # slot reuse, sharded eval). DEFAULT OFF pending chip rows: per the
+  # repo's measured accept/reject discipline a default only flips on
+  # chip numbers, and bench.py's inference_plane stage measures
+  # cache×depth head-to-head every round so BENCH_rN carries the
+  # call (this build host's CPU rows are recorded in docs/PERF.md r7).
+  inference_state_cache: bool = False
+  # Dispatched-but-uncompleted merged inference batches allowed in
+  # flight (the actor-plane mirror of staging_depth): 2 lets merged
+  # batch k+1 assemble and land on device while batch k computes —
+  # per-call latency absorbs the overlap, calls/s gains. 1 restores
+  # the pre-round serialized assemble→dispatch→readback loop.
+  inference_pipeline_depth: int = 2
+  # State-arena capacity in slots (state-cache mode only). 0 = auto:
+  # 2× the fleet size with a small floor — respawn headroom, because
+  # a wedged actor's slot frees only when its orphaned thread
+  # unwinds (runtime/fleet.py respawn contract).
+  inference_state_slots: int = 0
   # Ring buffer capacity in batches (reference FIFOQueue capacity=1 +
   # StagingArea double buffer ⇒ bounded policy lag; keep it small).
   queue_capacity_batches: int = 1

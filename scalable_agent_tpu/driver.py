@@ -86,13 +86,22 @@ def build_agent(config: Config, num_actions: int,
 def make_fleet(config: Config, agent, policy, buffer, levels,
                seed_base: int = 0, level_offset: int = 0,
                is_test: bool = False,
-               num_actors: Optional[int] = None) -> ActorFleet:
+               num_actors: Optional[int] = None,
+               initial_state_fn=None) -> ActorFleet:
   """The one env+actor+fleet construction, shared by train(),
   evaluate(), and the remote-actor role (they differ only in seeds,
   level assignment, and fleet size). Actor i plays
   levels[(level_offset + i) % len] with env seed `seed_base + i + 1`.
+
+  `initial_state_fn` builds each actor's policy core state, called
+  fresh at every (re)spawn — pass the InferenceServer's
+  `initial_core_state` so state-cache mode hands each actor a zeroed
+  arena slot (a respawned actor must never inherit a stale carry);
+  None falls back to the plain numeric zero carry.
   """
   n = config.num_actors if num_actors is None else num_actors
+  if initial_state_fn is None:
+    initial_state_fn = lambda: agent.initial_state(1)  # noqa: E731
 
   def make_actor(i):
     idx = level_offset + i
@@ -105,7 +114,7 @@ def make_fleet(config: Config, agent, policy, buffer, levels,
     # Fault-injection seam (runtime/faults.py): identity unless an
     # installed plan targets env_step.
     env = faults_lib.maybe_wrap_env(env)
-    actor = Actor(env, policy, agent.initial_state(1),
+    actor = Actor(env, policy, initial_state_fn(),
                   unroll_length=config.unroll_length,
                   num_action_repeats=config.num_action_repeats,
                   level_name_id=idx % len(levels))
@@ -359,7 +368,7 @@ def train(config: Config, max_steps: Optional[int] = None,
     # itself — which the first train step DONATES. Without this copy,
     # actors would run inference on deleted buffers (real on TPU;
     # invisible on CPU tests, where jit ignores donation).
-    server.update_params(initial_pub)
+    server.update_params(initial_pub, version=_initial_steps)
     # Pre-compile inference buckets up to the fleet size: a bucket's
     # first appearance otherwise stalls every parked actor for the TPU
     # compile (the reference's TF graph had dynamic batch dims). With
@@ -371,7 +380,8 @@ def train(config: Config, max_steps: Optional[int] = None,
 
     if fleet_factory is None:
       fleet = make_fleet(config, agent, server.policy, buffer, levels,
-                         seed_base=process_seed_base)
+                         seed_base=process_seed_base,
+                         initial_state_fn=server.initial_core_state)
     else:
       fleet = fleet_factory(config, agent, server.policy, buffer,
                             levels)
@@ -648,8 +658,10 @@ def train(config: Config, max_steps: Optional[int] = None,
         # actor_params is a cross-host collective in multi-host-TP
         # mode: it must run UNCONDITIONALLY here (lockstep branch),
         # never inside the per-host time-gated ingest publish below.
+        # version=step_now gates the server's whole-tree copy: a
+        # republish of the same step's snapshot is a counted no-op.
         published = actor_params(state.params)
-        server.update_params(published)
+        server.update_params(published, version=step_now)
         if (ingest is not None and
             time.monotonic() - last_remote_publish >=
             config.remote_publish_secs and
@@ -713,6 +725,17 @@ def train(config: Config, max_steps: Optional[int] = None,
         # versions" caveat, made observable).
         writer.scalar('params_version', snap['params_version'],
                       step_now)
+        # Actor-plane service time (round 7): per-merged-call latency
+        # percentiles over the recent window — the inference-plane
+        # bench's unit, exported live so a production regression shows
+        # in the same numbers the bench rows use. publishes_skipped
+        # counts version-gated no-op publishes (copy avoided).
+        writer.scalar('inference_latency_p50_ms',
+                      snap['latency_p50_ms'], step_now)
+        writer.scalar('inference_latency_p99_ms',
+                      snap['latency_p99_ms'], step_now)
+        writer.scalar('inference_publishes_skipped',
+                      snap['publishes_skipped'], step_now)
         # Per-interval action distribution (cumulative would hide a
         # late policy collapse).
         writer.histogram('actions', action_counts_acc, step_now)
@@ -969,7 +992,8 @@ def evaluate(config: Config,
                          test_levels,
                          seed_base=config.seed - 1 + start,
                          level_offset=start, is_test=True,
-                         num_actors=my_count)
+                         num_actors=my_count,
+                         initial_state_fn=server.initial_core_state)
     except BaseException:
       if server is not None:
         server.close()

@@ -202,9 +202,29 @@ class Batcher:
 
   # -- computation-thread side --
 
-  def get_batch(self):
-    """Block for the next merged batch → (batch_id, [np arrays]) or
-    None when the batcher is closed and drained."""
+  def input_meta(self):
+    """[(dtype, trailing_shape)] per input tensor, or None before the
+    first compute() call fixed it."""
+    with self._meta_lock:
+      return list(self._in_meta) if self._in_meta is not None else None
+
+  def get_batch_into(self, make_buffers):
+    """Zero-copy variant of `get_batch`: the C++ merge-copy lands in
+    caller-provided storage instead of freshly allocated arrays (the
+    inference server hands its preallocated padded staging buffers, so
+    the merged batch materializes already padded — no second
+    concatenate/pad pass).
+
+    Args:
+      make_buffers: callable `(total_rows) -> [np.ndarray]` returning
+        one C-contiguous array per input tensor, dtype/trailing shape
+        matching `input_meta()` and leading capacity >= total_rows
+        (only the first total_rows rows are written).
+
+    Returns:
+      (batch_id, total_rows, buffers) — or None when the batcher is
+      closed and drained.
+    """
     i64 = ctypes.c_longlong
     batch_id, total_rows = i64(0), i64(0)
     rc = self._lib.batcher_get_batch(
@@ -212,19 +232,39 @@ class Batcher:
     if rc == RC_CLOSED:
       return None
     assert rc == RC_OK, rc
-    with self._meta_lock:
-      in_meta = list(self._in_meta)
-    arrays = []
-    for i, (dtype, trail) in enumerate(in_meta):
-      buf = np.empty((total_rows.value,) + tuple(trail), dtype)
-      rc = self._lib.batcher_batch_input_copy(
-          self._h, batch_id, i, buf.ctypes.data_as(ctypes.c_void_p))
-      if rc != RC_OK:
-        # close() raced us and erased the batch — don't hand the
-        # caller uninitialized memory; treat as shutdown.
-        return None
-      arrays.append(buf)
-    return batch_id.value, arrays
+    try:
+      buffers = make_buffers(total_rows.value)
+      for i, buf in enumerate(buffers):
+        rc = self._lib.batcher_batch_input_copy(
+            self._h, batch_id, i, buf.ctypes.data_as(ctypes.c_void_p))
+        if rc != RC_OK:
+          # close() raced us and erased the batch — don't hand the
+          # caller uninitialized memory; treat as shutdown.
+          return None
+      return batch_id.value, total_rows.value, buffers
+    except Exception as e:
+      # The batch was already dequeued: a make_buffers failure (e.g.
+      # allocation under memory pressure) must not strand its parked
+      # callers in compute_wait — answer them with the error, then
+      # let the caller decide whether its loop survives.
+      self.set_error(batch_id.value, f'{type(e).__name__}: {e}')
+      raise
+
+  def get_batch(self):
+    """Block for the next merged batch → (batch_id, [np arrays]) or
+    None when the batcher is closed and drained."""
+
+    def alloc(total_rows):
+      with self._meta_lock:
+        in_meta = list(self._in_meta)
+      return [np.empty((total_rows,) + tuple(trail), dtype)
+              for dtype, trail in in_meta]
+
+    item = self.get_batch_into(alloc)
+    if item is None:
+      return None
+    batch_id, _, arrays = item
+    return batch_id, arrays
 
   def set_outputs(self, batch_id: int, arrays: Sequence[np.ndarray]):
     arrays = _as_contiguous([np.asarray(a) for a in arrays])

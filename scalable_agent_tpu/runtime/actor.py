@@ -74,17 +74,31 @@ class Actor:
 
   def unroll(self) -> ActorOutput:
     """Produce one ActorOutput of [T+1] time-major numpy arrays."""
+    # Device-resident policy state (InferenceServer state-cache mode)
+    # is an opaque handle: the learner still needs the NUMERIC carry
+    # at the unroll start, so snapshot it here — the once-per-unroll
+    # host read that replaces the old once-per-step carry round trip.
+    core0 = self._core_state
+    if hasattr(core0, 'snapshot'):
+      initial_core_state = core0.snapshot()
+    else:
+      initial_core_state = core0
     env_outputs = [self._env_output]
     if self._agent_output is None:
       # Prime lazily so we know num_actions from the first policy call.
       out, _ = self._policy(np.int32(0), self._env_output,
                             self._core_state)
+      if hasattr(core0, 'write'):
+        # The carry-passing path DISCARDS the priming call's new state;
+        # a device-resident state advanced in-graph must be put back,
+        # or the cache path would start the unroll one step ahead
+        # (parity gate in tests/test_runtime.py).
+        core0.write(initial_core_state)
       self._agent_output = AgentOutput(
           action=np.int32(0),
           policy_logits=np.zeros_like(np.asarray(out.policy_logits)),
           baseline=np.float32(0.0))
     agent_outputs = [self._agent_output]
-    initial_core_state = self._core_state
 
     for _ in range(self._unroll_length):
       agent_output, core_state = self._policy(
@@ -118,7 +132,20 @@ class Actor:
         env_outputs=_tree_stack(env_outputs),
         agent_outputs=_tree_stack(agent_outputs))
 
+  def release_policy_state(self):
+    """Return device-resident policy state (a state-arena slot) to its
+    server; no-op for plain numeric carries. Idempotent — called from
+    close() on every exit path and defensively by the fleet's respawn
+    (a thread killed before its finally ran must not leak the slot)."""
+    state = self._core_state
+    if hasattr(state, 'release'):
+      try:
+        state.release()
+      except Exception:
+        pass
+
   def close(self):
+    self.release_policy_state()
     self._env.close()
 
 

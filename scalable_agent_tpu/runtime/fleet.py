@@ -138,6 +138,7 @@ class ActorFleet:
 
   def _respawn(self, slot: _Slot):
     old_thread = slot.thread
+    old_actor = slot.actor
     if slot.process is not None:
       try:
         slot.process.close(timeout=1.0)
@@ -147,11 +148,36 @@ class ActorFleet:
       # A stalled thread blocked in env.step can't be killed; it is
       # orphaned (daemon) and a fresh actor takes over the slot. Its
       # buffer.put may still land one stale unroll — harmless, same
-      # policy-lag bound as any in-flight unroll.
+      # policy-lag bound as any in-flight unroll. Its device-resident
+      # inference state (a state-arena slot) stays acquired until the
+      # thread unwinds through run_actor_loop's finally — the arena's
+      # auto headroom (2× fleet) covers the interim; the replacement
+      # gets a FRESH zeroed slot from make_actor either way.
       pass
+    elif old_actor is not None:
+      # Dead thread: run_actor_loop's finally normally released the
+      # inference state via actor.close(); this is the idempotent
+      # backstop for a thread killed before its finally ran — the
+      # respawn must free the old slot, not leak it.
+      try:
+        old_actor.release_policy_state()
+      except Exception:
+        pass
     with self._lock:
       slot.respawns += 1
-    self._spawn(slot)
+    try:
+      self._spawn(slot)
+    except Exception as e:
+      # A failed respawn (env construction, exhausted inference state
+      # arena) must not propagate into the learner loop that called
+      # check_health — start()-time spawn failures still raise (setup
+      # errors belong to the caller), but a mid-run respawn records
+      # the error on the slot: the next health check retries, and the
+      # learner surfaces it via errors() only if the pipeline actually
+      # stalls (the same containment as any other actor-side failure).
+      with self._lock:
+        slot.error = e
+        slot.thread = None
 
   def errors(self) -> List[BaseException]:
     with self._lock:

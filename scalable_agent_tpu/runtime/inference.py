@@ -8,8 +8,9 @@ via the C++ Batcher op (reference: experiment.py ≈L470–482 monkey-patch
 - actor threads call `policy(prev_action, env_output, core_state)`
   (the `runtime.actor.Actor` contract) and block;
 - the C++ batcher (ops/batcher) merges concurrent calls;
-- ONE computation thread runs the jitted single-step agent on the
-  merged batch on TPU.
+- a dispatch thread runs the jitted single-step agent on the merged
+  batch on TPU; a completion thread reads results back and unparks
+  the callers.
 
 XLA needs static shapes, so merged batches are padded up to the next
 power of two (capped at maximum_batch_size) before the jitted call and
@@ -17,13 +18,44 @@ sliced after — a handful of compiled shapes total, no recompiles in
 steady state (the reference's TF graph handled dynamic batch dims
 natively; bucketing is the XLA-idiomatic trade).
 
+Round 7 overhaul (docs/INFERENCE.md) — three independent levers:
+
+1. Device-resident core-state cache (config.inference_state_cache):
+   instead of shipping the LSTM carry host→device and the new carry
+   device→host on EVERY env step, each actor owns a slot in an
+   on-device `[slots, hidden]` state arena; the jitted step gathers
+   carries by slot id, computes, and scatters the new carries back
+   in-graph (Podracer, arXiv:2104.06272). The per-step wire drops to
+   (action, reward, done, frame, instr, slot_id); the carry crosses
+   the host boundary only once per unroll (the learner needs the
+   unroll-start state — `_SlotHandle.snapshot()`). Numerics-identical
+   to the carry-passing path (golden parity gate in
+   tests/test_runtime.py, done edges + respawn slot reuse + the
+   sharded-eval mesh included); done-reset stays in-graph via the
+   agent's `_ResetCore`.
+2. Pipelined dispatch (config.inference_pipeline_depth, default 2):
+   dispatch and completion are separate threads with a depth
+   semaphore between them, so merged batch k+1 assembles and lands on
+   device while batch k computes — the actor-plane mirror of
+   `BatchPrefetcher`'s H2D/compute overlap. Depth 1 reproduces the
+   old serialized assemble→dispatch→device_get loop.
+3. Zero-copy merge staging: the C++ batcher's merge-copy lands
+   directly in preallocated per-bucket padded staging buffers
+   (`Batcher.get_batch_into`) — no per-call np.concatenate, no
+   per-call allocation — and the PRNG key lives on device, split
+   in-graph by the jitted step instead of per-call on the host.
+
 Weights: the server holds a params snapshot updated via
 `update_params` (the reference's gRPC weight fetch becomes an on-host
 pointer swap; the same "actions within one unroll may span weight
 versions" caveat applies — reference ≈L472 comment).
 """
 
+import collections
+import logging
+import queue
 import threading
+import time
 
 import numpy as np
 
@@ -33,12 +65,62 @@ import jax.numpy as jnp
 from scalable_agent_tpu.ops import dynamic_batching
 from scalable_agent_tpu.structs import AgentOutput, StepOutput
 
+log = logging.getLogger('scalable_agent_tpu')
+
 
 def _next_power_of_two(n):
   p = 1
   while p < n:
     p *= 2
   return p
+
+
+def percentile_ms(sorted_secs_or_ms, q, scale=1.0):
+  """q-th percentile of an ascending list (nearest-rank, clamped) ×
+  scale — the ONE implementation behind stats() and the bench rows, so
+  the accept/reject numbers are computed identically everywhere."""
+  if not sorted_secs_or_ms:
+    return 0.0
+  n = len(sorted_secs_or_ms)
+  return sorted_secs_or_ms[min(n - 1, int(n * q))] * scale
+
+
+class _SlotHandle:
+  """An actor's claim on one state-arena slot (state-cache mode).
+
+  Opaque under the `runtime.actor.Actor` core-state contract; the
+  actor only touches the duck-typed surface:
+
+  - `snapshot()`: the slot's carry as host numpy `(c[1,H], h[1,H])` —
+    the once-per-unroll read the learner's `agent_state` needs.
+  - `write(carry)`: overwrite the slot (the actor's priming-call
+    undo).
+  - `release()`: return the slot to the free list (idempotent). The
+    slot is zeroed again on the NEXT acquire, so a reclaimed slot can
+    never serve a stale carry.
+  """
+
+  __slots__ = ('_server', 'slot', 'released')
+
+  def __init__(self, server, slot):
+    self._server = server
+    self.slot = slot
+    self.released = False
+
+  def snapshot(self):
+    return self._server._read_slot(self.slot)
+
+  def write(self, carry):
+    self._server._write_slot(self.slot, carry)
+
+  def release(self):
+    if not self.released:
+      self.released = True
+      self._server._release_slot(self.slot)
+
+  def __repr__(self):
+    return (f'_SlotHandle(slot={self.slot}, '
+            f'released={self.released})')
 
 
 class InferenceServer:
@@ -65,8 +147,9 @@ class InferenceServer:
       concurrently), not for training fleets whose merge size is the
       tuning signal.
     fleet_size: number of actor threads this server will serve —
-      only consulted when config.inference_min_batch == 0 (AUTO merge
-      floor; see the constructor comment).
+      consulted when config.inference_min_batch == 0 (AUTO merge
+      floor; see the constructor comment) and when sizing the state
+      arena (config.inference_state_slots == 0).
   """
 
   def __init__(self, agent, params, config, seed=0, mesh=None,
@@ -86,7 +169,8 @@ class InferenceServer:
     self._agent = agent
     self._core_sizes = (agent.hidden_size, agent.hidden_size)  # (c, h)
     self._mesh = mesh
-    self._devices_last_call = 0
+    self._state_cache = bool(config.inference_state_cache)
+    self._depth = max(1, int(config.inference_pipeline_depth))
     if mesh is not None:
       from jax.sharding import NamedSharding, PartitionSpec
       from scalable_agent_tpu.parallel import mesh as mesh_lib
@@ -99,83 +183,359 @@ class InferenceServer:
       self._dp = 1
     self._params = params
     self._params_lock = threading.Lock()
+    # Sentinel: never equal to any caller-supplied publish version, so
+    # the first update_params always lands (see update_params).
+    self._published_version_key = object()
     self._stats_lock = threading.Lock()
     self._calls = 0
     self._merged_requests = 0
     self._params_version = 0
-    # _key is split from both warmup (caller thread) and batched (the
-    # batcher's computation thread); the lock makes that safe without
-    # relying on warmup-completes-before-serving ordering.
+    self._publishes_skipped = 0
+    self._devices_last_call = 0
+    self._inflight = 0
+    self._inflight_peak = 0
+    # Per-merged-call latency ring (assembly start → callers unparked)
+    # for the stats() p50/p99 — bounded so a week-long run's stats
+    # reflect RECENT service time, not the cumulative history.
+    self._latencies = collections.deque(maxlen=512)
+    # _key is a DEVICE array chained through the jitted step (split
+    # in-graph); the lock orders warmup (caller thread) against the
+    # dispatch thread. Same split sequence as the old host-side
+    # jax.random.split — numerics unchanged.
     self._key_lock = threading.Lock()
     self._key = jax.random.PRNGKey(seed)
+    self._base_seed = seed
+    self._chain_recoveries = 0
     self._max_batch = config.inference_max_batch
 
-    def step(params, rng, prev_action, reward, done, frame, instr,
-             core_c, core_h):
+    # --- Device-resident state arena (state-cache mode). ---
+    self._arena_lock = threading.Lock()
+    self._slot_lock = threading.Lock()
+    if self._state_cache:
+      num_slots = int(config.inference_state_slots)
+      if num_slots <= 0:
+        # Auto: 2× the fleet (respawn headroom — a wedged actor's slot
+        # frees only when its orphaned thread unwinds) with a floor,
+        # covering eval servers sized by pad_batch_to instead of
+        # fleet_size.
+        num_slots = max(2 * max(fleet_size or 0, pad_batch_to or 0), 8)
+      self._num_slots = num_slots
+      self._free = list(range(num_slots))
+      arena = tuple(jnp.zeros((num_slots, s), jnp.float32)
+                    for s in self._core_sizes)
+      if mesh is not None:
+        arena = jax.device_put(arena, self._replicated)
+      self._arena = arena
+    else:
+      self._num_slots = 0
+      self._free = []
+      self._arena = None
+    if mesh is not None:
+      self._key = jax.device_put(self._key, self._replicated)
+
+    def _apply(params, sub, prev_action, reward, done, frame, instr,
+               core_c, core_h):
       env_output = StepOutput(
           reward=reward[None], info=None, done=done[None],
           observation=(frame[None], instr[None]))
       out, (new_c, new_h) = agent.apply(
           params, prev_action[None], env_output, (core_c, core_h),
-          sample_rng=rng)
+          sample_rng=sub)
       return (out.action[0], out.policy_logits[0], out.baseline[0],
               new_c, new_h)
 
+    def carry_step(params, key, prev_action, reward, done, frame,
+                   instr, core_c, core_h):
+      key, sub = jax.random.split(key)
+      action, logits, baseline, new_c, new_h = _apply(
+          params, sub, prev_action, reward, done, frame, instr,
+          core_c, core_h)
+      return key, action, logits, baseline, new_c, new_h
+
+    def cache_step(params, key, arena_c, arena_h, slot_ids,
+                   prev_action, reward, done, frame, instr):
+      key, sub = jax.random.split(key)
+      # Gather each row's carry by slot id. Padded rows carry id ==
+      # num_slots (out of range): the gather clamps (their compute is
+      # sliced away) and the scatter DROPS them — mode='drop' is what
+      # keeps a padded row from ever corrupting a live slot.
+      core_c = arena_c[slot_ids]
+      core_h = arena_h[slot_ids]
+      action, logits, baseline, new_c, new_h = _apply(
+          params, sub, prev_action, reward, done, frame, instr,
+          core_c, core_h)
+      arena_c = arena_c.at[slot_ids].set(new_c, mode='drop')
+      arena_h = arena_h.at[slot_ids].set(new_h, mode='drop')
+      return key, arena_c, arena_h, action, logits, baseline
+
+    step = cache_step if self._state_cache else carry_step
+    num_batch_args = 6 if self._state_cache else 7
     if mesh is None:
       self._step = jax.jit(step)
     else:
-      self._step = jax.jit(
-          step,
-          # params keep their (replicated) placement; batch args shard
-          # dim 0 over the data axis; rng is replicated.
-          in_shardings=(None, self._replicated) +
-          (self._batch_sharding,) * 7,
-          out_shardings=(self._batch_sharding,) * 5)
+      # params keep their (replicated) placement; the key (and the
+      # state arena) are replicated; batch args shard dim 0 over the
+      # data axis.
+      if self._state_cache:
+        in_shardings = (None, self._replicated, self._replicated,
+                        self._replicated) + \
+            (self._batch_sharding,) * num_batch_args
+        out_shardings = (self._replicated,) * 3 + \
+            (self._batch_sharding,) * 3
+      else:
+        in_shardings = (None, self._replicated) + \
+            (self._batch_sharding,) * num_batch_args
+        out_shardings = (self._replicated,) + \
+            (self._batch_sharding,) * 5
+      self._step = jax.jit(step, in_shardings=in_shardings,
+                           out_shardings=out_shardings)
 
-    def batched(prev_action, reward, done, frame, instr, core_c,
-                core_h):
-      n = prev_action.shape[0]
-      with self._stats_lock:
-        self._calls += 1
-        self._merged_requests += n
-      padded = self._padded_size(n)
-      pad = padded - n
-
-      def pad0(x):
-        if pad == 0:
-          return x
-        return np.concatenate(
-            [x, np.zeros((pad,) + x.shape[1:], x.dtype)], axis=0)
-
-      with self._params_lock:
-        params = self._params
-      with self._key_lock:
-        self._key, sub = jax.random.split(self._key)
-      inputs = tuple(map(
-          pad0, (prev_action, reward, done, frame, instr, core_c,
-                 core_h)))
-      if self._mesh is not None:
-        # Explicit placement: under multi-process JAX, jit refuses
-        # numpy args with non-trivial shardings — and the local eval
-        # mesh is exactly that. All its devices are process-local, so
-        # the transfer itself is ordinary.
-        inputs = jax.device_put(inputs, self._batch_sharding)
-        sub = jax.device_put(sub, self._replicated)
-      outs = self._step(params, sub, *inputs)
-      # Observability for the sharded-eval contract: how many devices
-      # the last merged call actually spanned.
-      self._devices_last_call = len(outs[0].sharding.device_set)
-      # ONE device_get for all outputs: each separate device→host
-      # readback is a full round trip (85 ms through this sandbox's
-      # remote-TPU tunnel, vs ~µs co-located — either way, batching
-      # the transfer is strictly better).
-      outs = jax.device_get(outs)
-      return tuple(o[:n] for o in outs)
-
-    self._batched = dynamic_batching.batch_fn_with_options(
+    # --- Pipelined dispatch plane: the C++ batcher merges concurrent
+    # policy() calls; the dispatch thread copies each merged batch
+    # into a padded staging buffer (zero-copy via get_batch_into),
+    # dispatches the jitted step (async), and moves on to assemble
+    # the next batch; the completion thread reads results back in
+    # FIFO order and unparks the callers. The semaphore bounds
+    # dispatched-but-uncompleted batches at `depth`. ---
+    self._staging = {}        # padded size -> ring of buffer lists
+    self._staging_calls = {}  # padded size -> calls (ring index)
+    self._batcher = dynamic_batching.Batcher(
+        num_tensors=num_batch_args,
         minimum_batch_size=self._min_batch,
         maximum_batch_size=config.inference_max_batch,
-        timeout_ms=config.inference_timeout_ms)(batched)
+        timeout_ms=config.inference_timeout_ms)
+    self._sem = threading.Semaphore(self._depth)
+    self._completion_q = queue.Queue()
+    self._closed = False
+    self._dispatch_thread = threading.Thread(
+        target=self._dispatch_loop, name='inference-dispatch',
+        daemon=True)
+    self._completion_thread = threading.Thread(
+        target=self._completion_loop, name='inference-completion',
+        daemon=True)
+    self._dispatch_thread.start()
+    self._completion_thread.start()
+
+  # -- state arena (state-cache mode) --
+
+  def initial_core_state(self):
+    """Per-actor policy-state factory (driver.make_fleet's
+    initial_state_fn): zeroed host carry in carry-passing mode, a
+    freshly acquired (zeroed) arena slot in state-cache mode. Called
+    at actor (re)spawn — a respawned actor starts from a clean slot
+    either way."""
+    if not self._state_cache:
+      return tuple(np.zeros((1, s), np.float32)
+                   for s in self._core_sizes)
+    return self._acquire_slot()
+
+  def _acquire_slot(self):
+    with self._slot_lock:
+      if not self._free:
+        raise RuntimeError(
+            f'state arena exhausted ({self._num_slots} slots): more '
+            'live actors than slots — raise '
+            '--inference_state_slots (wedged-then-respawned actors '
+            'hold their old slot until the orphaned thread unwinds)')
+      slot = self._free.pop()
+    self._zero_slot(slot)
+    return _SlotHandle(self, slot)
+
+  def _release_slot(self, slot):
+    with self._slot_lock:
+      self._free.append(slot)
+
+  def _zero_slot(self, slot):
+    with self._arena_lock:
+      self._arena = tuple(a.at[slot].set(0.0) for a in self._arena)
+
+  def _read_slot(self, slot):
+    with self._arena_lock:
+      arena = self._arena
+    # The old arena array stays valid (never donated) even if the
+    # dispatch thread swaps in a successor while we read; only the
+    # owning actor writes this slot, and it is parked while reading.
+    return tuple(np.asarray(a[slot], np.float32)[None] for a in arena)
+
+  def _write_slot(self, slot, carry):
+    vals = [jnp.asarray(np.asarray(c, np.float32)[0]) for c in carry]
+    with self._arena_lock:
+      self._arena = tuple(a.at[slot].set(v)
+                          for a, v in zip(self._arena, vals))
+
+  def slots_free(self):
+    with self._slot_lock:
+      return len(self._free)
+
+  # -- dispatch plane --
+
+  def _staging_for(self, total_rows):
+    """Padded staging buffers for a merged batch of total_rows rows.
+
+    Per padded bucket, a ring of depth+1 preallocated buffer lists:
+    with at most `depth` batches dispatched-but-uncompleted (the
+    semaphore) and completions released in FIFO order, a ring slot is
+    reused only after the batch that last used it has completed — its
+    host buffers are free to overwrite."""
+    padded = self._padded_size(total_rows)
+    meta = self._batcher.input_meta()
+    ring = self._staging.get(padded)
+    if ring is None:
+      ring = [[np.zeros((padded,) + tuple(trail), dtype)
+               for dtype, trail in meta]
+              for _ in range(self._depth + 1)]
+      self._staging[padded] = ring
+      self._staging_calls[padded] = 0
+    i = self._staging_calls[padded] % len(ring)
+    self._staging_calls[padded] += 1
+    return ring[i]
+
+  def _dispatch(self, params, inputs):
+    """Dispatch one padded batch through the jitted step, chaining the
+    device-resident key (and arena) — returns the (async) caller-
+    visible output arrays."""
+    step = self._step  # read per call: tests monkeypatch it
+    with self._key_lock:
+      if self._state_cache:
+        with self._arena_lock:
+          outs = step(params, self._key, *self._arena, *inputs)
+          self._key = outs[0]
+          self._arena = (outs[1], outs[2])
+          return outs[3:]
+      outs = step(params, self._key, *inputs)
+      self._key = outs[0]
+      return outs[1:]
+
+  def _dispatch_loop(self):
+    while True:
+      try:
+        # Late-bound: _staging_for is resolved per batch, after the
+        # (long) park in get_batch — not captured at loop entry.
+        item = self._batcher.get_batch_into(
+            lambda rows: self._staging_for(rows))
+      except Exception:
+        # Staging-buffer construction failed; get_batch_into answers
+        # the batch's callers with the error before re-raising (its
+        # rc-assert path cannot, so this stays loud). The dispatch
+        # plane must survive — a dead dispatch thread hangs every
+        # future policy call — but never silently: a persistent error
+        # here would otherwise be an undiagnosable busy-spin.
+        log.exception('inference dispatch: merged-batch staging failed')
+        continue
+      if item is None:
+        self._completion_q.put(None)
+        return
+      batch_id, n, bufs = item
+      t0 = time.perf_counter()
+      try:
+        if self._state_cache:
+          # The staging ring reuses buffers: rows [n:] may hold slot
+          # ids from an earlier (larger) merge — point them out of
+          # range so the in-graph scatter drops them.
+          bufs[0][n:] = self._num_slots
+        with self._stats_lock:
+          self._calls += 1
+          self._merged_requests += n
+        with self._params_lock:
+          params = self._params
+        inputs = tuple(bufs)
+        if self._mesh is not None:
+          # Explicit placement: under multi-process JAX, jit refuses
+          # numpy args with non-trivial shardings — and the local eval
+          # mesh is exactly that. All its devices are process-local,
+          # so the transfer itself is ordinary.
+          inputs = jax.device_put(inputs, self._batch_sharding)
+        self._sem.acquire()
+        try:
+          payload = self._dispatch(params, inputs)
+          with self._stats_lock:
+            self._inflight += 1
+            self._inflight_peak = max(self._inflight_peak,
+                                      self._inflight)
+        except BaseException:
+          self._sem.release()
+          raise
+        self._completion_q.put((batch_id, n, t0, payload))
+      except Exception as e:  # propagate to the parked callers
+        self._batcher.set_error(batch_id, f'{type(e).__name__}: {e}')
+
+  def _completion_loop(self):
+    while True:
+      item = self._completion_q.get()
+      if item is None:
+        return
+      batch_id, n, t0, payload = item
+      try:
+        # Observability for the sharded-eval contract: how many
+        # devices the last merged call actually spanned (read before
+        # device_get turns the arrays into host numpy).
+        try:
+          devices = len(payload[0].sharding.device_set)
+        except Exception:
+          devices = 1
+        # ONE device_get for all outputs: each separate device→host
+        # readback is a full round trip (85 ms through this sandbox's
+        # remote-TPU tunnel, vs ~µs co-located — either way, batching
+        # the transfer is strictly better).
+        host = jax.device_get(payload)
+        self._batcher.set_outputs(
+            batch_id, [np.asarray(o)[:n] for o in host])
+      except Exception as e:
+        try:
+          self._batcher.set_error(batch_id, f'{type(e).__name__}: {e}')
+        except Exception:
+          pass
+        # A failed execution poisons everything CHAINED from its
+        # outputs — the device key, and in cache mode the arena —
+        # which _dispatch already swapped in. Re-anchor them, so one
+        # transient device failure fails THIS batch's callers, not
+        # every call forever.
+        self._recover_chain()
+      finally:
+        self._sem.release()
+      lat_ms = (time.perf_counter() - t0) * 1e3
+      with self._stats_lock:
+        self._inflight -= 1
+        self._devices_last_call = devices
+        self._latencies.append(lat_ms)
+
+  def _recover_chain(self):
+    """Re-anchor the device-chained state after a failed execution.
+
+    The key (and state arena) are outputs of every dispatched step, so
+    a failed step leaves poisoned arrays in the chain and every
+    later dispatch would inherit the failure (the old host-side split
+    survived transient failures — this restores that property). The
+    key re-seeds deterministically from (base_seed, recovery count);
+    the arena, if poisoned, can only be zeroed — its carry values
+    passed through the failed step — which resets the fleet's
+    episodes-in-flight, the same degraded class as a respawn's fresh
+    episode."""
+    recovered = False
+    with self._key_lock:
+      try:
+        jax.block_until_ready(self._key)
+      except Exception:
+        recovered = True
+        key = jax.random.PRNGKey(
+            self._base_seed + 100_003 * (self._chain_recoveries + 1))
+        if self._mesh is not None:
+          key = jax.device_put(key, self._replicated)
+        self._key = key
+      if self._state_cache:
+        with self._arena_lock:
+          try:
+            jax.block_until_ready(self._arena)
+          except Exception:
+            recovered = True
+            arena = tuple(jnp.zeros((self._num_slots, s), jnp.float32)
+                          for s in self._core_sizes)
+            if self._mesh is not None:
+              arena = jax.device_put(arena, self._replicated)
+            self._arena = arena
+    if recovered:
+      with self._stats_lock:
+        self._chain_recoveries += 1
 
   def _padded_size(self, n):
     """Bucket size for a merged batch of n: next power of two (capped
@@ -210,8 +570,6 @@ class InferenceServer:
     """
     h, w, c = obs_spec['frame']
     l = obs_spec['instr_len']
-    core_c, core_h = (np.zeros((1, s), np.float32)
-                      for s in self._core_sizes)
     if sizes is None:
       cap = self._max_batch if max_size is None else min(
           _next_power_of_two(max_size), self._max_batch)
@@ -221,7 +579,7 @@ class InferenceServer:
         s *= 2
       if sizes[-1] != cap:
         # A non-power-of-two max_batch cap is itself a reachable
-        # padded size (batched() pads to min(pow2, max_batch)).
+        # padded size (merged batches pad to min(pow2, max_batch)).
         sizes.append(cap)
     padded_done = set()
     for size in sizes:
@@ -231,38 +589,68 @@ class InferenceServer:
       padded_done.add(padded)
       with self._params_lock:
         params = self._params
-      with self._key_lock:
-        self._key, sub = jax.random.split(self._key)
       inputs = (
           np.zeros((padded,), np.int32),
           np.zeros((padded,), np.float32),
           np.zeros((padded,), bool),
           np.zeros((padded, h, w, c), np.uint8),
-          np.zeros((padded, l), np.int32),
-          np.repeat(core_c, padded, 0), np.repeat(core_h, padded, 0))
+          np.zeros((padded, l), np.int32))
+      if self._state_cache:
+        # Warmup must not touch live carries: out-of-range slot ids
+        # make every scatter a drop (same compiled program — shapes
+        # and dtypes are what XLA specializes on, not values).
+        ids = np.full((padded,), self._num_slots, np.int32)
+        inputs = (ids,) + inputs
+      else:
+        inputs = inputs + tuple(
+            np.zeros((padded, s), np.float32) for s in self._core_sizes)
       if self._mesh is not None:
         inputs = jax.device_put(inputs, self._batch_sharding)
-        sub = jax.device_put(sub, self._replicated)
-      outs = self._step(params, sub, *inputs)
-      jax.block_until_ready(outs)
+      payload = self._dispatch(params, inputs)
+      jax.block_until_ready(payload)
 
   def stats(self):
-    """Merge telemetry: {'calls', 'requests', 'mean_batch',
-    'params_version'}. mean_batch near 1.0 means the batcher is not
-    merging (the reference's ~3x single-machine win comes precisely
-    from this number being high — paper Table 1); watch it when tuning
-    inference_{min_batch,timeout_ms}."""
+    """Merge + service telemetry.
+
+    {'calls', 'requests', 'mean_batch', 'params_version',
+     'publishes_skipped', 'devices_last_call', 'latency_p50_ms',
+     'latency_p99_ms', 'pipeline_depth', 'state_cache',
+     'inflight_peak', 'slots_free'}.
+
+    mean_batch near 1.0 means the batcher is not merging (the
+    reference's ~3x single-machine win comes precisely from this
+    number being high — paper Table 1); watch it when tuning
+    inference_{min_batch,timeout_ms}. The latency percentiles cover
+    the last ≤512 merged calls, assembly start → callers unparked
+    (the per-call number bench.py's inference_plane stage itemizes).
+    """
     with self._stats_lock:
       calls, reqs = self._calls, self._merged_requests
+      lat = sorted(self._latencies)
+      devices = self._devices_last_call
+      version = self._params_version
+      skipped = self._publishes_skipped
+      peak = self._inflight_peak
+      recoveries = self._chain_recoveries
+    p50 = percentile_ms(lat, 0.5)
+    p99 = percentile_ms(lat, 0.99)
     return {
         'calls': calls,
         'requests': reqs,
         'mean_batch': (reqs / calls) if calls else 0.0,
-        'params_version': self._params_version,
-        'devices_last_call': self._devices_last_call,
+        'params_version': version,
+        'publishes_skipped': skipped,
+        'devices_last_call': devices,
+        'latency_p50_ms': round(p50, 3),
+        'latency_p99_ms': round(p99, 3),
+        'pipeline_depth': self._depth,
+        'state_cache': self._state_cache,
+        'inflight_peak': peak,
+        'chain_recoveries': recoveries,
+        'slots_free': self.slots_free() if self._state_cache else None,
     }
 
-  def update_params(self, params):
+  def update_params(self, params, version=None):
     """Publish a new weight snapshot.
 
     Copies each leaf: the learner's train step DONATES its state, so
@@ -271,30 +659,81 @@ class InferenceServer:
     deleted or donated"). The copy is dispatched before any subsequent
     donation, so it's race-free. On the mesh path the explicit copy
     also matters: device_put alone is a NO-OP (aliased buffers) when
-    the input already carries the target sharding."""
+    the input already carries the target sharding.
+
+    Args:
+      params: the snapshot pytree.
+      version: optional caller-side version of the snapshot. When it
+        matches the last published version the whole-tree copy is
+        SKIPPED (counted in stats()['publishes_skipped']) — the
+        republish of an unchanged snapshot (remote refetch cadences,
+        redundant publish cadences) must not cost a tree copy. None =
+        always publish (the safe default for callers with no version).
+    """
+    if version is not None:
+      with self._params_lock:
+        if self._published_version_key == version:
+          with self._stats_lock:
+            self._publishes_skipped += 1
+          return
     params = jax.tree_util.tree_map(jnp.copy, params)
     if self._mesh is not None:
       params = jax.device_put(params, self._replicated)
     with self._params_lock:
       self._params = params
+      self._published_version_key = version
     with self._stats_lock:
       self._params_version += 1
 
   def policy(self, prev_action, env_output, core_state):
-    """`runtime.actor.Actor`-contract policy: scalars in, scalars out."""
+    """`runtime.actor.Actor`-contract policy: scalars in, scalars out.
+
+    Carry-passing mode: core_state is the numeric (c, h) carry and the
+    new carry rides the wire back. State-cache mode: core_state is a
+    `_SlotHandle` and only its slot id rides the wire — the carry
+    advances in-graph on the device."""
     frame, instr = env_output.observation
+    if self._state_cache:
+      if not isinstance(core_state, _SlotHandle):
+        raise TypeError(
+            'state-cache mode: core_state must be the slot handle '
+            'from initial_core_state(), got '
+            f'{type(core_state).__name__}')
+      if core_state.released:
+        # A respawned actor owns this slot's successor; a straggler
+        # thread must fail here, not scatter into someone else's slot.
+        raise RuntimeError('policy() called with a released state slot')
+      action, logits, baseline = self._batcher.compute([
+          np.asarray([core_state.slot], np.int32),
+          np.asarray([prev_action], np.int32),
+          np.asarray([env_output.reward], np.float32),
+          np.asarray([env_output.done], bool),
+          np.asarray(frame)[None],
+          np.asarray(instr)[None]])
+      out = AgentOutput(action=action[0], policy_logits=logits[0],
+                        baseline=baseline[0])
+      return out, core_state
     core_c, core_h = core_state
-    action, logits, baseline, new_c, new_h = self._batched(
+    action, logits, baseline, new_c, new_h = self._batcher.compute([
         np.asarray([prev_action], np.int32),
         np.asarray([env_output.reward], np.float32),
         np.asarray([env_output.done], bool),
         np.asarray(frame)[None],
         np.asarray(instr)[None],
         np.asarray(core_c, np.float32),
-        np.asarray(core_h, np.float32))
+        np.asarray(core_h, np.float32)])
     out = AgentOutput(action=action[0], policy_logits=logits[0],
                       baseline=baseline[0])
     return out, (new_c, new_h)
 
   def close(self):
-    self._batched.close()
+    if self._closed:
+      return
+    self._closed = True
+    # Close wakes the dispatch thread's get_batch (None) and cancels
+    # parked callers; the dispatch thread forwards the sentinel so the
+    # completion thread drains in-flight batches first.
+    self._batcher.close()
+    for t in (self._dispatch_thread, self._completion_thread):
+      if t is not None:
+        t.join(timeout=10)

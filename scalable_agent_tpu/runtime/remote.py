@@ -1416,7 +1416,8 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
         max(2 * config.num_actors, 2))
     fleet = driver_lib.make_fleet(
         config, agent, server.policy, buffer, levels,
-        seed_base=seed_base, level_offset=task * config.num_actors)
+        seed_base=seed_base, level_offset=task * config.num_actors,
+        initial_state_fn=server.initial_core_state)
     fleet.start()
 
     def reconnect():
@@ -1498,7 +1499,11 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
         if ack_version > version:
           try:
             version, params = client.fetch_params()
-            server.update_params(params)
+            # Version-gated: a refetch racing the publish cadence can
+            # hand back the version already being served — the server
+            # skips the whole-tree copy for it (stats:
+            # publishes_skipped).
+            server.update_params(params, version=version)
             log.info('remote actor task=%d refreshed params to v%d',
                      task, version)
           except OSError:

@@ -33,6 +33,15 @@ echo '   socket garbage + NaN burst + interrupted save; asserts zero'
 echo '   learner crashes, >=1 rollback, monotone frames — <60 s) =='
 CHAOS_SMOKE=1 python scripts/chaos.py
 
+echo '== inference-plane smoke (state-cache golden parity + slot'
+echo '   lifecycle selector, then the tiny cache×depth bench rows'
+echo '   via BENCH_ONLY=inference_plane — <60 s CPU) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_runtime.py \
+  tests/test_parallel.py -q \
+  -k 'state_cache or slot or inflight or version_gate or arena' \
+  -p no:cacheprovider
+BENCH_SMOKE=1 BENCH_ONLY=inference_plane python bench.py
+
 echo '== pixel-control fast-path parity (integer rewards + d2s head'
 echo '   + bf16-Q levers vs the r5 reference forms — <60 s CPU) =='
 JAX_PLATFORMS=cpu python -m pytest tests/test_unreal.py -q \

@@ -43,16 +43,26 @@ def pytest_configure(config):
 
 @pytest.fixture
 def batcher_options_spy(monkeypatch):
-  """Intercept dynamic_batching.batch_fn_with_options and record each
-  call's kwargs (shared by the inference merge-floor tests — keeps the
-  two spies from drifting if the decoration call ever changes shape)."""
+  """Intercept dynamic_batching.Batcher construction and record each
+  instance's merge options (shared by the inference merge-floor tests
+  — keeps the spies from drifting if the construction call ever
+  changes shape). Since round 7 the InferenceServer drives the
+  low-level Batcher directly (pipelined dispatch), so the spy sits on
+  the class, covering batch_fn_with_options users too."""
   from scalable_agent_tpu.ops import dynamic_batching
   calls = []
-  real = dynamic_batching.batch_fn_with_options
+  real = dynamic_batching.Batcher
 
-  def spy(**kwargs):
-    calls.append(kwargs)
-    return real(**kwargs)
+  class Spy(real):
 
-  monkeypatch.setattr(dynamic_batching, 'batch_fn_with_options', spy)
+    def __init__(self, num_tensors, minimum_batch_size=1,
+                 maximum_batch_size=1024, timeout_ms=100):
+      calls.append({'num_tensors': num_tensors,
+                    'minimum_batch_size': minimum_batch_size,
+                    'maximum_batch_size': maximum_batch_size,
+                    'timeout_ms': timeout_ms})
+      super().__init__(num_tensors, minimum_batch_size,
+                       maximum_batch_size, timeout_ms)
+
+  monkeypatch.setattr(dynamic_batching, 'Batcher', Spy)
   return calls

@@ -69,6 +69,50 @@ def test_emit_writes_artifact_and_prints_headline_last(tmp_path,
   assert len(lines[-1]) < 1000  # compact: survives tail truncation
 
 
+def test_inference_plane_bench_smoke():
+  """The round-7 actor-plane instrument: all cache×depth variants run
+  and report calls/s + latency percentiles (the accept/reject rows for
+  the state-cache and pipeline-depth defaults)."""
+  results = bench.bench_inference_plane(smoke=True)
+  fleet = results['fleet_sizes'][0]
+  for cache in ('carry', 'cache'):
+    for depth in (1, 2):
+      row = results[f'{cache}_d{depth}_f{fleet}']
+      assert row['policy_calls_per_sec'] > 0
+      assert row['lat_p50_ms'] > 0
+      assert row['lat_p99_ms'] >= row['lat_p50_ms']
+      assert row['mean_batch'] > 0
+      # The depth semaphore held.
+      assert row['inflight_peak'] <= depth
+
+
+def test_headline_carries_inference_plane_rows(tmp_path, capsys):
+  """Acceptance: the clip-safe last line itemizes calls/s + p50/p99
+  for the cache×pipeline variants at the largest fleet size."""
+  import json
+  out = {
+      'metric': 'learner_env_frames_per_sec_per_chip',
+      'value': 1.0, 'vs_baseline': 0.0,
+      'inference_plane': {
+          'fleet_sizes': [8, 32],
+          'carry_d1_f8': {'policy_calls_per_sec': 10.0,
+                          'lat_p50_ms': 1.0, 'lat_p99_ms': 2.0},
+          'carry_d1_f32': {'policy_calls_per_sec': 100.0,
+                           'lat_p50_ms': 3.0, 'lat_p99_ms': 6.0},
+          'cache_d2_f32': {'policy_calls_per_sec': 150.0,
+                           'lat_p50_ms': 2.0, 'lat_p99_ms': 4.0},
+      },
+  }
+  bench._emit(out, path=str(tmp_path / 'BENCH_OUT.json'))
+  lines = capsys.readouterr().out.strip().splitlines()
+  head = json.loads(lines[-1])
+  # Only the largest fleet's rows ride the compact line.
+  assert head['inference_plane'] == {
+      'carry_d1_f32': {'cps': 100.0, 'p50': 3.0, 'p99': 6.0},
+      'cache_d2_f32': {'cps': 150.0, 'p50': 2.0, 'p99': 4.0}}
+  assert len(lines[-1]) < 1000
+
+
 def test_anakin_bench_smoke():
   results = bench.bench_anakin(smoke=True)
   assert results['env_frames_per_sec'] > 0

@@ -295,6 +295,27 @@ def test_default_min_batch_is_auto_for_train_only(tmp_path,
   assert batcher_options_spy[-1]['minimum_batch_size'] == 1  # opt-out
 
 
+def test_train_with_state_cache_end_to_end(tmp_path):
+  """Round-9 tentpole through the REAL driver: training with the
+  device-resident state cache on (slot handles flow make_fleet →
+  Actor → policy; agent_state snapshots feed the learner) must train,
+  checkpoint, and resume exactly like the carry-passing path."""
+  cfg = _config(tmp_path, inference_state_cache=True)
+  run = driver.train(cfg, max_steps=3, stall_timeout_secs=60)
+  assert int(run.state.update_steps) == 3
+  stats = run.server.stats()
+  assert stats['state_cache'] is True
+  # Every fleet actor released its slot on shutdown — no leak.
+  assert run.server.slots_free() == run.server._num_slots
+  # Resume from the checkpoint, still cached.
+  run2 = driver.train(cfg, max_steps=2, stall_timeout_secs=60)
+  assert int(run2.state.update_steps) == 5
+  # evaluate() restores and plays through the cache path too.
+  returns = driver.evaluate(_config(
+      tmp_path, inference_state_cache=True, test_num_episodes=1))
+  assert all(len(v) == 1 for v in returns.values())
+
+
 def test_transport_telemetry_written(tmp_path):
   """Round 6 per-lane counters land in summaries: the staging overlap
   fraction always, the remote ack/ingest rows when ingest is on."""
@@ -310,6 +331,11 @@ def test_transport_telemetry_written(tmp_path):
   assert 'remote_ack_p50_ms' in tags
   assert 'remote_ack_p99_ms' in tags
   assert 'remote_unrolls_per_sec' in tags
+  # Round 7 actor-plane service telemetry (satellite: summaries/JSONL
+  # export the percentiles alongside the merge telemetry).
+  assert 'inference_latency_p50_ms' in tags
+  assert 'inference_latency_p99_ms' in tags
+  assert 'inference_publishes_skipped' in tags
 
 
 def test_eval_ignores_auto_merge_floor(tmp_path, batcher_options_spy):

@@ -166,3 +166,42 @@ def test_fleet_detects_stalled_actor():
   assert bad == [0]
   stall.clear()
   fleet.stop(timeout=2)
+
+
+def test_respawn_failure_contained_and_retried():
+  """A respawn whose make_actor raises (env construction, exhausted
+  inference state arena) must NOT propagate out of check_health into
+  the learner loop: the error lands on the slot and the next health
+  check retries — here successfully."""
+  CrashingEnv.crashes = 0
+  buffer = ring_buffer.TrajectoryBuffer(8)
+  spawn_fail = {'armed': False, 'raised': 0}
+
+  def env_factory(i):
+    if spawn_fail['armed']:
+      spawn_fail['armed'] = False
+      spawn_fail['raised'] += 1
+      raise RuntimeError('state arena exhausted (simulated)')
+    crash_after = 3 if CrashingEnv.crashes < 1 else 0
+    return CrashingEnv(crash_after=crash_after, height=H, width=W,
+                       num_actions=A, seed=i)
+
+  fleet = ActorFleet(_make_actor_factory(env_factory), buffer,
+                     num_actors=1)
+  fleet.start()  # start-time spawn succeeds
+  # Wait for the first crash to land on the slot.
+  deadline = time.monotonic() + 15
+  while time.monotonic() < deadline and not fleet.errors():
+    time.sleep(0.05)
+  assert fleet.errors()
+  # The respawn attempt itself fails — contained, not raised.
+  spawn_fail['armed'] = True
+  bad = fleet.check_health()
+  assert bad == [0]
+  assert spawn_fail['raised'] == 1
+  assert fleet.errors()  # failure recorded on the slot
+  # Next check retries and recovers: unrolls flow again.
+  fleet.check_health()
+  got = buffer.get(timeout=15)
+  assert got is not None
+  fleet.stop(timeout=5)

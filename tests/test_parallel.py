@@ -325,3 +325,64 @@ def test_sharded_eval_inference_spans_devices():
                                  rtol=1e-5, atol=1e-5)
   finally:
     single.close()
+
+
+def test_sharded_eval_state_cache_parity():
+  """Round-9 satellite: the device-resident state cache on the
+  8-device eval mesh (replicated arena, sharded batch rows,
+  gather/scatter by slot id under SPMD) must be numerics-IDENTICAL to
+  the carry-passing mesh path — same seed, sequential scripted calls
+  through done edges, identical actions/logits/baselines and final
+  carry snapshots."""
+  from scalable_agent_tpu.runtime.inference import InferenceServer
+  from scalable_agent_tpu.structs import StepOutput, StepOutputInfo
+
+  agent = ImpalaAgent(num_actions=A, torso='shallow',
+                      use_instruction=False)
+  params = init_params(agent, jax.random.PRNGKey(0), OBS)
+  h, w, _ = OBS['frame']
+  rng = np.random.RandomState(2)
+  frames = rng.randint(0, 255, (20, h, w, 3)).astype(np.uint8)
+
+  def env_out(t):
+    return StepOutput(
+        reward=np.float32(0.1 * t),
+        info=StepOutputInfo(np.float32(0), np.int32(0)),
+        done=np.bool_(t > 0 and t % 7 == 0),
+        observation=(frames[t], np.zeros(OBS['instr_len'], np.int32)))
+
+  def drive(state_cache):
+    cfg = Config(inference_min_batch=1, inference_max_batch=8,
+                 inference_timeout_ms=5,
+                 inference_state_cache=state_cache)
+    mesh = mesh_lib.make_mesh(model_parallelism=1)
+    # pad_batch_to=8: every merged batch pads to the full mesh width,
+    # the evaluate() configuration (one compiled bucket, all shards
+    # non-empty).
+    server = InferenceServer(agent, params, cfg, seed=3, mesh=mesh,
+                             pad_batch_to=8)
+    try:
+      state = server.initial_core_state()
+      prev = np.int32(0)
+      trace = []
+      for t in range(20):
+        out, state = server.policy(prev, env_out(t), state)
+        trace.append((int(out.action),
+                      np.asarray(out.policy_logits).copy(),
+                      float(out.baseline)))
+        prev = np.int32(out.action)
+      snap = (state.snapshot() if hasattr(state, 'snapshot')
+              else state)
+      assert server.stats()['devices_last_call'] == 8
+      return trace, tuple(np.asarray(x) for x in snap)
+    finally:
+      server.close()
+
+  trace_carry, snap_carry = drive(False)
+  trace_cache, snap_cache = drive(True)
+  for t, (a, b) in enumerate(zip(trace_carry, trace_cache)):
+    assert a[0] == b[0], f'step {t}: action'
+    np.testing.assert_array_equal(a[1], b[1], err_msg=f'step {t}')
+    assert a[2] == b[2], f'step {t}: baseline'
+  for x, y in zip(snap_carry, snap_cache):
+    np.testing.assert_array_equal(x, y)

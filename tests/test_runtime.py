@@ -336,6 +336,14 @@ class TestInferenceServer:
         t.start()
       for t in threads:
         t.join(timeout=120)
+      # Let the publisher pass the count gate before stopping it: on a
+      # loaded 1-core host the GIL can starve the publisher thread for
+      # the actors' whole (warm-cache) run — the property under test
+      # is swap-safety under churn, not a publish-rate SLO.
+      deadline = time.monotonic() + 30
+      while (server.stats()['params_version'] <= 3
+             and time.monotonic() < deadline):
+        time.sleep(0.01)
       stop.set()
       pub.join(timeout=10)
       for lst in unrolls:
@@ -427,6 +435,409 @@ class TestInferenceServer:
       # This test pins the no-deadlock property.
     finally:
       server.close()
+
+def _cfg_variant(**kw):
+  base = dict(batch_size=2, unroll_length=6, num_action_repeats=1,
+              inference_min_batch=1, inference_max_batch=8,
+              inference_timeout_ms=5)
+  base.update(kw)
+  return base
+
+
+def _scripted_inputs(steps, seed=0):
+  """Deterministic per-step (frame, reward, done) script with done
+  edges (t % 7 == 0 past t=0) — both servers must see byte-identical
+  inputs for the golden parity gate."""
+  from scalable_agent_tpu.structs import StepOutput, StepOutputInfo
+  rng = np.random.RandomState(seed)
+  frames = rng.randint(0, 255, (steps, H, W, 3)).astype(np.uint8)
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+  instr = np.zeros((MAX_INSTRUCTION_LEN,), np.int32)
+
+  def env_out(t):
+    return StepOutput(
+        reward=np.float32(0.1 * t),
+        info=StepOutputInfo(np.float32(0), np.int32(0)),
+        done=np.bool_(t > 0 and t % 7 == 0),
+        observation=(frames[t], instr))
+
+  return env_out
+
+
+def _drive(server, env_out, steps, state=None, feedback=True):
+  """Sequential policy() loop; returns the per-step (action, logits,
+  baseline) plus the final carry snapshot and the state object (slot
+  handle in cache mode). feedback=False pins prev_action to 0 so the
+  trace depends only on (inputs, carry), not the sampling key stream
+  (what the zeroed-slot-reuse parity needs)."""
+  if state is None:
+    state = server.initial_core_state()
+  prev = np.int32(0)
+  outs = []
+  for t in range(steps):
+    out, state = server.policy(prev, env_out(t), state)
+    outs.append((int(out.action),
+                 np.asarray(out.policy_logits).copy(),
+                 float(out.baseline)))
+    if feedback:
+      prev = np.int32(out.action)
+  snap = state.snapshot() if hasattr(state, 'snapshot') else state
+  return outs, tuple(np.asarray(x) for x in snap), state
+
+
+def _assert_traces_equal(a, b):
+  assert len(a) == len(b)
+  for t, (ra, rb) in enumerate(zip(a, b)):
+    assert ra[0] == rb[0], f'step {t}: action {ra[0]} != {rb[0]}'
+    np.testing.assert_array_equal(ra[1], rb[1], err_msg=f'step {t}')
+    assert ra[2] == rb[2], f'step {t}: baseline'
+
+
+class TestStateCache:
+  """The round-7 tentpole's golden parity gate: the device-resident
+  state arena must be numerics-IDENTICAL to the carry-passing path —
+  same seeds → identical actions/logits/baselines across multiple
+  unrolls, through done edges, respawn slot reuse, and the sharded
+  eval mesh."""
+
+  def _servers(self, mesh=None, **cfg_kw):
+    agent, params, _ = _mk()
+    carry_cfg = Config(**_cfg_variant(inference_state_cache=False,
+                                      **cfg_kw))
+    cache_cfg = Config(**_cfg_variant(inference_state_cache=True,
+                                      **cfg_kw))
+    carry = InferenceServer(agent, params, carry_cfg, seed=3, mesh=mesh)
+    cache = InferenceServer(agent, params, cache_cfg, seed=3, mesh=mesh)
+    return carry, cache
+
+  def test_golden_parity_multi_unroll_with_done_edges(self):
+    carry, cache = self._servers()
+    try:
+      env_out = _scripted_inputs(24)
+      a, snap_a, _ = _drive(carry, env_out, 24)   # >= 2 unrolls of 8
+      b, snap_b, _ = _drive(cache, env_out, 24)
+      _assert_traces_equal(a, b)
+      for x, y in zip(snap_a, snap_b):
+        np.testing.assert_array_equal(x, y)
+    finally:
+      carry.close()
+      cache.close()
+
+  def test_slot_release_and_zeroed_reuse(self):
+    """Respawn slot reuse: release → re-acquire returns the SAME slot
+    ZEROED, so the replacement's trace matches the original's
+    from-scratch trace — no stale carry served."""
+    agent, params, _ = _mk()
+    cfg = Config(**_cfg_variant(inference_state_cache=True,
+                                inference_state_slots=2))
+    server = InferenceServer(agent, params, cfg, seed=3)
+    try:
+      env_out = _scripted_inputs(6)
+      # feedback=False: pin prev_action so the trace depends only on
+      # (inputs, carry) — the key stream advances between the two
+      # drives, so sampled actions may differ, exactly as a fresh
+      # carry-passing actor's would.
+      outs1, snap1, handle1 = _drive(server, env_out, 6,
+                                     feedback=False)
+      assert np.abs(snap1[0]).max() > 0  # carry actually advanced
+      assert server.slots_free() == 1
+      handle1.release()
+      assert server.slots_free() == 2
+      handle1.release()  # idempotent
+      assert server.slots_free() == 2
+      # LIFO reuse: the next acquire returns the SAME slot, zeroed —
+      # logits/baseline (rng-free) must replay exactly.
+      outs2, snap2, handle2 = _drive(server, env_out, 6,
+                                     feedback=False)
+      assert handle2.slot == handle1.slot
+      for x, y in zip(outs1, outs2):
+        np.testing.assert_array_equal(x[1], y[1])
+        assert x[2] == y[2]
+      for x, y in zip(snap1, snap2):
+        np.testing.assert_array_equal(x, y)
+      # A released handle must not be usable (a straggler thread must
+      # fail loudly, not scatter into the new owner's slot).
+      with pytest.raises(RuntimeError, match='released'):
+        server.policy(np.int32(0), env_out(0), handle1)
+    finally:
+      server.close()
+
+  def test_actor_death_mid_call_reclaims_slot(self):
+    """Satellite: batcher-timeout/slot-leak — an actor whose policy
+    call dies (server closed under it / env crash) unwinds through
+    run_actor_loop's finally → actor.close() → the slot returns to
+    the free list."""
+    agent, params, cfg = _mk(**_cfg_variant(
+        inference_state_cache=True, inference_timeout_ms=5))
+    server = InferenceServer(agent, params, cfg, seed=3, fleet_size=2)
+    from scalable_agent_tpu.runtime.ring_buffer import TrajectoryBuffer
+    buf = TrajectoryBuffer(8)
+    stop = threading.Event()
+    total = server.slots_free()
+
+    class DyingEnv(FakeEnv):
+
+      def __init__(self, **kw):
+        super().__init__(**kw)
+        self._steps = 0
+
+      def step(self, action):
+        self._steps += 1
+        if self._steps >= 3:
+          raise RuntimeError('env crashed mid-unroll')
+        return super().step(action)
+
+    failures = []
+    actor = Actor(DyingEnv(height=H, width=W, num_actions=A, seed=0),
+                  server.policy, server.initial_core_state(), 8)
+    try:
+      assert server.slots_free() == total - 1
+      run_actor_loop(actor, buf, stop, on_failure=failures.append)
+      assert len(failures) == 1
+      # The dying actor's slot came back; a fresh acquire is zeroed.
+      assert server.slots_free() == total
+      snap = server.initial_core_state().snapshot()
+      assert np.abs(np.asarray(snap[0])).max() == 0
+      assert np.abs(np.asarray(snap[1])).max() == 0
+    finally:
+      stop.set()
+      server.close()
+      buf.close()
+
+  def test_mid_call_close_releases_slots_via_fleet_loop(self):
+    """Actors parked IN policy() when the server closes: the
+    BatcherCancelled unwind must still release every slot."""
+    agent, params, cfg = _mk(**_cfg_variant(
+        inference_state_cache=True,
+        inference_min_batch=8,          # never satisfied: callers park
+        inference_timeout_ms=60_000))
+    server = InferenceServer(agent, params, cfg, seed=3, fleet_size=2)
+    from scalable_agent_tpu.runtime.ring_buffer import TrajectoryBuffer
+    buf = TrajectoryBuffer(8)
+    stop = threading.Event()
+    total = server.slots_free()
+    actors = [Actor(FakeEnv(height=H, width=W, num_actions=A, seed=i),
+                    server.policy, server.initial_core_state(), 8)
+              for i in range(2)]
+    threads = [threading.Thread(target=run_actor_loop,
+                                args=(a, buf, stop), daemon=True)
+               for a in actors]
+    for t in threads:
+      t.start()
+    time.sleep(0.3)  # both park in the merge wait
+    assert server.slots_free() == total - 2
+    stop.set()        # stop FIRST: cancellation is then a clean exit
+    server.close()
+    for t in threads:
+      t.join(timeout=10)
+    assert not any(t.is_alive() for t in threads)
+    assert server.slots_free() == total
+    buf.close()
+
+  def test_arena_exhaustion_raises(self):
+    agent, params, _ = _mk()
+    cfg = Config(**_cfg_variant(inference_state_cache=True,
+                                inference_state_slots=1))
+    server = InferenceServer(agent, params, cfg, seed=3)
+    try:
+      h1 = server.initial_core_state()
+      with pytest.raises(RuntimeError, match='arena exhausted'):
+        server.initial_core_state()
+      h1.release()
+      server.initial_core_state()  # freed slot is acquirable again
+    finally:
+      server.close()
+
+  def test_state_cache_through_actor_unroll_parity(self):
+    """End-to-end through the REAL Actor loop (priming call included):
+    identical unrolls from a carry-passing and a state-cache server —
+    including agent_state (the learner's unroll-start carry) on the
+    SECOND unroll, where the cache path's once-per-unroll snapshot
+    must equal the carry path's host-held state."""
+    agent, params, _ = _mk()
+    results = {}
+    for cache in (False, True):
+      cfg = Config(**_cfg_variant(inference_state_cache=cache))
+      server = InferenceServer(agent, params, cfg, seed=11)
+      try:
+        actor = Actor(FakeEnv(height=H, width=W, num_actions=A, seed=5),
+                      server.policy, server.initial_core_state(), 6)
+        u1 = actor.unroll()
+        u2 = actor.unroll()
+        actor.close()
+        results[cache] = (u1, u2)
+      finally:
+        server.close()
+    for (ua, ub) in zip(results[False], results[True]):
+      np.testing.assert_array_equal(
+          np.asarray(ua.agent_outputs.action),
+          np.asarray(ub.agent_outputs.action))
+      np.testing.assert_array_equal(
+          np.asarray(ua.agent_outputs.policy_logits),
+          np.asarray(ub.agent_outputs.policy_logits))
+      for sa, sb in zip(ua.agent_state, ub.agent_state):
+        np.testing.assert_array_equal(np.asarray(sa), np.asarray(sb))
+
+
+class TestInferencePlaneStats:
+
+  def test_stats_percentiles_and_echo(self):
+    agent, params, cfg = _mk(**_cfg_variant(
+        inference_state_cache=True, inference_pipeline_depth=2))
+    server = InferenceServer(agent, params, cfg, seed=3)
+    try:
+      env_out = _scripted_inputs(8)
+      _drive(server, env_out, 8)
+      stats = server.stats()
+      assert stats['pipeline_depth'] == 2
+      assert stats['state_cache'] is True
+      assert stats['latency_p50_ms'] > 0
+      assert stats['latency_p99_ms'] >= stats['latency_p50_ms']
+      assert stats['inflight_peak'] >= 1
+      assert stats['slots_free'] is not None
+    finally:
+      server.close()
+    # Carry-mode echo.
+    server = InferenceServer(agent, params, Config(**_cfg_variant(
+        inference_pipeline_depth=1)), seed=3)
+    try:
+      _drive(server, _scripted_inputs(4), 4)
+      stats = server.stats()
+      assert stats['pipeline_depth'] == 1
+      assert stats['state_cache'] is False
+      assert stats['slots_free'] is None
+      assert stats['inflight_peak'] == 1  # depth 1: serial dispatch
+    finally:
+      server.close()
+
+  def test_pipeline_depth_bounds_inflight(self):
+    """The depth semaphore is the policy-lag bound of the inference
+    plane: dispatched-but-uncompleted merged calls never exceed it."""
+    agent, params, cfg = _mk(**_cfg_variant(
+        inference_pipeline_depth=2, inference_timeout_ms=2))
+    server = InferenceServer(agent, params, cfg, seed=3)
+    stop = threading.Event()
+    try:
+      def hammer(i):
+        env_out = _scripted_inputs(1, seed=i)
+        state = server.initial_core_state()
+        prev = np.int32(0)
+        while not stop.is_set():
+          out, state = server.policy(prev, env_out(0), state)
+          prev = np.int32(out.action)
+
+      threads = [threading.Thread(target=hammer, args=(i,),
+                                  daemon=True) for i in range(4)]
+      for t in threads:
+        t.start()
+      time.sleep(1.0)
+      stop.set()
+      for t in threads:
+        t.join(timeout=10)
+      stats = server.stats()
+      assert stats['calls'] > 0
+      assert 1 <= stats['inflight_peak'] <= 2
+    finally:
+      stop.set()
+      server.close()
+
+  def test_failed_execution_recovers_key_and_arena_chain(self):
+    """One failed merged execution must fail THAT batch's callers and
+    nothing else: the device key (and in cache mode the arena) are
+    outputs of the failed step — the server re-anchors them instead of
+    serving the poisoned chain to every later call forever."""
+    from scalable_agent_tpu.ops.dynamic_batching import BatcherError
+
+    class _Poisoned:
+      """Stand-in for an array whose execution failed: any host
+      materialization or readiness check raises (jax semantics for
+      outputs of a failed computation)."""
+
+      def block_until_ready(self):
+        raise RuntimeError('computation failed (simulated)')
+
+      def __array__(self, dtype=None):
+        raise RuntimeError('computation failed (simulated)')
+
+    for cache in (False, True):
+      agent, params, cfg = _mk(**_cfg_variant(
+          inference_state_cache=cache))
+      server = InferenceServer(agent, params, cfg, seed=3)
+      try:
+        env_out = _scripted_inputs(4)
+        _drive(server, env_out, 2)  # healthy warm path
+        real_step = server._step
+        n_outs = 6  # both modes: key + 5 / key + 2 arenas + 3
+        state = {'poisoned': False}
+
+        def failing_step(*args):
+          if not state['poisoned']:
+            state['poisoned'] = True
+            return tuple(_Poisoned() for _ in range(n_outs))
+          return real_step(*args)
+
+        server._step = failing_step
+        handle = server.initial_core_state()
+        with pytest.raises(BatcherError, match='failed'):
+          server.policy(np.int32(0), env_out(0), handle)
+        # The very next call succeeds: the chain was re-anchored.
+        out, handle = server.policy(np.int32(0), env_out(1), handle)
+        assert np.isfinite(np.asarray(out.policy_logits)).all()
+        stats = server.stats()
+        assert stats['chain_recoveries'] >= 1
+      finally:
+        server.close()
+
+  def test_staging_failure_answers_callers_and_survives(self):
+    """A make_buffers failure after the batch was dequeued must answer
+    the parked callers with the error (not strand them) and must not
+    kill the dispatch thread."""
+    from scalable_agent_tpu.ops.dynamic_batching import BatcherError
+    agent, params, cfg = _mk(**_cfg_variant())
+    server = InferenceServer(agent, params, cfg, seed=3)
+    try:
+      env_out = _scripted_inputs(4)
+      real = server._staging_for
+      state = {'failed': False}
+
+      def flaky(total_rows):
+        if not state['failed']:
+          state['failed'] = True
+          raise MemoryError('no staging memory (simulated)')
+        return real(total_rows)
+
+      server._staging_for = flaky
+      core = server.initial_core_state()
+      with pytest.raises(BatcherError, match='MemoryError'):
+        server.policy(np.int32(0), env_out(0), core)
+      out, core = server.policy(np.int32(0), env_out(1), core)
+      assert np.isfinite(np.asarray(out.policy_logits)).all()
+    finally:
+      server.close()
+
+  def test_update_params_version_gate(self):
+    """Satellite: an unchanged-version publish must skip the
+    whole-tree copy (counted), a new version must land."""
+    agent, params, cfg = _mk()
+    server = InferenceServer(agent, params, cfg)
+    try:
+      server.update_params(params, version=7)
+      assert server.stats()['params_version'] == 1
+      server.update_params(params, version=7)  # same version: skipped
+      stats = server.stats()
+      assert stats['params_version'] == 1
+      assert stats['publishes_skipped'] == 1
+      server.update_params(params, version=8)
+      assert server.stats()['params_version'] == 2
+      # Unversioned publishes never gate (the safe default).
+      server.update_params(params)
+      server.update_params(params)
+      stats = server.stats()
+      assert stats['params_version'] == 4
+      assert stats['publishes_skipped'] == 1
+    finally:
+      server.close()
+
 
 class TestFullPipeline:
 
