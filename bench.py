@@ -308,6 +308,11 @@ def _run_e2e_window(cfg, smoke, label):
       'inference_p99_ms': round(
           last.get('inference_latency_p99_ms', 0.0), 2),
       'buffer_unrolls': last.get('buffer_unrolls', 0.0),
+      # Staging overlap (round 8 satellite): how often the step found
+      # its batch already staged — read with buffer_unrolls (≈0 there
+      # means starvation upstream of staging, not transfer).
+      'h2d_overlap_fraction': round(
+          last.get('h2d_overlap_fraction', 0.0), 3),
       'frames': int(run.frames),
   }
 
@@ -484,6 +489,221 @@ def bench_inference_plane(smoke):
   return results
 
 
+def bench_learner_plane(smoke):
+  """The learner-feed instrument (round 8): itemize the batch boundary
+  the tentpole attacks. BENCH_r05 measured it as ONE burst per step —
+  stack_ms 37.5 host-stacking a 67.5 MB batch, then h2d_ms 1430.5
+  transferring it — while the compiled step is HBM-bound, so headline
+  growth must come from removing exposed overheads. Four cells run
+  the REAL feed machinery ({batch, unroll} staging × depth {1, 2}:
+  synthetic producers → TrajectoryBuffer → BatchPrefetcher →
+  the compiled flagship train step) and report, per cell:
+
+  - `exposed_feed_ms_per_step`: time the step actually BLOCKED on the
+    feed (prefetcher wait — H2D + assembly not hidden behind compute);
+  - `step_gap_ms`: fed wall-clock per step minus the bare compiled
+    step (everything the loop adds, overlapped or not);
+  - `h2d_overlap_fraction` and `stack_ms` (the host stack is 0 by
+    construction in unroll mode — it left the hot path).
+
+  Plus two one-off rows: `vtrace_sharded` (the shard_map'ped Pallas
+  kernel vs the lax.scan form over a mesh of ALL local devices — 1 on
+  the bench chip, so the row exercises the shard_map path trivially
+  there; the scripts/ci.sh smoke lane forces 8 virtual CPU devices so
+  the multi-shard path runs too, and the numeric multi-device parity
+  gates live in tests/) and `metrics_readback` (leaf-by-leaf
+  device_get vs the round-8 stacked read, stack dispatch itemized
+  separately — the driver pays it a step before the read).
+  The cells share ONE compiled executable; the accept/reject call for
+  `--staging_mode` rides these rows into BENCH_r08.
+  """
+  import threading
+  import numpy as np
+  import jax
+  import jax.numpy as jnp
+  from scalable_agent_tpu import learner as learner_lib
+  from scalable_agent_tpu import observability, vtrace
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.models import ImpalaAgent, init_params
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+  from scalable_agent_tpu.parallel import mesh as mesh_lib
+  from scalable_agent_tpu.runtime import ring_buffer
+  from scalable_agent_tpu.runtime.actor import batch_unrolls
+
+  h, w = (72, 96) if not smoke else (24, 32)
+  b = 32 if not smoke else 2
+  t = 100 if not smoke else 4
+  steps = 12 if not smoke else 3
+  cfg = Config(batch_size=b, unroll_length=t, num_action_repeats=4,
+               total_environment_frames=int(1e9),
+               torso='deep' if not smoke else 'shallow',
+               compute_dtype='bfloat16' if not smoke else 'float32',
+               use_instruction=False)
+  agent = ImpalaAgent(num_actions=9, torso=cfg.torso,
+                      use_instruction=False,
+                      scan_unroll=cfg.scan_unroll,
+                      dtype=(jnp.bfloat16 if not smoke
+                             else jnp.float32))
+  obs_spec = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+  params = init_params(agent, jax.random.PRNGKey(0), obs_spec)
+  state = learner_lib.make_train_state(params, cfg)
+  train_step = learner_lib.make_train_step(agent, cfg)
+  unroll = _transport_unroll(t + 1, h, w)
+  rows = [unroll] * b
+  host_batch = batch_unrolls(rows)
+  placed = jax.device_put(host_batch)
+  compiled = train_step.lower(state, placed).compile()
+  # Warm + bare step (same value-readback barrier as _time_step).
+  state, metrics = compiled(state, placed)
+  float(metrics['total_loss'])
+  t0 = time.perf_counter()
+  for _ in range(steps):
+    state, metrics = compiled(state, placed)
+  float(metrics['total_loss'])
+  bare_step_ms = (time.perf_counter() - t0) / steps * 1e3
+
+  # Host stack cost (the batch-mode term unroll mode deletes).
+  t0 = time.perf_counter()
+  n_stack = 3 if not smoke else 1
+  for _ in range(n_stack):
+    batch_unrolls(rows)
+  stack_ms = (time.perf_counter() - t0) / n_stack * 1e3
+
+  def run_cell(mode, depth):
+    nonlocal state
+    buffer = ring_buffer.TrajectoryBuffer(2 * b)
+    stop = threading.Event()
+
+    def produce():
+      while not stop.is_set():
+        try:
+          buffer.put(unroll, timeout=0.2)
+        except (TimeoutError, ring_buffer.Closed):
+          continue
+
+    producers = [threading.Thread(target=produce, daemon=True)
+                 for _ in range(4)]
+    for p in producers:
+      p.start()
+    stager = (ring_buffer.UnrollBatchStager(b) if mode == 'unroll'
+              else None)
+    pf = ring_buffer.BatchPrefetcher(buffer, b,
+                                     place_fn=jax.device_put,
+                                     depth=depth, stager=stager)
+    try:
+      # Prime: the first get covers the insert-jit compile (unroll
+      # mode) and the pipeline fill; excluded from the window.
+      batch = pf.get(timeout=300)
+      state, m = compiled(state, batch)
+      float(m['total_loss'])
+      base = pf.stats()
+      t0 = time.perf_counter()
+      for _ in range(steps):
+        batch = pf.get(timeout=300)
+        state, m = compiled(state, batch)
+      float(m['total_loss'])
+      fed_ms = (time.perf_counter() - t0) / steps * 1e3
+      stats = pf.stats()
+    finally:
+      stop.set()
+      pf.close()
+      for p in producers:
+        p.join(timeout=2)
+    d_gets = stats['gets'] - base['gets']
+    d_wait = stats['wait_secs'] - base['wait_secs']
+    d_blocked = stats['blocked_gets'] - base['blocked_gets']
+    return {
+        'mode': mode,
+        'depth': depth,
+        'fed_step_ms': round(fed_ms, 2),
+        'step_gap_ms': round(fed_ms - bare_step_ms, 2),
+        'exposed_feed_ms_per_step': round(
+            d_wait / d_gets * 1e3 if d_gets else 0.0, 2),
+        'h2d_overlap_fraction': round(
+            1.0 - d_blocked / d_gets if d_gets else 0.0, 3),
+        'stack_ms': round(stack_ms, 1) if mode == 'batch' else 0.0,
+        'donation_fallback': stats.get('donation_fallback', False),
+    }
+
+  results = {
+      'batch_size': b,
+      'unroll_length': t,
+      'bare_step_ms': round(bare_step_ms, 2),
+      'batch_mb': round(sum(x.nbytes for x in
+                            jax.tree_util.tree_leaves(host_batch))
+                        / 1e6, 1),
+  }
+  for mode in ('batch', 'unroll'):
+    for depth in (1, 2):
+      results[f'{mode}_d{depth}'] = run_cell(mode, depth)
+
+  # --- Sharded Pallas-vs-scan V-trace (the lifted mesh restriction,
+  # timed standalone over a mesh of ALL local devices — 1 on the
+  # bench chip, 8 virtual in the CI smoke; both exercise the
+  # shard_map path the flagship sharded step now takes). ---
+  mesh = mesh_lib.make_mesh(jax.local_devices(), model_parallelism=1)
+  tb, bb = (100, 32) if not smoke else (6, 8)
+  bb = max(bb, len(jax.local_devices()))
+  rng = np.random.RandomState(0)
+  vkw = dict(
+      log_rhos=jnp.asarray(rng.randn(tb, bb) * 0.5, jnp.float32),
+      discounts=jnp.full((tb, bb), 0.9, jnp.float32),
+      rewards=jnp.asarray(rng.randn(tb, bb), jnp.float32),
+      values=jnp.asarray(rng.randn(tb, bb), jnp.float32),
+      bootstrap_value=jnp.asarray(rng.randn(bb), jnp.float32))
+
+  def time_vtrace(fn):
+    out = fn(**vkw)
+    float(np.asarray(out[0, 0]))  # readback barrier
+    n = 20 if not smoke else 3
+    t0 = time.perf_counter()
+    for _ in range(n):
+      out = fn(**vkw)
+    float(np.asarray(out[0, 0]))
+    return round((time.perf_counter() - t0) / n * 1e3, 3)
+
+  results['vtrace_sharded'] = {
+      'devices': len(jax.local_devices()),
+      'pallas_ms': time_vtrace(jax.jit(
+          lambda **k: vtrace.from_importance_weights(
+              use_pallas=True, mesh=mesh, **k).vs)),
+      'scan_ms': time_vtrace(jax.jit(
+          lambda **k: vtrace.from_importance_weights(**k).vs)),
+  }
+
+  # --- Metrics readback, measured as the DRIVER actually pays it.
+  # The round-8 path splits into two independently-timed pieces:
+  # the per-step stack DISPATCH (async, returns immediately — rides
+  # alongside the next step's dispatch) and the summary-time READ of
+  # an already-computed stack (one transfer). Timing
+  # read(stack(metrics)) as one unit would charge the deferred path a
+  # serialize-on-fresh-dispatch sync it never pays in the driver,
+  # where the stack was dispatched a whole step earlier. The per-leaf
+  # row is the pre-round-8 summary path: one device_get per key
+  # (computed values here too, so both rows measure transfer/dispatch
+  # cost, not step-completion waits). ---
+  n = 10 if not smoke else 2
+  t0 = time.perf_counter()
+  for _ in range(n):
+    _ = {k: float(jax.device_get(v)) for k, v in metrics.items()}
+  per_leaf_ms = (time.perf_counter() - t0) / n * 1e3
+  t0 = time.perf_counter()
+  handles = [observability.stack_metrics(metrics) for _ in range(n)]
+  stack_dispatch_ms = (time.perf_counter() - t0) / n * 1e3
+  observability.read_stacked_metrics(handles[-1])  # all computed now
+  t0 = time.perf_counter()
+  for h in handles:
+    _ = observability.read_stacked_metrics(h)
+  stacked_read_ms = (time.perf_counter() - t0) / n * 1e3
+  results['metrics_readback'] = {
+      'keys': len(metrics),
+      'per_leaf_ms': round(per_leaf_ms, 2),
+      'stacked_read_ms': round(stacked_read_ms, 2),
+      'stack_dispatch_ms': round(stack_dispatch_ms, 2),
+  }
+  return results
+
+
 class _SyntheticFleet:
   """Producer 'fleet' for the fed-learner stage: threads put canned
   unrolls into the trajectory buffer as fast as it accepts them —
@@ -616,6 +836,13 @@ def bench_e2e_fed(smoke):
     for b in barriers:
       b()
   h2d_pipelined_ms = ((time.perf_counter() - t0) / n_itemize / 2) * 1e3
+  # Exposed vs overlapped H2D (round 8 satellite): the run's own
+  # telemetry says how much of the transfer the step actually WAITED
+  # on (`staging_exposed_ms_per_step`, last steady interval); the
+  # remainder of the serially-measured burst was hidden behind
+  # compute/pipelining. The window-total h2d_ms alone could not tell
+  # a fully-hidden transfer from a fully-exposed one.
+  exposed_ms = round(last.get('staging_exposed_ms_per_step', 0.0), 1)
   return {
       'fps': round(fps, 1),
       'steady_secs': round(span, 1),
@@ -624,6 +851,11 @@ def bench_e2e_fed(smoke):
       # summary; the ISSUE-1 acceptance counter).
       'h2d_overlap_fraction': last.get('h2d_overlap_fraction', 0.0),
       'staging_depth': cfg.staging_depth,
+      # The mode the run ACTUALLY used (driver echo) — config alone
+      # would mislabel a topology fallback to batch staging.
+      'staging_mode': ('unroll'
+                       if last.get('staging_unroll_active') else
+                       'batch'),
       'frames': int(run.frames),
       'batch_size': cfg.batch_size,
       'gap_itemization': {
@@ -631,6 +863,8 @@ def bench_e2e_fed(smoke):
           'stack_ms': round(stack_ms, 1),
           'h2d_ms': round(h2d_ms, 1),
           'h2d_pipelined_ms': round(h2d_pipelined_ms, 1),
+          'h2d_exposed_ms': exposed_ms,
+          'h2d_overlapped_ms': round(max(h2d_ms - exposed_ms, 0.0), 1),
       },
   }
 
@@ -1175,6 +1409,22 @@ def main():
     import jax
     jax.config.update('jax_platforms', 'cpu')
 
+  # BENCH_ONLY=learner_plane: run just the learner-feed stage (the
+  # scripts/ci.sh smoke — same rationale as the inference_plane lane).
+  if os.environ.get('BENCH_ONLY') == 'learner_plane':
+    plane = bench_learner_plane(smoke)
+    _emit({
+        'metric': 'learner_plane_exposed_feed_ms_per_step',
+        'value': min(row['exposed_feed_ms_per_step']
+                     for row in plane.values()
+                     if isinstance(row, dict)
+                     and 'exposed_feed_ms_per_step' in row),
+        'unit': ('exposed feed ms/step, best staging variant%s'
+                 % (' (SMOKE)' if smoke else '')),
+        'learner_plane': plane,
+    })
+    return
+
   # BENCH_ONLY=inference_plane: run just the actor-plane stage (the
   # scripts/ci.sh smoke — the full bench's compile budget doesn't fit
   # a CI lane; the stage's mechanics must still be exercised there).
@@ -1212,6 +1462,9 @@ def main():
   infer = None
   if os.environ.get('BENCH_SKIP_INFERENCE') != '1':
     infer = bench_inference_plane(smoke)
+  plane = None
+  if os.environ.get('BENCH_SKIP_LEARNER_PLANE') != '1':
+    plane = bench_learner_plane(smoke)
 
   baseline_per_chip = 200_000.0 / 16.0  # north star / v5e-16 chips
   out = {
@@ -1247,6 +1500,8 @@ def main():
     out['anakin'] = anakin
   if infer is not None:
     out['inference_plane'] = infer
+  if plane is not None:
+    out['learner_plane'] = plane
   _emit(out)
 
 
@@ -1276,6 +1531,8 @@ def _headline(out):
   if fed:
     head['e2e_fed_fps'] = fed['fps']
     head['h2d_overlap_fraction'] = fed.get('h2d_overlap_fraction')
+    gap = fed.get('gap_itemization') or {}
+    head['h2d_exposed_ms'] = gap.get('h2d_exposed_ms')
   transport = out.get('transport')
   if transport:
     head['ingest_1conn'] = transport['ingest_1conn']['unrolls_per_sec']
@@ -1304,6 +1561,21 @@ def _headline(out):
                'p50': row['lat_p50_ms'], 'p99': row['lat_p99_ms']}
         for name, row in infer.items()
         if isinstance(row, dict) and name.endswith(f'_f{fmax}')}
+  # The learner-feed itemization (round 8): the {batch, unroll} ×
+  # depth rows plus the sharded pallas-vs-scan call must ride the
+  # clip-safe last line — BENCH_r08 carries the --staging_mode and
+  # Pallas-under-mesh accept/reject on exactly these numbers.
+  plane = out.get('learner_plane')
+  if plane:
+    head['learner_plane'] = {
+        name: {'exposed': row['exposed_feed_ms_per_step'],
+               'gap': row['step_gap_ms'],
+               'overlap': row['h2d_overlap_fraction']}
+        for name, row in plane.items()
+        if isinstance(row, dict) and 'exposed_feed_ms_per_step' in row}
+    head['learner_plane']['bare_step_ms'] = plane['bare_step_ms']
+    if plane.get('vtrace_sharded'):
+      head['learner_plane']['vtrace_sharded'] = plane['vtrace_sharded']
   return head
 
 

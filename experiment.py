@@ -237,6 +237,15 @@ flags.DEFINE_integer('staging_depth', _DEFAULTS.staging_depth,
                      'depth): 2 overlaps consecutive host-to-device '
                      'transfers with the step; each extra slot adds '
                      'one batch of policy lag.')
+flags.DEFINE_enum('staging_mode', _DEFAULTS.staging_mode,
+                  ['batch', 'unroll'],
+                  'Learner feed staging: batch = host-stack + one '
+                  'device_put burst per step (default); unroll = '
+                  'per-unroll eager H2D + on-device batch assembly '
+                  '(the step-boundary burst becomes a trickle '
+                  'overlapped with compute — parity-gated, measured '
+                  'per round by bench.py learner_plane; docs/PERF.md '
+                  'r8).')
 flags.DEFINE_enum('publish_codec', _DEFAULTS.publish_codec,
                   ['bf16', 'f32'],
                   'Wire codec for served param snapshots: bf16 '

@@ -177,6 +177,26 @@ class Config:
   # and the compute (BENCH_r05: h2d_ms 1430.5 dominated the fed-loop
   # gap). Each extra slot extends the policy-lag bound by one batch.
   staging_depth: int = 2
+  # --- Learner feed staging mode (round 8; docs/PERF.md r8). ---
+  # 'batch': host-stack B unrolls (`batch_unrolls`) then one burst
+  #   device_put per step — the r5–r7 reference path (BENCH_r05
+  #   itemized it at stack_ms 37.5 / h2d_ms 1430.5 per 67.5 MB batch).
+  # 'unroll': each completed unroll is device_put the moment it leaves
+  #   the TrajectoryBuffer — placed directly on the device owning its
+  #   batch slot — and the [T+1, B] batch assembles ON DEVICE via a
+  #   jitted donated dynamic_update_slice arena
+  #   (runtime/ring_buffer.UnrollBatchStager), so the step-boundary
+  #   burst becomes a trickle overlapped with the previous step's
+  #   compute and the host stack leaves the hot path. Golden
+  #   parity-gated vs the host-stack path (bit-identical batches);
+  #   falls back to 'batch' with a warning on topologies the per-slot
+  #   placement cannot serve (model-axis batch sharding, indivisible
+  #   local batch — parallel/train_parallel.supports_unroll_staging).
+  # DEFAULT STAYS 'batch' per the repo's measured accept/reject
+  # discipline: bench.py's `learner_plane` stage measures both modes
+  # × staging_depth head-to-head every round (exposed H2D ms/step,
+  # stack_ms, step gap), so BENCH_r08's chip rows carry the flip call.
+  staging_mode: str = 'batch'            # batch | unroll
   # Remote actors (reference --job_name=actor gRPC topology, SURVEY
   # §3.4): learner listens on this port for actor-host connections
   # (0 = disabled); actor hosts point learner_address at it.

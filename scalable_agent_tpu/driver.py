@@ -240,14 +240,14 @@ def train(config: Config, max_steps: Optional[int] = None,
     # trace time for library users).
     raise ValueError('use_pallas_vtrace and use_associative_scan are '
                      'mutually exclusive')
+  if config.staging_mode not in ('batch', 'unroll'):
+    raise ValueError(f'unknown staging_mode {config.staging_mode!r} '
+                     '(batch | unroll)')
+  # NOTE round 8: the fused Pallas V-trace is no longer rejected under
+  # a mesh — the sharded step runs it shard_map'ped over the data axis
+  # (vtrace.py / ops/vtrace_pallas.sharded_from_importance_weights;
+  # parity-gated on the 8-virtual-device mesh in tests/test_parallel).
   mesh = choose_mesh(config)
-  if mesh is not None and config.use_pallas_vtrace:
-    # pallas_call has no SPMD partitioning rule: under the sharded
-    # step it would be rejected or force replication of the [T, B]
-    # operands. (CI can't catch this — interpret mode off-TPU lowers
-    # to plain ops, which partition fine.)
-    raise ValueError('use_pallas_vtrace is single-device only; disable '
-                     'it or run without a mesh')
   if mesh is not None:
     from scalable_agent_tpu.testing import make_example_batch
     from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
@@ -264,8 +264,12 @@ def train(config: Config, max_steps: Optional[int] = None,
     state = learner_lib.make_train_state(params, config,
                                          num_popart_tasks)
     train_step = learner_lib.make_train_step(agent, config)
-    place_fn = lambda b: jax.tree_util.tree_map(  # noqa: E731
-        lambda x: jax.device_put(np.asarray(x)), b)
+    # ONE tree-level async device_put (the per-leaf
+    # device_put(np.asarray(x)) round trip dispatched leaf-at-a-time
+    # and re-materialized already-host arrays); default-device
+    # placement matches the unroll stager's steady-state slot
+    # placement, so batch and unroll staging land identically.
+    place_fn = jax.device_put
 
   # --- Checkpoint restore (reference: MonitoredTrainingSession auto-
   # restore from --logdir, ≈L570). ---
@@ -404,9 +408,50 @@ def train(config: Config, max_steps: Optional[int] = None,
           minlength=num_actions)
       return stats_view, action_counts, place_fn(host_batch)
 
+    # --- Per-unroll host stats peel + batch finalize: the unroll
+    # staging plane's split of stage() — the tiny leaves (done / info
+    # / level id / action bincount) peel per unroll while it is host
+    # numpy; the frames never come back, and the per-batch host work
+    # is a [T+1, B]-of-scalars stack instead of the 67.5 MB frame
+    # stack (BENCH_r05 stack_ms 37.5). ---
+    def unroll_view(unroll):
+      return (
+          np.asarray(unroll.level_name),
+          jax.tree_util.tree_map(np.asarray, unroll.env_outputs.info),
+          np.asarray(unroll.env_outputs.done),
+          np.bincount(np.asarray(unroll.agent_outputs.action)[1:],
+                      minlength=num_actions))
+
+    def finalize_views(views, batch_device):
+      stats_view = _stats_only_view(
+          np.stack([v[0] for v in views]),
+          jax.tree_util.tree_map(lambda *xs: np.stack(xs, axis=1),
+                                 *[v[1] for v in views]),
+          np.stack([v[2] for v in views], axis=1))
+      action_counts = np.sum([v[3] for v in views], axis=0)
+      return stats_view, action_counts, batch_device
+
+    stager = None
+    if config.staging_mode == 'unroll':
+      if train_parallel.supports_unroll_staging(config, mesh):
+        if mesh is None:
+          slot_devices, assemble_fn = None, None
+        else:
+          slot_devices, assemble_fn = train_parallel.make_unroll_assembly(
+              config, mesh, example_batch)
+        stager = ring_buffer.UnrollBatchStager(
+            local_batch_size, slot_devices=slot_devices,
+            assemble_fn=assemble_fn, host_view_fn=unroll_view,
+            finalize_fn=finalize_views)
+      else:
+        log.warning(
+            'staging_mode=unroll unsupported on this topology '
+            '(model-axis batch sharding or local batch %d not '
+            'divisible by the local data width) — falling back to '
+            'batch staging', local_batch_size)
     prefetcher = ring_buffer.BatchPrefetcher(
         buffer, local_batch_size, place_fn=stage,
-        depth=config.staging_depth)
+        depth=config.staging_depth, stager=stager)
 
     # Multi-host: every host logs its OWN fleet's stream; process 0
     # keeps the canonical filename (shared logdirs must not interleave
@@ -486,8 +531,15 @@ def train(config: Config, max_steps: Optional[int] = None,
   # rollback, so it cannot bracket bursts).
   pending_sentinel = None
   bad_count_in_burst = 0
+  # Deferred metrics readback (round 8): (step, stacked-handle) pairs.
+  # `pending_metrics` is the step just dispatched; `prev_metrics` is
+  # one step older — its values are computed by now, so the summary
+  # read is a single non-syncing transfer.
+  pending_metrics = None
+  prev_metrics = None
   action_counts_acc = np.zeros((num_actions,), np.int64)
   last_remote_publish = float('-inf')
+  last_pf_snap = {'gets': 0, 'wait_secs': 0.0}
   last_inference_snap = {'calls': 0, 'requests': 0}
   last_ingest_snap = {'unrolls': 0, 'per_conn_unrolls': {}}
   last_ingest_time = time.monotonic()
@@ -562,6 +614,16 @@ def train(config: Config, max_steps: Optional[int] = None,
       # Episode stats ride in the trajectory; the prefetcher peeled a
       # host-side view before the device transfer — no device_get here.
       step_now = steps_done + _initial_steps
+      # Stack this step's scalar metrics into ONE device array now —
+      # BEFORE the next step is dispatched, so the tiny stack
+      # computation precedes it on the device stream. The summary
+      # block reads the PREVIOUS step's stack: already computed, one
+      # transfer, no dispatch-pipeline sync (the health-sentinel
+      # pattern applied to the whole metrics dict — round 8; the old
+      # path device_get each key separately against just-dispatched
+      # values).
+      prev_metrics = pending_metrics
+      pending_metrics = (step_now, observability.stack_metrics(metrics))
       for name, ep_return, ep_frames in stats.record_batch(
           stats_view, step_now):
         log.info('episode %s return=%.2f frames=%d', name, ep_return,
@@ -680,9 +742,18 @@ def train(config: Config, max_steps: Optional[int] = None,
       now = time.monotonic()
       if now - last_summary >= config.summary_secs:
         last_summary = now
-        writer.scalars(
-            {k: float(jax.device_get(v)) for k, v in metrics.items()},
-            step_now)
+        # One-step-delayed stacked read (round 8): the previous step's
+        # metrics land in a single transfer of already-computed values.
+        # Written at step_now — one step stale, immaterial at summary
+        # cadence, and it keeps the summary step sequence monotone
+        # (episode events already wrote step_now; the chaos SLO and
+        # downstream readers assert non-decreasing steps). Only the
+        # very first step has no predecessor — that one read blocks on
+        # the fresh dispatch, like the old path always did.
+        _, handle = (prev_metrics if prev_metrics is not None
+                     else pending_metrics)
+        writer.scalars(observability.read_stacked_metrics(handle),
+                       step_now)
         writer.scalar('env_frames_per_sec', fps_meter.fps(), step_now)
         fleet_stats = fleet.stats(
             healthy_horizon_secs=(stall_timeout_secs
@@ -748,6 +819,24 @@ def train(config: Config, max_steps: Optional[int] = None,
         writer.scalar('h2d_overlap_fraction',
                       pf['h2d_overlap_fraction'], step_now)
         writer.scalar('staged_batches', pf['staged_batches'], step_now)
+        # EXPOSED staging wait over this interval (round 8): ms/step
+        # the learner actually blocked on the feed — the part of
+        # H2D+stacking NOT hidden behind compute. The overlap fraction
+        # says how often a step waited; this says how much. bench.py's
+        # learner_plane / e2e_fed itemization reads it back out.
+        d_gets = pf['gets'] - last_pf_snap['gets']
+        d_wait = pf['wait_secs'] - last_pf_snap['wait_secs']
+        writer.scalar('staging_exposed_ms_per_step',
+                      (d_wait / d_gets * 1e3) if d_gets else 0.0,
+                      step_now)
+        last_pf_snap = pf
+        # The mode ACTUALLY running (config may have asked for unroll
+        # and been topology-fallback'd to batch — a bench row labeled
+        # from config alone would corrupt the head-to-head record).
+        writer.scalar('staging_unroll_active',
+                      1.0 if pf['mode'] == 'unroll' else 0.0, step_now)
+        if pf.get('donation_fallback'):
+          writer.scalar('staging_donation_fallback', 1, step_now)
         if ingest is not None:
           ing = ingest.stats()
           writer.scalar('remote_unrolls', ing['unrolls'], step_now)

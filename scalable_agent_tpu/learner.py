@@ -89,8 +89,13 @@ def align_batch(env_outputs, agent_outputs, learner_outputs, config):
 
 
 def loss_fn(params, agent, batch: ActorOutput, config: Config,
-            popart_state=None):
+            popart_state=None, mesh=None):
   """Total IMPALA loss for one batch; returns (loss, (metrics, aux)).
+
+  `mesh` is the sharded step's mesh (train_parallel passes it; None on
+  the single-device path). It only matters to the Pallas V-trace form,
+  which runs under shard_map over the mesh's data axis — pallas_call
+  has no SPMD partitioning rule of its own (vtrace.py).
 
   With PopArt (popart_state not None): the agent's baseline is the
   NORMALIZED per-task value; V-trace runs on the unnormalized σ·n + μ,
@@ -130,7 +135,8 @@ def loss_fn(params, agent, batch: ActorOutput, config: Config,
       values=inputs.values,
       bootstrap_value=inputs.bootstrap_value,
       use_associative_scan=config.use_associative_scan,
-      use_pallas=config.use_pallas_vtrace)
+      use_pallas=config.use_pallas_vtrace,
+      mesh=mesh)
 
   pg_loss = losses_lib.compute_policy_gradient_loss(
       inputs.target_logits, inputs.actions, vtrace_returns.pg_advantages)
@@ -220,17 +226,18 @@ def make_train_state(params, config: Config,
               if config.use_popart else None))
 
 
-def make_train_step_fn(agent, config: Config):
+def make_train_step_fn(agent, config: Config, mesh=None):
   """The raw (unjitted) train step: (TrainState, batch) → (state,
   metrics). Single source of truth — jitted plain here and with explicit
-  shardings in parallel/train_parallel.py."""
+  shardings in parallel/train_parallel.py (which passes its mesh so the
+  Pallas V-trace can shard_map over the data axis)."""
   optimizer = make_optimizer(config)
   schedule = make_schedule(config)
 
   def train_step(state: TrainState, batch: ActorOutput):
     (total_loss, (metrics, aux)), grads = jax.value_and_grad(
         loss_fn, has_aux=True)(state.params, agent, batch, config,
-                               state.popart)
+                               state.popart, mesh)
     # Pre-clip norm: explosions must stay visible even with clipping on.
     metrics['grad_norm'] = optax.global_norm(grads)
     updates, new_opt_state = optimizer.update(

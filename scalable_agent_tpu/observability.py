@@ -182,6 +182,32 @@ class LatencyReservoir:
     return tuple(snap[min(last, int(round(q * last)))] for q in qs)
 
 
+def stack_metrics(metrics: Dict) -> Tuple[Tuple[str, ...], object]:
+  """Stack a step's scalar metrics into ONE device array.
+
+  The deferred-readback half of the learner's metrics path (round 8):
+  `driver.train` used to `device_get` the whole per-step metrics dict
+  leaf-by-leaf at summary time — one host sync per key, against
+  values the step had JUST produced, so the first sync stalled on the
+  entire step. Stacking costs one tiny fused dispatch per step; the
+  handle is read ONE STEP LATER (`read_stacked_metrics`), by which
+  time the values are long computed and the single transfer returns
+  without syncing the dispatch pipeline — the same pattern
+  health.stack_sentinels proved for the watchdog scalars."""
+  import jax.numpy as jnp
+  keys = tuple(sorted(metrics))
+  return keys, jnp.stack([jnp.asarray(metrics[k], jnp.float32)
+                          for k in keys])
+
+
+def read_stacked_metrics(handle) -> Dict[str, float]:
+  """One transfer: (keys, stacked device array) → host float dict."""
+  import jax
+  keys, stacked = handle
+  values = np.asarray(jax.device_get(stacked))
+  return {k: float(v) for k, v in zip(keys, values)}
+
+
 def extract_episodes(batch) -> List[Tuple[int, float, int]]:
   """Finished episodes in a dequeued [T+1, B] batch.
 

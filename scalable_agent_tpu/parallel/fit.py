@@ -103,7 +103,10 @@ def aot_memory_fit(devices: Optional[Sequence[Any]] = None,
   batch_shard = mesh_lib.batch_shardings(batch, mesh)
   replicated = NamedSharding(mesh, P())
   state_sh = jax.tree_util.tree_map(lambda _: replicated, state_abs)
-  step = learner_lib.make_train_step_fn(agent, cfg)
+  # mesh rides in so a pallas-vtrace config lowers under shard_map
+  # instead of failing the AOT fit (round 8 — the mesh restriction is
+  # lifted everywhere, this path included).
+  step = learner_lib.make_train_step_fn(agent, cfg, mesh=mesh)
   compiled = jax.jit(
       step, in_shardings=(state_sh, batch_shard),
       donate_argnums=(0,)).lower(state_abs, batch).compile()

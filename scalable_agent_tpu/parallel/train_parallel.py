@@ -57,8 +57,13 @@ def make_sharded_train_step(agent, config: Config, mesh: Mesh,
   input ... to have the same size" — the pre-existing bug xfail'd in
   tests/test_parallel.py); __graft_entry__'s dryrun falls back to it
   so the parity gate still runs there.
+
+  The mesh rides into the step fn (round 8): the Pallas V-trace has
+  no SPMD partitioning rule, so under this jit it runs shard_map'ped
+  over the data axis — the fused kernel is no longer single-device
+  only (vtrace.py / ops/vtrace_pallas.py).
   """
-  train_step = learner_lib.make_train_step_fn(agent, config)
+  train_step = learner_lib.make_train_step_fn(agent, config, mesh=mesh)
   batch_shard = mesh_lib.batch_shardings(
       example_batch, mesh,
       shard_over_model=mesh_lib.shard_batch_over_model(config))
@@ -82,3 +87,71 @@ def make_sharded_train_step(agent, config: Config, mesh: Mesh,
         host_batch, batch_shard)
 
   return jitted, place_batch
+
+
+def supports_unroll_staging(config, mesh) -> bool:
+  """Whether staging_mode='unroll' can serve this topology.
+
+  The per-unroll staging plane places each unroll on the device owning
+  its batch slot and assembles the global batch zero-copy from the
+  per-device arenas — that requires a pure-data batch sharding (no
+  model-axis replication of the batch: duplicating every unroll's H2D
+  across the TP width would undo the trickle win) and a local batch
+  that divides this process's data width. The driver falls back to
+  'batch' with a warning otherwise; None mesh (single device) always
+  supports it."""
+  if mesh is None:
+    return True
+  if mesh_lib.shard_batch_over_model(config):
+    return False
+  if mesh.shape[mesh_lib.MODEL_AXIS] != 1:
+    return False
+  local = [d for d in mesh.devices.flat
+           if d.process_index == jax.process_index()]
+  local_batch = config.batch_size // jax.process_count()
+  return bool(local) and local_batch % len(local) == 0
+
+
+def make_unroll_assembly(config, mesh, example_batch):
+  """Slot placement + zero-copy global assembly for the per-unroll
+  staging plane (runtime/ring_buffer.UnrollBatchStager) over a pure-DP
+  mesh.
+
+  Returns (slot_devices, assemble_fn): slot s of this process's local
+  batch lives on the s·D/B-th local mesh device (the contiguous
+  data-axis shard layout batch_shardings assigns), and `assemble_fn`
+  stitches the per-device arenas into the globally-sharded batch via
+  `jax.make_array_from_single_device_arrays` — no copy, no host
+  round trip: the arena rows ARE the step's shards. Single-host this
+  is the whole batch; multi-host each process supplies its
+  addressable shards, exactly like make_array_from_process_local_data
+  does on the batch path."""
+  if not supports_unroll_staging(config, mesh):
+    raise ValueError('unroll staging unsupported on this topology '
+                     '(see supports_unroll_staging)')
+  batch_shard = mesh_lib.batch_shardings(example_batch, mesh,
+                                         shard_over_model=False)
+  local_devices = [d for d in mesh.devices.flat
+                   if d.process_index == jax.process_index()]
+  n_local = len(local_devices)
+  data_width = mesh.shape[mesh_lib.DATA_AXIS]
+  local_batch = config.batch_size // jax.process_count()
+  per_dev = local_batch // n_local
+  slot_devices = [local_devices[s // per_dev]
+                  for s in range(local_batch)]
+
+  def assemble(sub_arenas):
+    """Per-device arenas (device order) → the global sharded batch."""
+
+    def join(sharding, *shards):
+      spec = sharding.spec
+      bdim = next(i for i, ax in enumerate(spec) if ax is not None)
+      # Global batch dim: per-device rows × data-axis width.
+      shape = list(shards[0].shape)
+      shape[bdim] = shards[0].shape[bdim] * data_width
+      return jax.make_array_from_single_device_arrays(
+          tuple(shape), sharding, list(shards))
+
+    return jax.tree_util.tree_map(join, batch_shard, *sub_arenas)
+
+  return slot_devices, assemble

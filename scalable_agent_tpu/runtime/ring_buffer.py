@@ -16,6 +16,18 @@ SURVEY §2.b "async pipeline"):
   with TPU compute; with depth >= 2 consecutive transfers also
   overlap each other (the r5 fed bench measured H2D as the dominant
   feed-gap term).
+- `UnrollBatchStager` (round 8, config.staging_mode='unroll'): the
+  device-resident alternative to the host stack + one-burst
+  `device_put`. Each completed unroll is `device_put` the moment it
+  leaves the buffer — placed directly on the device owning its batch
+  slot — and the [T+1, B] batch is assembled ON DEVICE by a jitted,
+  donated `dynamic_update_slice` arena, so the step-boundary H2D
+  burst (BENCH_r05: h2d_ms 1430.5 on a 67.5 MB batch) becomes a
+  per-unroll trickle overlapped with the previous step's compute, and
+  the host-side `batch_unrolls` stack (stack_ms 37.5) leaves the hot
+  path entirely. Golden parity: `dynamic_update_slice` of the same
+  values is bit-identical to the host-stack + transfer path
+  (tests/test_learner_plane.py).
 
 Episode stats ride inside the trajectories (StepOutputInfo), so there
 is no side channel to drain — consume them from the dequeued batch
@@ -136,6 +148,213 @@ class TrajectoryBuffer:
       return len(self._deque)
 
 
+def _arena_insert(arena, unroll, slot):
+  """One jitted batch-slot write: place unroll `slot`'s rows into the
+  [T+1, B(, ...)] arena via `dynamic_update_slice` (bit-identical to
+  `np.stack` of the same values — the golden-parity property the
+  unroll staging mode rests on). Donated on the arena so the update is
+  in-place in HBM."""
+  import jax
+  import jax.numpy as jnp
+  from jax import lax
+
+  def traj(a, x):
+    # [T+1, ...] unroll leaf → arena [T+1, B, ...] at batch index slot.
+    x = jnp.asarray(x)
+    return lax.dynamic_update_slice(
+        a, x[:, None].astype(a.dtype), (0, slot) + (0,) * (a.ndim - 2))
+
+  def lead(a, x):
+    # Leading-batch leaf: level_name scalar → arena [B]; core-state
+    # [1, hidden] → arena [B, hidden].
+    x = jnp.asarray(x)
+    upd = x if x.ndim == a.ndim else x[None]
+    return lax.dynamic_update_slice(a, upd.astype(a.dtype),
+                                    (slot,) + (0,) * (a.ndim - 1))
+
+  tree_map = jax.tree_util.tree_map
+  return ActorOutput(
+      level_name=lead(arena.level_name, unroll.level_name),
+      agent_state=tree_map(lead, arena.agent_state, unroll.agent_state),
+      env_outputs=tree_map(traj, arena.env_outputs, unroll.env_outputs),
+      agent_outputs=tree_map(traj, arena.agent_outputs,
+                             unroll.agent_outputs))
+
+
+class UnrollBatchStager:
+  """On-device [T+1, B] batch assembly from per-unroll transfers
+  (config.staging_mode='unroll').
+
+  `add(unroll)` runs the moment an unroll leaves the TrajectoryBuffer:
+  the optional `host_view_fn` peels its tiny host-side stats view
+  first (the batch never comes back to host), then the unroll is
+  `jax.device_put` — async, directly to the device owning its batch
+  slot (`slot_devices`) — and written into a zeroed per-device arena
+  by the jitted, DONATED `_arena_insert`. The step-boundary H2D burst
+  becomes a B-transfer trickle that overlaps the previous step's
+  compute; the host `batch_unrolls` stack disappears.
+
+  `finish()` emits the [T+1, B] batch: the arena itself on a single
+  device, or `assemble_fn` (zero-copy
+  `jax.make_array_from_single_device_arrays` over the data-axis
+  sharding — parallel/train_parallel.make_unroll_assembly) under a
+  pure-DP mesh. Fresh zero arenas back the NEXT batch, so the emitted
+  arrays are never written again while the learner reads them.
+
+  Donation-aliasing fallback: some jaxlib builds mis-pair donation
+  aliases of mesh-placed leaves (the PR-3 dryrun defect — "Expected
+  aliased input ... to have the same size"). The first insert that
+  trips it rebuilds the insert un-donated and continues; the engaged
+  fallback is visible as `stats()['donation_fallback']`.
+
+  NOT thread-safe: owned and driven by the BatchPrefetcher loop
+  thread. `abort()` (partial batch at close/error) is idempotent.
+  """
+
+  def __init__(self, batch_size: int, slot_devices=None,
+               assemble_fn=None, host_view_fn=None, finalize_fn=None,
+               donate: bool = True):
+    import jax
+    if batch_size < 1:
+      raise ValueError('batch_size must be >= 1')
+    if slot_devices is not None and len(slot_devices) != batch_size:
+      raise ValueError(f'slot_devices must have one entry per batch '
+                       f'slot ({len(slot_devices)} != {batch_size})')
+    self._batch_size = batch_size
+    self._slot_devices = slot_devices
+    self._assemble_fn = assemble_fn
+    self._host_view_fn = host_view_fn
+    self._finalize_fn = finalize_fn
+    self._donate = donate
+    self._insert_donated = jax.jit(_arena_insert, donate_argnums=(0,))
+    self._insert_plain = jax.jit(_arena_insert)
+    # Slots grouped by device, in slot order: arena d holds the
+    # contiguous run of slots placed on device d (the data-axis shard
+    # layout make_unroll_assembly's sharding expects).
+    if slot_devices is None:
+      self._device_slots = [(None, batch_size)]
+    else:
+      groups = []
+      for dev in slot_devices:
+        if groups and groups[-1][0] == dev:
+          groups[-1][1] += 1
+        else:
+          groups.append([dev, 1])
+      self._device_slots = [(d, n) for d, n in groups]
+    self._arenas = None   # list of per-device arenas (current batch)
+    self._views = []
+    self._next_slot = 0
+    # Telemetry (read via stats(); single-writer, torn reads benign).
+    self.unrolls_staged = 0
+    self.batches_assembled = 0
+    self.aborted_partials = 0
+    self.donation_fallback = False
+
+  def _zero_arena(self, unroll, slots, device):
+    """Zeroed per-device arena with `slots` batch rows, shaped from a
+    real unroll (no spec plumbing — the first unroll of each batch
+    defines the shapes, and a shape drift fails loudly in the jit)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def traj(x):
+      x = np.asarray(x)
+      return jnp.zeros((x.shape[0], slots) + x.shape[1:], x.dtype)
+
+    def lead(x):
+      x = np.asarray(x)
+      shape = (slots,) + (x.shape[1:] if x.ndim else ())
+      return jnp.zeros(shape, x.dtype)
+
+    tree_map = jax.tree_util.tree_map
+    arena = ActorOutput(
+        level_name=lead(unroll.level_name),
+        agent_state=tree_map(lead, unroll.agent_state),
+        env_outputs=tree_map(traj, unroll.env_outputs),
+        agent_outputs=tree_map(traj, unroll.agent_outputs))
+    if device is not None:
+      arena = jax.device_put(arena, device)
+    return arena
+
+  def _insert(self, arena, unroll_dev, local_slot):
+    import numpy as np
+    slot = np.int32(local_slot)
+    if self._donate:
+      try:
+        return self._insert_donated(arena, unroll_dev, slot)
+      except Exception as e:  # jaxlib XlaRuntimeError (INTERNAL)
+        if 'alias' not in str(e):
+          raise
+        # The PR-3 jaxlib donation-aliasing defect: retry un-donated
+        # for the rest of the run (correctness first; the in-place
+        # update is an optimization).
+        self._donate = False
+        self.donation_fallback = True
+    return self._insert_plain(arena, unroll_dev, slot)
+
+  def add(self, unroll):
+    """Stage one unroll into the current batch (called with host
+    numpy, straight off the TrajectoryBuffer)."""
+    import jax
+    if self._next_slot >= self._batch_size:
+      raise RuntimeError('batch already full; call finish()')
+    if self._host_view_fn is not None:
+      self._views.append(self._host_view_fn(unroll))
+    if self._arenas is None:
+      self._arenas = [self._zero_arena(unroll, n, d)
+                      for d, n in self._device_slots]
+    # Which per-device arena owns this global slot, and where in it.
+    slot = self._next_slot
+    arena_idx, local_slot = 0, slot
+    for i, (_, n) in enumerate(self._device_slots):
+      if local_slot < n:
+        arena_idx = i
+        break
+      local_slot -= n
+    device = self._device_slots[arena_idx][0]
+    unroll_dev = (jax.device_put(unroll, device) if device is not None
+                  else jax.device_put(unroll))
+    self._arenas[arena_idx] = self._insert(self._arenas[arena_idx],
+                                           unroll_dev, local_slot)
+    self._next_slot += 1
+    self.unrolls_staged += 1
+
+  def finish(self):
+    """Emit the completed [T+1, B] device batch (plus the finalized
+    host views when configured); resets for the next batch."""
+    if self._next_slot != self._batch_size:
+      raise RuntimeError(
+          f'finish() with {self._next_slot}/{self._batch_size} slots '
+          'staged')
+    arenas, views = self._arenas, self._views
+    self._arenas, self._views, self._next_slot = None, [], 0
+    batch = (self._assemble_fn(arenas) if self._assemble_fn is not None
+             else arenas[0])
+    self.batches_assembled += 1
+    if self._finalize_fn is not None:
+      return self._finalize_fn(views, batch)
+    return batch
+
+  def abort(self):
+    """Drop a partially staged batch (close/error path): releases the
+    arena device buffers so nothing leaks past the prefetcher's
+    lifetime. Idempotent."""
+    if self._arenas is not None or self._next_slot:
+      self.aborted_partials += 1
+    self._arenas = None
+    self._views = []
+    self._next_slot = 0
+
+  def stats(self):
+    return {
+        'unrolls_staged': self.unrolls_staged,
+        'batches_assembled': self.batches_assembled,
+        'aborted_partials': self.aborted_partials,
+        'donation_fallback': self.donation_fallback,
+    }
+
+
 class BatchPrefetcher:
   """Stages upcoming device batches while the learner consumes the
   current one (the StagingArea role, generalized to `depth` slots).
@@ -160,12 +379,16 @@ class BatchPrefetcher:
   """
 
   def __init__(self, buffer: TrajectoryBuffer, batch_size: int,
-               place_fn: Callable = lambda x: x, depth: int = 2):
+               place_fn: Callable = lambda x: x, depth: int = 2,
+               stager: Optional[UnrollBatchStager] = None):
     if depth < 1:
       raise ValueError('staging depth must be >= 1')
     self._buffer = buffer
     self._batch_size = batch_size
     self._place_fn = place_fn
+    # staging_mode='unroll': per-unroll device staging + on-device
+    # assembly replaces get_batch + place_fn (which is then unused).
+    self._stager = stager
     self._out = collections.deque()
     self._lock = threading.Lock()
     self._ready = threading.Condition(self._lock)
@@ -182,11 +405,23 @@ class BatchPrefetcher:
                                     name='batch-prefetcher', daemon=True)
     self._thread.start()
 
+  def _stage_next(self):
+    """Assemble + stage one batch. Batch mode: host stack via
+    get_batch, then one place_fn burst. Unroll mode: each unroll is
+    transferred the moment it dequeues and the batch assembles on
+    device (UnrollBatchStager) — the transfers overlap the step that
+    is computing RIGHT NOW, not just each other."""
+    if self._stager is None:
+      batch = self._buffer.get_batch(self._batch_size)
+      return self._place_fn(batch)  # async device_put: overlaps
+    for _ in range(self._batch_size):
+      self._stager.add(self._buffer.get())
+    return self._stager.finish()
+
   def _loop(self):
     try:
       while True:
-        batch = self._buffer.get_batch(self._batch_size)
-        staged = self._place_fn(batch)  # async device_put: overlaps
+        staged = self._stage_next()
         with self._space:
           while len(self._out) >= self._depth and not self._closed:
             self._space.wait()
@@ -196,10 +431,14 @@ class BatchPrefetcher:
           self._staged += 1
           self._ready.notify()
     except Closed:
+      if self._stager is not None:
+        self._stager.abort()  # partial batch: free its arena buffers
       with self._lock:
         self._closed = True
         self._ready.notify_all()
     except BaseException as e:  # surfaced to the consumer
+      if self._stager is not None:
+        self._stager.abort()
       with self._lock:
         self._error = e
         self._closed = True
@@ -236,8 +475,9 @@ class BatchPrefetcher:
     `h2d_overlap_fraction` (1.0 = no step ever waited on staging)."""
     with self._lock:
       gets = self._gets
-      return {
+      out = {
           'depth': self._depth,
+          'mode': 'unroll' if self._stager is not None else 'batch',
           'staged_batches': self._staged,
           'gets': gets,
           'blocked_gets': self._blocked_gets,
@@ -245,6 +485,9 @@ class BatchPrefetcher:
           'h2d_overlap_fraction': (
               (gets - self._blocked_gets) / gets if gets else 0.0),
       }
+    if self._stager is not None:
+      out.update(self._stager.stats())
+    return out
 
   def close(self):
     with self._lock:
@@ -253,3 +496,8 @@ class BatchPrefetcher:
       self._space.notify_all()
     self._buffer.close()
     self._thread.join(timeout=5)
+    # Release staged device batches (and, via the loop thread's abort,
+    # any partial arena): a closed prefetcher must not pin batch-sized
+    # HBM buffers for the rest of the process lifetime.
+    with self._lock:
+      self._out.clear()

@@ -48,12 +48,19 @@ def log_probs_from_logits_and_actions(policy_logits, actions):
 def from_logits(behaviour_policy_logits, target_policy_logits, actions,
                 discounts, rewards, values, bootstrap_value,
                 clip_rho_threshold=1.0, clip_pg_rho_threshold=1.0,
-                use_associative_scan=False, use_pallas=False):
+                use_associative_scan=False, use_pallas=False,
+                mesh=None, batch_axis='data'):
   """V-trace for softmax policies (reference: vtrace.py ≈L80).
 
   Shapes (time-major): logits [T, B, NUM_ACTIONS], actions [T, B],
   discounts/rewards/values [T, B], bootstrap_value [B]. Extra trailing
   dimensions are supported everywhere the reference supports them.
+
+  `mesh` (with `batch_axis`) only matters for the Pallas form: inside
+  a sharded step the kernel runs under `shard_map` over the batch
+  axis (ops/vtrace_pallas.sharded_from_importance_weights) — V-trace
+  is per-batch-column independent, so the mapping is exact. The pure
+  JAX forms partition under GSPMD without help and ignore it.
   """
   behaviour_action_log_probs = log_probs_from_logits_and_actions(
       behaviour_policy_logits, actions)
@@ -69,7 +76,8 @@ def from_logits(behaviour_policy_logits, target_policy_logits, actions,
       clip_rho_threshold=clip_rho_threshold,
       clip_pg_rho_threshold=clip_pg_rho_threshold,
       use_associative_scan=use_associative_scan,
-      use_pallas=use_pallas)
+      use_pallas=use_pallas,
+      mesh=mesh, batch_axis=batch_axis)
   return VTraceFromLogitsReturns(
       log_rhos=log_rhos,
       behaviour_action_log_probs=behaviour_action_log_probs,
@@ -112,7 +120,8 @@ def from_importance_weights(log_rhos, discounts, rewards, values,
                             bootstrap_value, clip_rho_threshold=1.0,
                             clip_pg_rho_threshold=1.0,
                             use_associative_scan=False,
-                            use_pallas=False):
+                            use_pallas=False,
+                            mesh=None, batch_axis='data'):
   """V-trace from log importance weights (reference: vtrace.py ≈L130).
 
   rhos = exp(log_rhos); clipped at `clip_rho_threshold` (rho-bar) for the
@@ -122,7 +131,10 @@ def from_importance_weights(log_rhos, discounts, rewards, values,
 
   `use_pallas=True` runs the whole computation as one fused Pallas TPU
   kernel (ops/vtrace_pallas.py) — no HBM intermediates; interpreter
-  mode off-TPU keeps CI on the same code path.
+  mode off-TPU keeps CI on the same code path. Under a sharded step,
+  pass the step's `mesh`: pallas_call has no SPMD partitioning rule,
+  so the kernel is shard_map'ped over `batch_axis` instead (exact —
+  each batch column is an independent recursion).
   """
   if use_pallas and use_associative_scan:
     raise ValueError('use_pallas and use_associative_scan are mutually '
@@ -137,10 +149,17 @@ def from_importance_weights(log_rhos, discounts, rewards, values,
      bootstrap_value) = jax.tree_util.tree_map(
          lax.stop_gradient,
          (log_rhos, discounts, rewards, values, bootstrap_value))
-    vs, pg_advantages = vtrace_pallas.from_importance_weights(
-        log_rhos, discounts, rewards, values, bootstrap_value,
-        clip_rho_threshold=clip_rho_threshold,
-        clip_pg_rho_threshold=clip_pg_rho_threshold)
+    if mesh is not None:
+      vs, pg_advantages = vtrace_pallas.sharded_from_importance_weights(
+          mesh, log_rhos, discounts, rewards, values, bootstrap_value,
+          clip_rho_threshold=clip_rho_threshold,
+          clip_pg_rho_threshold=clip_pg_rho_threshold,
+          batch_axis=batch_axis)
+    else:
+      vs, pg_advantages = vtrace_pallas.from_importance_weights(
+          log_rhos, discounts, rewards, values, bootstrap_value,
+          clip_rho_threshold=clip_rho_threshold,
+          clip_pg_rho_threshold=clip_pg_rho_threshold)
     return VTraceReturns(vs=lax.stop_gradient(vs),
                          pg_advantages=lax.stop_gradient(pg_advantages))
   log_rhos = jnp.asarray(log_rhos, jnp.float32)

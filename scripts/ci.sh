@@ -42,6 +42,18 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_runtime.py \
   -p no:cacheprovider
 BENCH_SMOKE=1 BENCH_ONLY=inference_plane python bench.py
 
+echo '== learner-plane smoke (on-device assembly golden parity +'
+echo '   failure paths + sharded Pallas V-trace parity selector, then'
+echo '   the tiny {batch,unroll}×depth bench rows via'
+echo '   BENCH_ONLY=learner_plane — <60 s CPU) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_learner_plane.py \
+  "tests/test_parallel.py::test_pallas_vtrace_sharded_step_matches_single_device" \
+  -q -p no:cacheprovider
+# 8 virtual devices: the vtrace_sharded row must exercise the
+# multi-shard shard_map path here (the bench chip has 1 device).
+XLA_FLAGS='--xla_force_host_platform_device_count=8' \
+  BENCH_SMOKE=1 BENCH_ONLY=learner_plane python bench.py
+
 echo '== pixel-control fast-path parity (integer rewards + d2s head'
 echo '   + bf16-Q levers vs the r5 reference forms — <60 s CPU) =='
 JAX_PLATFORMS=cpu python -m pytest tests/test_unreal.py -q \

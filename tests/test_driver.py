@@ -254,12 +254,15 @@ def test_dryrun_multichip_self_provisions():
   assert 'ok' in out.stdout
 
 
-def test_pallas_vtrace_rejected_under_mesh(tmp_path):
-  """pallas_call has no SPMD partitioning rule; the driver must reject
-  the combination before any env/checkpoint spin-up."""
+def test_pallas_vtrace_accepted_under_mesh(tmp_path):
+  """Round 8: the mesh rejection is LIFTED — pallas_call still has no
+  SPMD partitioning rule, but the sharded step now runs the kernel
+  shard_map'ped over the data axis (vtrace.py), so the 8-device mesh
+  trains with the fused V-trace instead of raising. The mutual
+  exclusion with the associative scan stays a config error."""
   cfg = _config(tmp_path, batch_size=8, use_pallas_vtrace=True)
-  with pytest.raises(ValueError, match='single-device'):
-    driver.train(cfg, max_steps=1)
+  run = driver.train(cfg, max_steps=2, stall_timeout_secs=120)
+  assert int(run.state.update_steps) == 2
   cfg2 = _config(tmp_path, use_pallas_vtrace=True,
                  use_associative_scan=True)
   with pytest.raises(ValueError, match='mutually exclusive'):
