@@ -489,6 +489,157 @@ def bench_inference_plane(smoke):
   return results
 
 
+def bench_overload(smoke):
+  """The overload instrument (round 9, docs/ROBUSTNESS.md actor-plane
+  rows): tail latency and shed rate of the serving plane when the
+  actor population exceeds the state arena — the regime admission
+  control exists for. Three rows run the fleet at {1x, 2x, 4x} slot
+  capacity under the SHED policy (deadline rejection is the intended
+  steady-state overload answer); each actor holds its slot for a
+  burst of policy calls, releases, and re-acquires, so the admission
+  seam churns continuously:
+
+  - `policy_calls_per_sec` + client-side `lat_p50_ms`/`lat_p99_ms` of
+    the calls that DID run — what overload does to the served tail;
+  - `shed_fraction` (sheds / acquires, the SLO number the chaos storm
+    bounds) with the raw acquire/shed/wait counters and the parked-
+    wait p99 from stats() riding along.
+
+  The 1x row is the control (shed_fraction ≈ 0 — admission must cost
+  nothing when capacity suffices); 2x matches the chaos overload
+  storm's pressure; 4x is the headroom probe.
+  """
+  import threading
+  import numpy as np
+  import jax
+  import jax.numpy as jnp
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.models import ImpalaAgent, init_params
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+  from scalable_agent_tpu.ops.dynamic_batching import BatcherCancelled
+  from scalable_agent_tpu.runtime.inference import (
+      InferenceClosed, InferenceServer, SlotUnavailable, percentile_ms)
+  from scalable_agent_tpu.structs import StepOutput, StepOutputInfo
+
+  h, w = (72, 96) if not smoke else (24, 32)
+  torso = 'deep' if not smoke else 'shallow'
+  dtype = jnp.bfloat16 if not smoke else jnp.float32
+  dur = 4.0 if not smoke else 0.6
+  slots = 8 if not smoke else 2
+  pressures = (1, 2, 4)
+  hold_calls = 25 if not smoke else 8
+  num_actions = 9
+  obs_spec = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+  agent = ImpalaAgent(num_actions=num_actions, torso=torso,
+                      use_instruction=False, dtype=dtype)
+  params = init_params(agent, jax.random.PRNGKey(0), obs_spec)
+  rng = np.random.RandomState(0)
+  frame = rng.randint(0, 255, (h, w, 3)).astype(np.uint8)
+  instr = np.zeros((MAX_INSTRUCTION_LEN,), np.int32)
+
+  def run_cell(pressure):
+    fleet = pressure * slots
+    cfg = Config(inference_min_batch=0,
+                 inference_max_batch=max(64, slots),
+                 inference_timeout_ms=20,
+                 inference_state_cache=True,
+                 inference_state_slots=slots,
+                 inference_admission='shed',
+                 # Short deadline: a shed row must measure steady-state
+                 # rejection rate, not one long parked wait per actor.
+                 inference_admission_timeout_secs=0.05)
+    server = InferenceServer(agent, params, cfg, seed=7,
+                             pad_batch_to=slots, fleet_size=slots)
+    server.warmup(obs_spec, sizes=[slots])
+    counts = [0] * fleet
+    lats = [[] for _ in range(fleet)]
+    measuring = threading.Event()
+    stop = threading.Event()
+
+    def run(i):
+      prev = np.int32(i % num_actions)
+      step = 0
+      try:
+        while not stop.is_set():
+          try:
+            state = server.initial_core_state()
+          except SlotUnavailable:
+            # Shed: the intended overload answer — back off briefly
+            # and retry (server.stats() counts it).
+            time.sleep(0.005)
+            continue
+          except InferenceClosed:
+            return
+          try:
+            for _ in range(hold_calls):
+              if stop.is_set():
+                return
+              env_out = StepOutput(
+                  reward=np.float32(0.1),
+                  info=StepOutputInfo(np.float32(0), np.int32(0)),
+                  done=np.bool_(step > 0 and step % 23 == 0),
+                  observation=(frame, instr))
+              t0 = time.perf_counter()
+              out, state = server.policy(prev, env_out, state)
+              dt = time.perf_counter() - t0
+              counts[i] += 1
+              if measuring.is_set():
+                lats[i].append(dt)
+              prev = np.int32(out.action)
+              step += 1
+          finally:
+            if hasattr(state, 'release'):
+              state.release()
+      except BatcherCancelled:
+        pass
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(fleet)]
+    for t in threads:
+      t.start()
+    deadline = time.perf_counter() + (60 if not smoke else 120)
+    # Under pressure > 1x a given actor may legitimately never get a
+    # slot inside the warm window — warm until the FLEET moves, not
+    # until every member does.
+    while (sum(counts) < slots * 2
+           and time.perf_counter() < deadline):
+      time.sleep(0.05)
+    base = sum(counts)
+    measuring.set()
+    dt = _count_window(lambda: sum(counts), base, dur,
+                       min_count=slots * 4)
+    got = sum(counts) - base
+    measuring.clear()
+    stop.set()
+    stats = server.stats()
+    server.close()
+    for t in threads:
+      t.join(timeout=15)
+    if got == 0:
+      raise RuntimeError(f'overload moved no calls (pressure='
+                         f'{pressure}x, slots={slots})')
+    acquires = stats['acquires']
+    window = sorted(x for lat in lats for x in lat)
+    return {
+        'fleet': fleet,
+        'slots': slots,
+        'policy_calls_per_sec': round(got / dt, 1),
+        'lat_p50_ms': round(percentile_ms(window, 0.5, 1e3), 2),
+        'lat_p99_ms': round(percentile_ms(window, 0.99, 1e3), 2),
+        'acquires': acquires,
+        'sheds': stats['sheds'],
+        'shed_fraction': round(stats['sheds'] / acquires, 4)
+        if acquires else 0.0,
+        'admission_waits': stats['admission_waits'],
+        'admission_wait_p99_ms': stats['admission_wait_p99_ms'],
+    }
+
+  results = {'slots': slots, 'pressures': list(pressures)}
+  for pressure in pressures:
+    results[f'{pressure}x'] = run_cell(pressure)
+  return results
+
+
 def bench_learner_plane(smoke):
   """The learner-feed instrument (round 8): itemize the batch boundary
   the tentpole attacks. BENCH_r05 measured it as ONE burst per step —
@@ -1442,6 +1593,22 @@ def main():
     })
     return
 
+  # BENCH_ONLY=overload: just the overload rows (the scripts/ci.sh
+  # chaos-adjacent smoke — shed-rate/tail-latency mechanics on CPU).
+  if os.environ.get('BENCH_ONLY') == 'overload':
+    overload = bench_overload(smoke)
+    worst = max((row['shed_fraction']
+                 for row in overload.values() if isinstance(row, dict)),
+                default=0.0)
+    _emit({
+        'metric': 'overload_worst_shed_fraction',
+        'value': worst,
+        'unit': ('sheds/acquires at 4x slot pressure, shed admission%s'
+                 % (' (SMOKE)' if smoke else '')),
+        'overload': overload,
+    })
+    return
+
   rows = bench_synthetic(smoke)
   cfg = rows['config']
   stats = rows['synthetic']
@@ -1462,6 +1629,9 @@ def main():
   infer = None
   if os.environ.get('BENCH_SKIP_INFERENCE') != '1':
     infer = bench_inference_plane(smoke)
+  overload = None
+  if os.environ.get('BENCH_SKIP_OVERLOAD') != '1':
+    overload = bench_overload(smoke)
   plane = None
   if os.environ.get('BENCH_SKIP_LEARNER_PLANE') != '1':
     plane = bench_learner_plane(smoke)
@@ -1500,6 +1670,8 @@ def main():
     out['anakin'] = anakin
   if infer is not None:
     out['inference_plane'] = infer
+  if overload is not None:
+    out['overload'] = overload
   if plane is not None:
     out['learner_plane'] = plane
   _emit(out)
@@ -1561,6 +1733,15 @@ def _headline(out):
                'p50': row['lat_p50_ms'], 'p99': row['lat_p99_ms']}
         for name, row in infer.items()
         if isinstance(row, dict) and name.endswith(f'_f{fmax}')}
+  # The overload rows (round 9): shed fraction + served tail latency
+  # at 1x/2x/4x slot pressure — the clip-safe record of what the
+  # admission policy does under the load the chaos storm drills.
+  overload = out.get('overload')
+  if overload:
+    head['overload'] = {
+        name: {'p99': row['lat_p99_ms'],
+               'shed_fraction': row['shed_fraction']}
+        for name, row in overload.items() if isinstance(row, dict)}
   # The learner-feed itemization (round 8): the {batch, unroll} ×
   # depth rows plus the sharded pallas-vs-scan call must ride the
   # clip-safe last line — BENCH_r08 carries the --staging_mode and

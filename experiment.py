@@ -173,6 +173,38 @@ flags.DEFINE_integer('inference_state_slots',
                      'State-arena capacity in slots (state-cache '
                      'mode). 0 = auto: 2x the fleet size (respawn '
                      'headroom).')
+flags.DEFINE_enum('inference_admission', _DEFAULTS.inference_admission,
+                  ['block', 'shed', 'grow'],
+                  'Slot admission when the state arena is exhausted: '
+                  'block = deadline-bounded priority waitlist '
+                  '(default), shed = deadline rejection counted as '
+                  'load shedding, grow = double the arena in place. '
+                  'Exhaustion never raises into the learner loop '
+                  '(docs/ROBUSTNESS.md actor-plane rows).')
+flags.DEFINE_float('inference_admission_timeout_secs',
+                   _DEFAULTS.inference_admission_timeout_secs,
+                   'Deadline for parked slot acquisitions '
+                   '(block/shed admission).')
+flags.DEFINE_integer('max_unroll_staleness',
+                     _DEFAULTS.max_unroll_staleness,
+                     'Ingest admission window in published param '
+                     'versions: remote unrolls generated more than '
+                     'this many versions behind the current snapshot '
+                     'are refused (benign; the actor refetches and '
+                     'keeps feeding). 0 = no window.')
+flags.DEFINE_integer('fleet_quarantine_after',
+                     _DEFAULTS.fleet_quarantine_after,
+                     'Consecutive respawns without one completed '
+                     'unroll before an actor slot quarantines '
+                     '(slots_quarantined in summaries); 0 = retry '
+                     'forever (backoff-paced).')
+flags.DEFINE_float('preempt_drain_timeout_secs',
+                   _DEFAULTS.preempt_drain_timeout_secs,
+                   'Preemption drain budget: SIGTERM stops '
+                   'admissions, flushes in-flight unrolls, takes a '
+                   'verified checkpoint and writes '
+                   'resume_manifest.json within this many seconds '
+                   '(docs/RUNBOOK.md drain/resume).')
 flags.DEFINE_integer('num_actions', _DEFAULTS.num_actions,
                      'Policy head size override (None = backend '
                      'default; Atari: 18 full set, fewer = minimal '
@@ -326,15 +358,27 @@ def main(argv):
   from scalable_agent_tpu.runtime.py_process import warm_forkserver
   warm_forkserver()
   # Preemption safety: SIGTERM (k8s eviction, TPU-VM maintenance)
-  # must run driver.train's finally block — final checkpoint save and
-  # clean fleet/batcher shutdown — not kill the process mid-step. The
-  # reference relied on MonitoredTrainingSession's periodic saves and
-  # simply lost the tail; here the tail is saved.
+  # must not kill the process mid-step. Round 9 upgrades the response
+  # from "unwind through the finally block" to a GRACEFUL DRAIN: the
+  # first SIGTERM sets the drain event — driver.train stops
+  # admissions, flushes in-flight unrolls through the learner, takes
+  # a verified checkpoint and writes resume_manifest.json, then
+  # returns cleanly (docs/RUNBOOK.md §7). A second SIGTERM (the
+  # platform's kill escalation arriving before the drain finished)
+  # falls back to the old raise-through-finally path; a third is
+  # ignored so it cannot abort the final save. Only the train loop
+  # consumes the drain event — every other mode (actor host, anakin,
+  # eval) keeps the old first-SIGTERM-raises behavior, or its one
+  # graceful shot would be absorbed by an event nobody reads.
   import signal
+  import threading
+  drain_event = threading.Event()
+  drain_supported = threading.Event()
 
   def _terminate(signum, frame):
-    # Disarm first: a second SIGTERM during the cleanup (final save)
-    # must not abort the very save this handler exists to protect.
+    if drain_supported.is_set() and not drain_event.is_set():
+      drain_event.set()
+      return
     signal.signal(signal.SIGTERM, signal.SIG_IGN)
     raise KeyboardInterrupt(f'signal {signum}')
 
@@ -360,7 +404,8 @@ def main(argv):
     return
   from scalable_agent_tpu import driver
   if cfg.mode == 'train':
-    run = driver.train(cfg)
+    drain_supported.set()
+    run = driver.train(cfg, drain_event=drain_event)
     logging.info('training done at %d frames', run.frames)
   elif cfg.mode == 'anakin':
     import jax

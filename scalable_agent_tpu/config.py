@@ -168,6 +168,36 @@ class Config:
   # a wedged actor's slot frees only when its orphaned thread
   # unwinds (runtime/fleet.py respawn contract).
   inference_state_slots: int = 0
+  # --- Actor-plane overload & preemption hardening (round 9;
+  # docs/ROBUSTNESS.md actor-plane rows). ---
+  # Slot admission policy when the state arena is exhausted (the old
+  # behavior — raise RuntimeError into the fleet — is gone):
+  #   'block' (default): park on a priority waitlist until a slot
+  #     frees or the admission deadline passes (then a clean
+  #     SlotUnavailable that fleet respawn treats as pause-and-retry);
+  #   'shed': same wait, but the deadline rejection is the intended
+  #     overload response — counted in stats()['sheds'] and the
+  #     driver's inference_sheds summary;
+  #   'grow': never park — double the arena in place (one recompile
+  #     per growth, counted as arena_grows).
+  inference_admission: str = 'block'      # block | shed | grow
+  # Deadline for parked slot acquisitions (block and shed policies).
+  inference_admission_timeout_secs: float = 10.0
+  # Ingest staleness window, in published param versions: a remote
+  # unroll generated with params more than this many versions behind
+  # the current snapshot is refused at admission (benign 'stale'
+  # reply; the client refetches and keeps feeding). 0 = no window.
+  max_unroll_staleness: int = 0
+  # Consecutive respawns without one completed unroll before a fleet
+  # slot gives up and quarantines (surfaced as slots_quarantined);
+  # 0 = retry forever (pre-round-9 semantics, minus the hot loop —
+  # respawns are always backoff-paced now).
+  fleet_quarantine_after: int = 5
+  # Preemption drain budget: on SIGTERM (or the preempt_signal fault)
+  # the driver stops admissions, flushes in-flight unrolls through
+  # the learner, takes a verified checkpoint and writes
+  # resume_manifest.json — all within this many seconds.
+  preempt_drain_timeout_secs: float = 30.0
   # Ring buffer capacity in batches (reference FIFOQueue capacity=1 +
   # StagingArea double buffer ⇒ bounded policy lag; keep it small).
   queue_capacity_batches: int = 1

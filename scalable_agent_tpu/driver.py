@@ -22,11 +22,14 @@ maps as:
 in multi-task mode (reference test() ≈L595–630).
 """
 
+import collections
 import dataclasses
+import inspect
 import json
 import logging
 import os
 import sys
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -46,12 +49,38 @@ from scalable_agent_tpu.models import ImpalaAgent, init_params
 from scalable_agent_tpu.parallel import mesh as mesh_lib
 from scalable_agent_tpu.parallel import train_parallel
 from scalable_agent_tpu.runtime import faults as faults_lib
+from scalable_agent_tpu.runtime import inference as inference_lib
 from scalable_agent_tpu.runtime import ring_buffer
 from scalable_agent_tpu.runtime.actor import Actor
 from scalable_agent_tpu.runtime.fleet import ActorFleet
 from scalable_agent_tpu.runtime.inference import InferenceServer
 
 log = logging.getLogger('scalable_agent_tpu')
+
+# The preemption drain's on-disk handoff: written next to the
+# checkpoints at drain time, consumed (renamed) by the resuming run.
+RESUME_MANIFEST = 'resume_manifest.json'
+
+
+def read_resume_manifest(logdir: str) -> Optional[Dict]:
+  """The drain manifest of a previous preempted run, or None."""
+  path = os.path.join(logdir, RESUME_MANIFEST)
+  try:
+    with open(path) as f:
+      return json.load(f)
+  except (OSError, ValueError):
+    return None
+
+
+def _write_resume_manifest(logdir: str, manifest: Dict) -> str:
+  """Atomic write (tmp + rename): a manifest is either complete or
+  absent — a resume must never act on a half-written one."""
+  path = os.path.join(logdir, RESUME_MANIFEST)
+  tmp = path + '.tmp'
+  with open(tmp, 'w') as f:
+    json.dump(manifest, f, indent=2, sort_keys=True)
+  os.replace(tmp, path)
+  return path
 
 
 def _stats_only_view(level_name, info, done):
@@ -97,11 +126,24 @@ def make_fleet(config: Config, agent, policy, buffer, levels,
   fresh at every (re)spawn — pass the InferenceServer's
   `initial_core_state` so state-cache mode hands each actor a zeroed
   arena slot (a respawned actor must never inherit a stale carry);
-  None falls back to the plain numeric zero carry.
+  None falls back to the plain numeric zero carry. A factory that
+  accepts a `priority` keyword (initial_core_state does) gets the
+  admission class: PRIORITY_LIVE for a slot's first spawn,
+  PRIORITY_RESPAWN for respawns — so respawn churn under overload
+  waits behind live traffic instead of starving it.
   """
   n = config.num_actors if num_actors is None else num_actors
   if initial_state_fn is None:
     initial_state_fn = lambda: agent.initial_state(1)  # noqa: E731
+  try:
+    accepts_priority = ('priority' in
+                        inspect.signature(initial_state_fn).parameters)
+  except (TypeError, ValueError):
+    accepts_priority = False
+  # Spawn count per slot (single-threaded: start() and check_health
+  # respawns both run on the learner thread) — first spawn vs respawn
+  # picks the admission priority class.
+  spawns = collections.Counter()
 
   def make_actor(i):
     idx = level_offset + i
@@ -114,13 +156,33 @@ def make_fleet(config: Config, agent, policy, buffer, levels,
     # Fault-injection seam (runtime/faults.py): identity unless an
     # installed plan targets env_step.
     env = faults_lib.maybe_wrap_env(env)
-    actor = Actor(env, policy, initial_state_fn(),
+    try:
+      if accepts_priority:
+        priority = (inference_lib.PRIORITY_RESPAWN if spawns[i]
+                    else inference_lib.PRIORITY_LIVE)
+        state = initial_state_fn(priority=priority)
+      else:
+        state = initial_state_fn()
+    except BaseException:
+      # A denied slot admission must not leak the env just built —
+      # the fleet retries this spawn later with a FRESH env.
+      try:
+        if process is not None:
+          process.close(timeout=1.0)
+        else:
+          env.close()
+      except Exception:
+        pass
+      raise
+    spawns[i] += 1
+    actor = Actor(env, policy, state,
                   unroll_length=config.unroll_length,
                   num_action_repeats=config.num_action_repeats,
                   level_name_id=idx % len(levels))
     return env, process, actor
 
-  return ActorFleet(make_actor, buffer, n)
+  return ActorFleet(make_actor, buffer, n,
+                    quarantine_after=config.fleet_quarantine_after)
 
 
 def _choose_eval_mesh():
@@ -198,7 +260,8 @@ class TrainRun:
 def train(config: Config, max_steps: Optional[int] = None,
           stall_timeout_secs: Optional[float] = None,
           max_seconds: Optional[float] = None,
-          fleet_factory=None) -> TrainRun:
+          fleet_factory=None,
+          drain_event: Optional[threading.Event] = None) -> TrainRun:
   """Run IMPALA training until total_environment_frames (or max_steps
   / max_seconds — timed smoke and bench runs).
 
@@ -208,6 +271,17 @@ def train(config: Config, max_steps: Optional[int] = None,
   publish cadence, summaries, health checks) can be measured at full
   feed rate without env/inference cost (VERDICT r4 #3). Production
   always uses the default.
+
+  `drain_event` is the preemption seam (experiment.py sets it from
+  SIGTERM; the 'preempt_signal' fault site fires it deterministically
+  for chaos): when set, the loop QUIESCES instead of dying mid-step —
+  admissions stop, in-flight unrolls flush through the learner,
+  a verified checkpoint lands through the integrity ladder, and
+  `resume_manifest.json` (frames / update_steps / param version /
+  buffer watermarks) is written next to the summaries; the next
+  train() on the same logdir resumes from it. Single-host only: the
+  drain checkpoint is not a collective (multi-host preemption keeps
+  the periodic-checkpoint story).
 
   Returns the TrainRun with the final state (all machinery shut down).
   """
@@ -292,6 +366,38 @@ def train(config: Config, max_steps: Optional[int] = None,
   # dispatch pipeline each step).
   _initial_steps = int(jax.device_get(state.update_steps))
 
+  # --- Preemption resume: a drain manifest from a preempted run is
+  # the handoff record — validate the restored step against it, then
+  # CONSUME it (renamed, process 0) so a later unrelated restart does
+  # not re-announce the same preemption. ---
+  resume_manifest = read_resume_manifest(config.logdir)
+  if resume_manifest is not None:
+    manifest_steps = int(resume_manifest.get('update_steps', -1))
+    if _initial_steps == manifest_steps:
+      log.info('resuming from preemption drain manifest: step %d, '
+               '%d frames (drain latency %.2fs, %d unroll(s) were '
+               'left in the buffer)', manifest_steps,
+               resume_manifest.get('frames', -1),
+               resume_manifest.get('drain_latency_secs', -1.0),
+               resume_manifest.get('buffer', {}).get(
+                   'leftover_unrolls', 0))
+    else:
+      # The drain's verified checkpoint and the manifest disagree
+      # (drain save failed → the ladder restored an older LAST_GOOD).
+      # Resume anyway — frames between the checkpoint and the drain
+      # point replay, the same at-least-once story as any crash.
+      log.warning(
+          'resume manifest names step %d but the restored checkpoint '
+          'is step %d — resuming from the checkpoint (frames between '
+          'them replay)', manifest_steps, _initial_steps)
+    if jax.process_index() == 0:
+      try:
+        os.replace(os.path.join(config.logdir, RESUME_MANIFEST),
+                   os.path.join(config.logdir,
+                                RESUME_MANIFEST + '.consumed'))
+      except OSError:
+        log.exception('could not consume the resume manifest')
+
   # Multi-host TP: state.params are sharded ACROSS processes, so a
   # jit over them (the inference step) is a collective SPMD program —
   # and the batcher's computation thread invokes inference at
@@ -355,7 +461,8 @@ def train(config: Config, max_steps: Optional[int] = None,
           contract=remote.trajectory_contract(config, agent,
                                               num_actions),
           wire_dtype=config.resolved_wire_dtype,
-          ingest_workers=config.ingest_workers)
+          ingest_workers=config.ingest_workers,
+          max_unroll_staleness=config.max_unroll_staleness)
       log.info('remote-actor ingest listening on port %d', ingest.port)
     # --- Inference server (weights served host-side to actor
     # threads). Per-process seed offset: params/init use config.seed
@@ -525,6 +632,15 @@ def train(config: Config, max_steps: Optional[int] = None,
   steps_done = 0
   profiling = False
   errors: List[BaseException] = []
+  # Preemption-drain state: set once the drain is requested (SIGTERM
+  # via drain_event, or the deterministic 'preempt_signal' fault);
+  # the loop then flushes the already-produced feed instead of
+  # breaking mid-pipeline, and the post-loop finalize takes the
+  # verified checkpoint + writes the resume manifest.
+  draining = False
+  drain_t0 = None
+  drain_deadline = None
+  drain_source = None
   # Watchdog loop state: the stashed (step, SentinelHandle) awaiting
   # its delayed read, and the bad-step count of the current burst
   # (driver-side: the monitor's consecutive counter resets on
@@ -538,6 +654,8 @@ def train(config: Config, max_steps: Optional[int] = None,
   pending_metrics = None
   prev_metrics = None
   action_counts_acc = np.zeros((num_actions,), np.int64)
+  last_publish_step = _initial_steps   # resume-manifest param version
+  last_quarantined_slots = 0
   last_remote_publish = float('-inf')
   last_pf_snap = {'gets': 0, 'wait_secs': 0.0}
   last_inference_snap = {'calls': 0, 'requests': 0}
@@ -550,6 +668,41 @@ def train(config: Config, max_steps: Optional[int] = None,
       10.0, stall_timeout_secs)
   try:
     while True:
+      # --- Preemption drain request (SIGTERM via drain_event, or the
+      # deterministic 'preempt_signal' fault site): quiesce instead of
+      # dying mid-step. The fault site is consulted every loop
+      # iteration (one event per step, like nan_burst). ---
+      preempt_fault = faults_lib.fire('preempt_signal') is not None
+      if not draining and (preempt_fault or (
+          drain_event is not None and drain_event.is_set())):
+        if num_processes > 1:
+          # The drain checkpoint is NOT a collective save; a one-host
+          # drain would deadlock the others. Exit the loop — the
+          # periodic collective checkpoints cover the tail.
+          log.warning('preemption requested on a multi-host run: '
+                      'drain is single-host, exiting the loop')
+          break
+        draining = True
+        drain_source = 'fault' if preempt_fault else 'signal'
+        drain_t0 = time.monotonic()
+        drain_deadline = drain_t0 + config.preempt_drain_timeout_secs
+        incidents.event('preempt_drain_start',
+                        step=steps_done + _initial_steps,
+                        source=drain_source)
+        log.warning(
+            'preemption drain (%s): admissions stopped; flushing '
+            'in-flight unrolls within %.1fs', drain_source,
+            config.preempt_drain_timeout_secs)
+        # Stop production WITHOUT closing the buffer: actors finish
+        # their current unroll, put it, and exit — those unrolls are
+        # exactly what the flush below trains on. (Custom fleet
+        # factories without a stop seam still drain: the feed just
+        # keeps producing until the deadline.)
+        if hasattr(fleet, 'stop_event'):
+          fleet.stop_event.set()
+      if draining and time.monotonic() > drain_deadline:
+        log.warning('preemption drain budget exhausted; finalizing')
+        break
       frames = (_initial_steps + steps_done) * config.frames_per_step
       if frames >= config.total_environment_frames:
         break
@@ -560,8 +713,10 @@ def train(config: Config, max_steps: Optional[int] = None,
         break
       try:
         stats_view, action_counts, batch_device = prefetcher.get(
-            timeout=poll_secs)
+            timeout=0.5 if draining else poll_secs)
       except TimeoutError:
+        if draining:
+          break  # the feed dried up: every drainable batch is trained
         # No data yet: surface actor failures instead of hanging (the
         # reference hangs silently here — SURVEY §5.3). Read errors
         # BEFORE check_health — a respawn clears the slot's error, and
@@ -576,6 +731,8 @@ def train(config: Config, max_steps: Optional[int] = None,
               'no trajectory batch despite healthy actors')
         continue
       except ring_buffer.Closed:
+        if draining:
+          break
         errors = fleet.errors() or errors
         if errors:
           raise errors[0]
@@ -605,6 +762,13 @@ def train(config: Config, max_steps: Optional[int] = None,
       if poisoned:
         incidents.event('fault_nan_burst',
                         step=steps_done + _initial_steps + 1)
+      # Fault site 'slow_learner': a stalled step (device contention,
+      # preempted neighbors) — the buffer must fill and producer-side
+      # backpressure engage, never unbounded queueing (the overload
+      # storm's occupancy SLO).
+      slow = faults_lib.fire('slow_learner')
+      if slow is not None and slow.kind == 'hang':
+        time.sleep(float(slow.param))
       state, metrics = train_step(run.state, batch_device)
       run.state = state
       steps_done += 1
@@ -724,6 +888,7 @@ def train(config: Config, max_steps: Optional[int] = None,
         # republish of the same step's snapshot is a counted no-op.
         published = actor_params(state.params)
         server.update_params(published, version=step_now)
+        last_publish_step = step_now
         if (ingest is not None and
             time.monotonic() - last_remote_publish >=
             config.remote_publish_secs and
@@ -807,6 +972,31 @@ def train(config: Config, max_steps: Optional[int] = None,
                       snap['latency_p99_ms'], step_now)
         writer.scalar('inference_publishes_skipped',
                       snap['publishes_skipped'], step_now)
+        # Admission/overload counters (round 9): sheds are the serving
+        # plane's load-shedding response; admission_waits says how
+        # often acquires parked; quarantined slots are respawn's
+        # give-up tally. All bounded-degradation signals — alert on
+        # slope, not presence.
+        writer.scalar('inference_sheds', snap.get('sheds', 0),
+                      step_now)
+        writer.scalar('inference_admission_waits',
+                      snap.get('admission_waits', 0), step_now)
+        writer.scalar('inference_arena_grows',
+                      snap.get('arena_grows', 0), step_now)
+        quarantined_slots = fleet_stats.get('slots_quarantined', 0)
+        writer.scalar('slots_quarantined', quarantined_slots, step_now)
+        if quarantined_slots > last_quarantined_slots:
+          incidents.event('actor_slots_quarantined', step=step_now,
+                          count=quarantined_slots)
+          last_quarantined_slots = quarantined_slots
+        # Buffer occupancy guard: high_water at capacity + put_waits
+        # growing = producers throttled by backpressure (the bound
+        # holding), not a failure.
+        buf_stats = buffer.stats()
+        writer.scalar('buffer_high_water', buf_stats['high_water'],
+                      step_now)
+        writer.scalar('buffer_put_waits', buf_stats['put_waits'],
+                      step_now)
         # Per-interval action distribution (cumulative would hide a
         # late policy collapse).
         writer.histogram('actions', action_counts_acc, step_now)
@@ -846,6 +1036,11 @@ def train(config: Config, max_steps: Optional[int] = None,
           # decides severity), so without this counter a host whose
           # every unroll is being refused is invisible here.
           writer.scalar('remote_rejected', ing['rejected'], step_now)
+          # Staleness-window refusals (round 9): benign per unroll
+          # (the client refetches), but a steadily climbing count
+          # means some host can't keep its params fresh.
+          writer.scalar('remote_stale_rejected',
+                        ing.get('stale_rejected', 0), step_now)
           # Connections dropped for unparseable/garbage frames — the
           # wire-level quarantine (a corrupting peer must not be able
           # to take the learner down, only itself).
@@ -898,6 +1093,69 @@ def train(config: Config, max_steps: Optional[int] = None,
             jnp.asarray(checkpointer.should_save()))) and healthy_now
         checkpointer.maybe_save(state, decision=decision)
       fleet.check_health(stall_timeout_secs=stall_timeout_secs)
+    if draining:
+      # --- Drain finalize: quiesce → flush already happened in the
+      # loop; now join the fleet (bounded), close the prefetcher
+      # (pushes any partial batch's unrolls back into the buffer),
+      # take a VERIFIED checkpoint through the integrity ladder, and
+      # write the resume manifest. ---
+      remaining = max(1.0, drain_deadline - time.monotonic())
+      quiesce_report = (fleet.quiesce(timeout=remaining)
+                        if hasattr(fleet, 'quiesce')
+                        else {'unjoined_actors': []})
+      prefetcher.close()
+      step_final = _initial_steps + steps_done
+      buf_stats = buffer.stats()
+      # Withhold the drain save mid-bad-burst, exactly like the
+      # periodic and final saves: checkpointing diverged params would
+      # advance LAST_GOOD onto the poison. The manifest then names
+      # the retained last-good step as the resume point.
+      healthy_now = health is None or bad_count_in_burst == 0
+      if healthy_now:
+        checkpointer.save(run.state, force=True)
+      else:
+        log.warning('drain checkpoint withheld: training was '
+                    'unhealthy at preemption (the retained last-'
+                    'known-good step covers the resume)')
+      ckpt_step = checkpointer.last_good_step()
+      drain_latency = time.monotonic() - drain_t0
+      manifest = {
+          'update_steps': step_final,
+          'frames': step_final * config.frames_per_step,
+          'params_version_step': last_publish_step,
+          'params_publishes': server.stats()['params_version'],
+          'checkpoint_step': ckpt_step,
+          'checkpoint_verified': ckpt_step == step_final,
+          'buffer': {
+              'leftover_unrolls': buf_stats['occupancy'],
+              'high_water': buf_stats['high_water'],
+              'capacity': buf_stats['capacity'],
+          },
+          'unjoined_actors': quiesce_report['unjoined_actors'],
+          # Health at preemption: consecutive_bad > 0 here explains a
+          # withheld (unverified) drain checkpoint to the resume/
+          # postmortem without a summaries.jsonl dig.
+          'health': (health.drain_report()
+                     if health is not None else None),
+          'drain_source': drain_source,
+          'drain_latency_secs': round(drain_latency, 3),
+          'wall_time': round(time.time(), 3),
+      }
+      if process_index == 0:
+        path = _write_resume_manifest(config.logdir, manifest)
+        log.warning(
+            'preemption drain complete in %.2fs: checkpoint step %s '
+            '(verified=%s), %d unroll(s) left in the buffer, '
+            'manifest %s', drain_latency, ckpt_step,
+            manifest['checkpoint_verified'],
+            buf_stats['occupancy'], path)
+      incidents.event('preempt_drain_complete', step=step_final,
+                      drain_latency_secs=round(drain_latency, 3),
+                      checkpoint_step=ckpt_step,
+                      leftover_unrolls=buf_stats['occupancy'],
+                      unjoined_actors=quiesce_report['unjoined_actors'])
+      writer.scalar('drain_latency_secs', round(drain_latency, 3),
+                    step_final)
   finally:
     exiting_clean = sys.exc_info()[0] is None
     # One robustness roll-up while the fleet still runs (stats after
@@ -1077,12 +1335,19 @@ def evaluate(config: Config,
       # test_levels[start + i] and stamps that id on its unrolls);
       # seed_base offsets by start so env streams stay disjoint
       # across processes.
-      fleet = make_fleet(config, agent, server.policy, buffer,
-                         test_levels,
-                         seed_base=config.seed - 1 + start,
-                         level_offset=start, is_test=True,
-                         num_actors=my_count,
-                         initial_state_fn=server.initial_core_state)
+      # Eval acquisitions carry the EVAL admission class: on a shared
+      # or constrained state arena, eval churn parks behind live
+      # traffic instead of starving it (the fleet's priority kwarg is
+      # accepted and overridden — every eval acquire is eval-class).
+      fleet = make_fleet(
+          config, agent, server.policy, buffer,
+          test_levels,
+          seed_base=config.seed - 1 + start,
+          level_offset=start, is_test=True,
+          num_actors=my_count,
+          initial_state_fn=lambda priority=None:
+              server.initial_core_state(
+                  priority=inference_lib.PRIORITY_EVAL))
     except BaseException:
       if server is not None:
         server.close()

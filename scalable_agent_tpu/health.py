@@ -256,7 +256,20 @@ class HealthMonitor:
     return {'skipped_steps': self.skipped_steps,
             'flagged_steps': self.flagged_steps,
             'rollbacks': self.rollbacks,
+            'halts': self.halts,
             'consecutive_bad': self._consecutive_bad}
+
+  def drain_report(self) -> Dict:
+    """Training-health state at preemption, for the drain's
+    resume_manifest.json: the counters plus WHY the last bad step was
+    bad. A resume that finds `consecutive_bad > 0` here knows the
+    drain checkpoint was withheld mid-burst (driver.train's drain
+    finalize) and that the retained last-good step is the real resume
+    point — the postmortem reads the reason from the manifest instead
+    of re-deriving it from summaries.jsonl."""
+    report = dict(self.stats())
+    report['last_reason'] = self.last_reason
+    return report
 
   # --- diagnostics ---
 

@@ -181,6 +181,12 @@ class LatencyReservoir:
     last = len(snap) - 1
     return tuple(snap[min(last, int(round(q * last)))] for q in qs)
 
+  def percentile_ms(self, *qs: float) -> Tuple[float, ...]:
+    """`percentiles`, in rounded milliseconds — the stats()-surface
+    form every reservoir consumer (ingest ack, inference admission
+    wait) was hand-rolling with its own `round(x * 1e3, 3)`."""
+    return tuple(round(v * 1e3, 3) for v in self.percentiles(*qs))
+
 
 def stack_metrics(metrics: Dict) -> Tuple[Tuple[str, ...], object]:
   """Stack a step's scalar metrics into ONE device array.

@@ -31,6 +31,21 @@ purpose:
                       NaN for the step — the loss/grads go non-finite
                       and the learner's device-side guard + watchdog
                       ladder must skip/roll back.
+    slot_exhaustion   InferenceServer._acquire_slot: the acquire is
+                      forced down the contended admission path (parked
+                      waitlist) even when slots are free — the
+                      block/shed/grow degrade machinery must execute,
+                      never the old raise-on-exhaustion.
+    preempt_signal    driver.train loop (one event per learner step):
+                      a fired fault requests the preemption drain —
+                      SIGTERM made deterministic for the chaos SLOs
+                      (quiesce → flush → verified checkpoint →
+                      resume_manifest.json).
+    slow_learner      driver.train loop: 'hang' sleeps `param` seconds
+                      in the step path, so the trajectory buffer fills
+                      and producer-side backpressure (actor put
+                      blocking, ingest ack delay, staleness growth)
+                      must engage instead of unbounded queueing.
 
 The plan is installed process-globally (`install`/`clear`); sites are
 consulted via `fire(site)` which is a no-op returning None when no
@@ -54,7 +69,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-SITES = ('env_step', 'transport_send', 'checkpoint_save', 'nan_burst')
+SITES = ('env_step', 'transport_send', 'checkpoint_save', 'nan_burst',
+         'slot_exhaustion', 'preempt_signal', 'slow_learner')
 
 _LEN = struct.Struct('>Q')
 
@@ -149,7 +165,13 @@ class FaultPlan:
             transport_stride: int = 4,
             nan_burst_at: Optional[int] = None,
             nan_burst_len: int = 0,
-            checkpoint_interrupt_at: Optional[int] = None
+            checkpoint_interrupt_at: Optional[int] = None,
+            slot_exhaustion_at: Optional[int] = None,
+            slot_exhaustion_len: int = 0,
+            preempt_at: Optional[int] = None,
+            slow_learner_at: Optional[int] = None,
+            slow_learner_len: int = 0,
+            slow_learner_secs: float = 0.5
             ) -> 'FaultPlan':
     """The scripted multi-fault storm chaos.py runs: one builder so
     the schedule is a pure function of its arguments (+ seed, which
@@ -168,6 +190,14 @@ class FaultPlan:
     if checkpoint_interrupt_at is not None:
       faults.append(Fault('checkpoint_save', checkpoint_interrupt_at,
                           'interrupt'))
+    for i in range(slot_exhaustion_len):
+      faults.append(Fault('slot_exhaustion',
+                          (slot_exhaustion_at or 0) + i, 'force'))
+    if preempt_at is not None:
+      faults.append(Fault('preempt_signal', preempt_at, 'drain'))
+    for i in range(slow_learner_len):
+      faults.append(Fault('slow_learner', (slow_learner_at or 0) + i,
+                          'hang', param=slow_learner_secs))
     return cls(faults, seed=seed)
 
 

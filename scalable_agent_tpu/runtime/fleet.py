@@ -8,19 +8,41 @@ fleet explicit and adds what upstream lacks:
 - per-actor heartbeats (last unroll completion time),
 - dead/stalled-actor detection,
 - respawn of the env (process) + actor thread without disturbing the
-  rest of the fleet or the learner.
+  rest of the fleet or the learner,
+- capped-exponential respawn backoff with full jitter PER SLOT and a
+  give-up-after-N quarantine (round 9): a persistently failing env —
+  or a respawn starved by inference-slot admission under overload —
+  used to hot-loop respawn attempts through every health check;
+  now each failed generation pushes the slot's next attempt out on
+  its own jittered backoff, and after `quarantine_after` consecutive
+  respawns without ONE completed unroll the slot is quarantined
+  (marked dead, surfaced as `slots_quarantined` in stats()/driver
+  summaries) instead of burning the learner loop forever.
 
 Trajectories from a respawned actor restart from a fresh episode —
 consistent with the reference's crash story (unrolls straddling a
 restart are lost, SURVEY §5.4).
 """
 
+import logging
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from scalable_agent_tpu.runtime import ring_buffer
 from scalable_agent_tpu.runtime.actor import Actor
+from scalable_agent_tpu.runtime.remote import Backoff
+
+log = logging.getLogger('scalable_agent_tpu')
+
+
+def _is_admission_error(e: BaseException) -> bool:
+  """Whether a spawn failure is inference-slot admission (overload —
+  degrade to pause-and-retry) rather than a setup error (raise).
+  Lazy import: the fleet must not pull jax at module import."""
+  from scalable_agent_tpu.runtime.inference import (InferenceClosed,
+                                                    SlotUnavailable)
+  return isinstance(e, (SlotUnavailable, InferenceClosed))
 
 
 class _Slot:
@@ -37,6 +59,14 @@ class _Slot:
     self.unrolls_done: int = 0
     self.respawns: int = 0
     self.error: Optional[BaseException] = None
+    # Respawn pacing (round 9): consecutive respawns since the last
+    # COMPLETED unroll (a spawn that crash-loops before producing is
+    # still a failure), the per-slot jittered backoff, the earliest
+    # next respawn attempt, and the give-up flag.
+    self.respawn_streak: int = 0
+    self.backoff = Backoff(base=0.5, cap=30.0)
+    self.next_respawn_time: float = 0.0
+    self.quarantined: bool = False
 
 
 class ActorFleet:
@@ -47,11 +77,15 @@ class ActorFleet:
       start and again on every respawn; must build a FRESH env.
     buffer: the shared TrajectoryBuffer.
     num_actors: fleet size.
+    quarantine_after: consecutive respawns without one completed
+      unroll before the slot gives up and quarantines (0 = never).
   """
 
-  def __init__(self, make_actor: Callable, buffer, num_actors: int):
+  def __init__(self, make_actor: Callable, buffer, num_actors: int,
+               quarantine_after: int = 5):
     self._make_actor = make_actor
     self._buffer = buffer
+    self._quarantine_after = int(quarantine_after)
     self._stop = threading.Event()
     self._lock = threading.Lock()
     self._slots: List[_Slot] = [_Slot(i) for i in range(num_actors)]
@@ -62,7 +96,25 @@ class ActorFleet:
 
   def start(self):
     for slot in self._slots:
-      self._spawn(slot)
+      try:
+        self._spawn(slot)
+      except Exception as e:
+        # Overload degrade (round 9): a start-time acquire denied by
+        # inference-slot admission is NOT a setup error — record it on
+        # the slot and let the health loop retry on the slot's backoff
+        # instead of crashing the run before it begins. Anything else
+        # (env construction, bad config) still raises to the caller.
+        if not _is_admission_error(e):
+          raise
+        with self._lock:
+          slot.error = e
+          slot.thread = None
+          slot.respawn_streak += 1
+          slot.next_respawn_time = (time.monotonic()
+                                    + slot.backoff.next_delay())
+        log.warning(
+            'actor %d: start-time slot admission denied (%s) — '
+            'degrading to pause-and-retry', slot.index, e)
 
   def _spawn(self, slot: _Slot):
     env, process, actor = self._make_actor(slot.index)
@@ -97,6 +149,11 @@ class ActorFleet:
           return False  # orphaned: a replacement owns the slot now
         slot.last_heartbeat = time.monotonic()
         slot.unrolls_done += 1
+        # A completed unroll is the success signal that resets the
+        # respawn ladder: streak, backoff, and pacing all clear.
+        slot.respawn_streak = 0
+        slot.backoff.reset()
+        slot.next_respawn_time = 0.0
         return True
 
     def on_failure(exc):
@@ -125,11 +182,17 @@ class ActorFleet:
     bad: List[_Slot] = []
     with self._lock:
       for slot in self._slots:
+        if slot.quarantined:
+          continue  # gave up on this slot; stats() carries the count
         dead = slot.error is not None or (
             slot.thread is not None and not slot.thread.is_alive())
         stalled = (stall_timeout_secs is not None and
                    now - slot.last_heartbeat > stall_timeout_secs)
-        if dead or stalled:
+        # Respawn pacing: a failing slot is retried only once its
+        # jittered backoff elapses — a crash-looping env (or an
+        # admission-denied respawn under overload) must not hot-loop
+        # the learner thread through every health check.
+        if (dead or stalled) and now >= slot.next_respawn_time:
           bad.append(slot)
     for slot in bad:
       if respawn:
@@ -165,23 +228,54 @@ class ActorFleet:
         pass
     with self._lock:
       slot.respawns += 1
+      slot.respawn_streak += 1
+      # Pace the NEXT attempt now, so a spawn that fails (or succeeds
+      # and immediately crash-loops) waits out the jittered backoff
+      # before the health loop touches the slot again.
+      slot.next_respawn_time = (time.monotonic()
+                                + slot.backoff.next_delay())
+      give_up = (self._quarantine_after > 0 and
+                 slot.respawn_streak > self._quarantine_after)
+      if give_up:
+        slot.quarantined = True
+        slot.thread = None
+    if give_up:
+      log.error(
+          'actor %d QUARANTINED after %d consecutive respawns without '
+          'a completed unroll (last error: %s) — the slot is marked '
+          'dead; the rest of the fleet keeps feeding', slot.index,
+          slot.respawn_streak, slot.error)
+      return
     try:
       self._spawn(slot)
     except Exception as e:
-      # A failed respawn (env construction, exhausted inference state
-      # arena) must not propagate into the learner loop that called
-      # check_health — start()-time spawn failures still raise (setup
-      # errors belong to the caller), but a mid-run respawn records
-      # the error on the slot: the next health check retries, and the
-      # learner surfaces it via errors() only if the pipeline actually
-      # stalls (the same containment as any other actor-side failure).
+      # A failed respawn (env construction, denied inference-slot
+      # admission) must not propagate into the learner loop that
+      # called check_health — start()-time spawn failures still raise
+      # for setup errors (admission denials degrade; see start()), but
+      # a mid-run respawn records the error on the slot: the next
+      # health check retries after the slot's backoff, and the learner
+      # surfaces it via errors() only if the pipeline actually stalls
+      # (the same containment as any other actor-side failure).
       with self._lock:
         slot.error = e
         slot.thread = None
 
   def errors(self) -> List[BaseException]:
+    """Errors the learner should act on NOW. A quarantined slot's
+    error is a closed incident (logged, counted in stats() — the
+    give-up already happened), not the cause of whatever stalls the
+    pipeline hours later — surfacing it would misdiagnose the new
+    incident. Exception: when EVERY slot is quarantined the fleet is
+    dead and those errors ARE the cause, so they come back."""
     with self._lock:
-      return [s.error for s in self._slots if s.error is not None]
+      live = [s.error for s in self._slots
+              if s.error is not None and not s.quarantined]
+      if live:
+        return live
+      if self._slots and all(s.quarantined for s in self._slots):
+        return [s.error for s in self._slots if s.error is not None]
+      return []
 
   def stats(self, healthy_horizon_secs: float = 60.0):
     """Fleet health counters.
@@ -201,7 +295,7 @@ class ActorFleet:
       alive = [s for s in self._slots
                if s.thread is not None and s.thread.is_alive()]
       healthy = [s for s in alive
-                 if s.error is None and
+                 if s.error is None and not s.quarantined and
                  now - s.last_heartbeat <= healthy_horizon_secs]
       return {
           'unrolls': sum(s.unrolls_done for s in self._slots),
@@ -210,12 +304,51 @@ class ActorFleet:
           'healthy': len(healthy),
           'healthy_fraction': (len(healthy) / len(self._slots)
                                if self._slots else 1.0),
+          # Give-up slots (round 9): respawn exhausted its budget —
+          # the honest 'this much of my fleet is permanently gone'
+          # number the driver surfaces as `slots_quarantined`.
+          'slots_quarantined': sum(1 for s in self._slots
+                                   if s.quarantined),
       }
 
-  def stop(self, timeout: float = 10.0):
-    self._stop.set()
-    self._buffer.close()
+  def _join_all(self, timeout: float, what: str,
+                consequence: str) -> Dict[str, List[int]]:
+    """Deadline-join every actor thread; actors that miss it are
+    NAMED in the log and the returned report instead of dropped
+    silently (round 9 — the shared tail of stop() and quiesce())."""
     deadline = time.monotonic() + timeout
+    unjoined: List[int] = []
     for slot in self._slots:
       if slot.thread is not None:
         slot.thread.join(max(0.0, deadline - time.monotonic()))
+        if slot.thread.is_alive():
+          unjoined.append(slot.index)
+    if unjoined:
+      log.warning('fleet %s: actors %s did not stop within %.1fs '
+                  '(%s)', what, unjoined, timeout, consequence)
+    return {'unjoined_actors': unjoined}
+
+  def quiesce(self, timeout: float = 10.0) -> Dict[str, List[int]]:
+    """Stop production WITHOUT closing the buffer (the preemption-
+    drain path): the stop event ends each actor's loop after its
+    current unroll, and the in-flight unrolls land in the trajectory
+    buffer for the learner to flush. Joins actor threads up to
+    `timeout`; returns {'unjoined_actors': [...]} — the slots whose
+    unrolls are lost to the drain (a wedged env can't be joined; its
+    unroll follows the reference's crash semantics)."""
+    self._stop.set()
+    return self._join_all(timeout, 'quiesce',
+                          'their in-flight unrolls are lost')
+
+  def stop(self, timeout: float = 10.0) -> Dict[str, List[int]]:
+    """Stop the fleet and close the buffer. Returns the same report as
+    `quiesce`. After stop() returns the buffer is CLOSED: any
+    straggler thread's `put` raises `ring_buffer.Closed` instead of
+    landing a stale unroll (regression-tested; the in-RUN orphan
+    window documented in `_respawn` is unchanged). The buffer closes
+    BEFORE the join: an actor blocked in a full buffer's put must be
+    woken (Closed) or it could never exit."""
+    self._stop.set()
+    self._buffer.close()
+    return self._join_all(timeout, 'stop',
+                          'orphaned as daemon threads')

@@ -49,6 +49,30 @@ Weights: the server holds a params snapshot updated via
 `update_params` (the reference's gRPC weight fetch becomes an on-host
 pointer swap; the same "actions within one unroll may span weight
 versions" caveat applies — reference ≈L472 comment).
+
+Round 9 (actor-plane overload hardening, docs/ROBUSTNESS.md): slot
+ADMISSION CONTROL replaces raise-on-exhaustion. `_acquire_slot` parks
+callers on a priority-ordered bounded waitlist instead of raising
+`RuntimeError('state arena exhausted')` — exhaustion now DEGRADES per
+`config.inference_admission`:
+
+  block  (default) wait (deadline-bounded, capped-jitter re-check via
+         runtime.remote.Backoff) for a released slot; raise
+         `SlotUnavailable` only at the deadline.
+  shed   same parked wait, but the deadline REJECTION is the intended
+         steady-state response to overload: counted in
+         stats()['sheds'] and the driver's `inference_sheds` summary —
+         the serving-plane load-shedding seam (TorchBeast's decoupled
+         actor/server split, arXiv:1910.03552).
+  grow   never park: the arena doubles in place (one recompile per
+         growth, counted in stats()['arena_grows']).
+
+Waiters carry a PRIORITY class (PRIORITY_LIVE < PRIORITY_RESPAWN <
+PRIORITY_EVAL): releases hand the freed slot to the best-priority
+waiter directly, so eval/respawn churn can never starve live actor
+traffic. `close()` answers every parked waiter with `InferenceClosed`
+(never leaves them blocked forever) and counts worker threads that
+missed their join deadline (stats()['unjoined_threads']).
 """
 
 import collections
@@ -62,10 +86,55 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from scalable_agent_tpu.observability import LatencyReservoir
 from scalable_agent_tpu.ops import dynamic_batching
+from scalable_agent_tpu.runtime import faults as faults_lib
+from scalable_agent_tpu.runtime.remote import Backoff
 from scalable_agent_tpu.structs import AgentOutput, StepOutput
 
 log = logging.getLogger('scalable_agent_tpu')
+
+# Admission priority classes (lower = served first): a released slot
+# is handed to the best-priority parked waiter, so background churn
+# (respawns, eval fleets sharing a server) cannot starve live actors.
+PRIORITY_LIVE = 0
+PRIORITY_RESPAWN = 1
+PRIORITY_EVAL = 2
+
+ADMISSION_POLICIES = ('block', 'shed', 'grow')
+
+# Padded merge rows scatter/gather with this slot id: ALWAYS out of
+# range (gather clamps, scatter mode='drop' discards), and — unlike
+# the old `num_slots` stamp — still out of range after a 'grow'
+# admission doubles the arena between staging and dispatch.
+_PAD_SLOT_ID = np.int32(1 << 30)
+
+
+class SlotUnavailable(RuntimeError):
+  """No state-arena slot could be admitted before the deadline (shed
+  policy: the intended overload response; block policy: the bounded-
+  wait backstop). Fleet respawn treats this as pause-and-retry, never
+  as a learner-loop crash."""
+
+
+class InferenceClosed(RuntimeError):
+  """The server closed while the caller was parked on the admission
+  waitlist — a clean shutdown answer, not an overload signal."""
+
+
+class _Waiter:
+  """One parked `_acquire_slot` caller: priority + FIFO tiebreak, an
+  event the release path sets on direct slot handoff, and the closed
+  flag `close()` answers parked callers with."""
+
+  __slots__ = ('priority', 'seq', 'event', 'slot', 'closed')
+
+  def __init__(self, priority, seq):
+    self.priority = priority
+    self.seq = seq
+    self.event = threading.Event()
+    self.slot = None
+    self.closed = False
 
 
 def _next_power_of_two(n):
@@ -108,9 +177,16 @@ class _SlotHandle:
     self.released = False
 
   def snapshot(self):
+    if self.released:
+      # A released slot may already be serving its next owner (the
+      # waitlist hands freed slots over directly): a straggler thread
+      # must fail here, not read someone else's carry.
+      raise RuntimeError('snapshot() on a released state slot')
     return self._server._read_slot(self.slot)
 
   def write(self, carry):
+    if self.released:
+      raise RuntimeError('write() on a released state slot')
     self._server._write_slot(self.slot, carry)
 
   def release(self):
@@ -171,6 +247,14 @@ class InferenceServer:
     self._mesh = mesh
     self._state_cache = bool(config.inference_state_cache)
     self._depth = max(1, int(config.inference_pipeline_depth))
+    # --- Slot admission policy (overload hardening; module docstring).
+    self._admission = getattr(config, 'inference_admission', 'block')
+    if self._admission not in ADMISSION_POLICIES:
+      raise ValueError(
+          f'unknown inference_admission {self._admission!r} '
+          f'(policies: {ADMISSION_POLICIES})')
+    self._admission_timeout = float(
+        getattr(config, 'inference_admission_timeout_secs', 10.0))
     if mesh is not None:
       from jax.sharding import NamedSharding, PartitionSpec
       from scalable_agent_tpu.parallel import mesh as mesh_lib
@@ -194,6 +278,14 @@ class InferenceServer:
     self._devices_last_call = 0
     self._inflight = 0
     self._inflight_peak = 0
+    # Admission counters (stats(); the driver's summary surface).
+    self._acquires = 0
+    self._admission_waits = 0      # acquires that had to park
+    self._sheds = 0                # shed policy: deadline rejections
+    self._admission_timeouts = 0   # block policy: deadline rejections
+    self._arena_grows = 0
+    self._unjoined_threads = 0
+    self._admission_wait_reservoir = LatencyReservoir(maxlen=1024)
     # Per-merged-call latency ring (assembly start → callers unparked)
     # for the stats() p50/p99 — bounded so a week-long run's stats
     # reflect RECENT service time, not the cumulative history.
@@ -209,8 +301,13 @@ class InferenceServer:
     self._max_batch = config.inference_max_batch
 
     # --- Device-resident state arena (state-cache mode). ---
+    # Lock order where nested: _slot_lock -> _arena_lock (the grow
+    # path swaps the arena while holding the free list); _key_lock ->
+    # _arena_lock (dispatch). Nothing takes _slot_lock after either.
     self._arena_lock = threading.Lock()
     self._slot_lock = threading.Lock()
+    self._waiters = []          # parked _acquire_slot callers
+    self._waiter_seq = 0
     if self._state_cache:
       num_slots = int(config.inference_state_slots)
       if num_slots <= 0:
@@ -255,10 +352,11 @@ class InferenceServer:
     def cache_step(params, key, arena_c, arena_h, slot_ids,
                    prev_action, reward, done, frame, instr):
       key, sub = jax.random.split(key)
-      # Gather each row's carry by slot id. Padded rows carry id ==
-      # num_slots (out of range): the gather clamps (their compute is
-      # sliced away) and the scatter DROPS them — mode='drop' is what
-      # keeps a padded row from ever corrupting a live slot.
+      # Gather each row's carry by slot id. Padded rows carry
+      # _PAD_SLOT_ID (out of range for any arena size, grown or not):
+      # the gather clamps (their compute is sliced away) and the
+      # scatter DROPS them — mode='drop' is what keeps a padded row
+      # from ever corrupting a live slot.
       core_c = arena_c[slot_ids]
       core_h = arena_h[slot_ids]
       action, logits, baseline, new_c, new_h = _apply(
@@ -318,32 +416,138 @@ class InferenceServer:
 
   # -- state arena (state-cache mode) --
 
-  def initial_core_state(self):
+  def initial_core_state(self, priority=PRIORITY_LIVE):
     """Per-actor policy-state factory (driver.make_fleet's
     initial_state_fn): zeroed host carry in carry-passing mode, a
     freshly acquired (zeroed) arena slot in state-cache mode. Called
     at actor (re)spawn — a respawned actor starts from a clean slot
-    either way."""
+    either way. `priority` is the admission class of the acquire
+    (PRIORITY_LIVE / PRIORITY_RESPAWN / PRIORITY_EVAL — released
+    slots go to the best-priority parked waiter first)."""
     if not self._state_cache:
       return tuple(np.zeros((1, s), np.float32)
                    for s in self._core_sizes)
-    return self._acquire_slot()
+    return self._acquire_slot(priority=priority)
 
-  def _acquire_slot(self):
+  def _acquire_slot(self, priority=PRIORITY_LIVE):
+    """Admit one slot acquisition under the configured policy (module
+    docstring): fast-path pop when slots are free and nobody is parked
+    ahead of us, else grow (grow policy) or park on the priority
+    waitlist (block/shed) with a deadline. Raises SlotUnavailable at
+    the deadline, InferenceClosed when the server shuts down — never
+    the old bare 'state arena exhausted' RuntimeError."""
+    # Fault site 'slot_exhaustion' (runtime/faults.py): a fired fault
+    # forces this acquire down the contended path even when slots are
+    # free — the parked waiter re-checks the real free list on its
+    # next backoff tick, so the forced detour is bounded and the
+    # waitlist machinery executes under test.
+    forced = faults_lib.fire('slot_exhaustion') is not None
+    waiter = None
     with self._slot_lock:
-      if not self._free:
-        raise RuntimeError(
-            f'state arena exhausted ({self._num_slots} slots): more '
-            'live actors than slots — raise '
-            '--inference_state_slots (wedged-then-respawned actors '
-            'hold their old slot until the orphaned thread unwinds)')
-      slot = self._free.pop()
+      if self._closed:
+        raise InferenceClosed('inference server is closed')
+      with self._stats_lock:
+        self._acquires += 1
+      if not forced and self._free and not self._waiters:
+        slot = self._free.pop()
+      elif self._admission == 'grow':
+        if forced or not self._free:
+          self._grow_arena_locked()
+        slot = self._free.pop()
+      else:
+        self._waiter_seq += 1
+        waiter = _Waiter(priority, self._waiter_seq)
+        self._waiters.append(waiter)
+        with self._stats_lock:
+          self._admission_waits += 1
+    if waiter is not None:
+      slot = self._wait_for_slot(waiter)
     self._zero_slot(slot)
     return _SlotHandle(self, slot)
 
+  def _best_waiter(self):
+    """Called with _slot_lock held; waitlists are fleet-sized."""
+    return min(self._waiters, key=lambda w: (w.priority, w.seq))
+
+  def _wait_for_slot(self, waiter):
+    """Park until a released slot is handed over, the server closes,
+    or the admission deadline passes. The event wait is capped-jitter
+    (runtime.remote.Backoff) so a missed wake — or a fault-forced park
+    with slots actually free — re-checks the free list instead of
+    blocking until the deadline."""
+    t0 = time.monotonic()
+    deadline = t0 + self._admission_timeout
+    backoff = Backoff(base=0.02, cap=0.5)
+    while True:
+      remaining = deadline - time.monotonic()
+      if remaining > 0:
+        waiter.event.wait(timeout=min(backoff.next_delay() + 1e-3,
+                                      remaining))
+      with self._slot_lock:
+        if waiter.slot is not None:
+          slot = waiter.slot  # direct handoff from _release_slot
+          break
+        if waiter.closed or self._closed:
+          if waiter in self._waiters:
+            self._waiters.remove(waiter)
+          raise InferenceClosed(
+              'inference server closed while waiting for a state slot')
+        if self._free and self._best_waiter() is waiter:
+          self._waiters.remove(waiter)
+          slot = self._free.pop()
+          break
+        if time.monotonic() >= deadline:
+          self._waiters.remove(waiter)
+          shed = self._admission == 'shed'
+          with self._stats_lock:
+            if shed:
+              self._sheds += 1
+            else:
+              self._admission_timeouts += 1
+          raise SlotUnavailable(
+              f'{"shed" if shed else "admission timeout"}: no state-'
+              f'arena slot free within {self._admission_timeout:.1f}s '
+              f'({self._num_slots} slots, {len(self._waiters)} other '
+              'waiter(s)) — overload; raise --inference_state_slots, '
+              'or pick --inference_admission=grow')
+    self._admission_wait_reservoir.record(time.monotonic() - t0)
+    return slot
+
+  def _grow_arena_locked(self):
+    """Double the state arena in place (grow admission; called with
+    _slot_lock held). Existing slot ids and carries are preserved; the
+    new rows are zeroed and appended to the free list. One XLA
+    recompile per growth (new arena shape) — rare by construction."""
+    old = self._num_slots
+    new = 2 * old if old else 8
+    with self._arena_lock:
+      arena = tuple(
+          jnp.zeros((new, s), jnp.float32).at[:old].set(a)
+          for a, s in zip(self._arena, self._core_sizes))
+      if self._mesh is not None:
+        arena = jax.device_put(arena, self._replicated)
+      self._arena = arena
+      self._num_slots = new
+    self._free.extend(range(old, new))
+    with self._stats_lock:
+      self._arena_grows += 1
+    log.warning(
+        'inference state arena grown %d -> %d slots '
+        '(--inference_admission=grow; one recompile per growth)',
+        old, new)
+
   def _release_slot(self, slot):
     with self._slot_lock:
-      self._free.append(slot)
+      if self._waiters:
+        # Direct handoff to the best-priority waiter: the slot never
+        # touches the free list, so a lower-priority waiter (or a
+        # fresh fast-path acquire) cannot steal it.
+        w = self._best_waiter()
+        self._waiters.remove(w)
+        w.slot = slot
+        w.event.set()
+      else:
+        self._free.append(slot)
 
   def _zero_slot(self, slot):
     with self._arena_lock:
@@ -431,8 +635,10 @@ class InferenceServer:
         if self._state_cache:
           # The staging ring reuses buffers: rows [n:] may hold slot
           # ids from an earlier (larger) merge — point them out of
-          # range so the in-graph scatter drops them.
-          bufs[0][n:] = self._num_slots
+          # range so the in-graph scatter drops them. The sentinel is
+          # a constant (not num_slots): a concurrent 'grow' admission
+          # must not turn a just-stamped pad id into a live slot.
+          bufs[0][n:] = _PAD_SLOT_ID
         with self._stats_lock:
           self._calls += 1
           self._merged_requests += n
@@ -599,7 +805,7 @@ class InferenceServer:
         # Warmup must not touch live carries: out-of-range slot ids
         # make every scatter a drop (same compiled program — shapes
         # and dtypes are what XLA specializes on, not values).
-        ids = np.full((padded,), self._num_slots, np.int32)
+        ids = np.full((padded,), _PAD_SLOT_ID, np.int32)
         inputs = (ids,) + inputs
       else:
         inputs = inputs + tuple(
@@ -632,6 +838,15 @@ class InferenceServer:
       skipped = self._publishes_skipped
       peak = self._inflight_peak
       recoveries = self._chain_recoveries
+      acquires = self._acquires
+      admission_waits = self._admission_waits
+      sheds = self._sheds
+      admission_timeouts = self._admission_timeouts
+      arena_grows = self._arena_grows
+      unjoined = self._unjoined_threads
+    with self._slot_lock:
+      waitlist_depth = len(self._waiters)
+    (wait_p99_ms,) = self._admission_wait_reservoir.percentile_ms(0.99)
     p50 = percentile_ms(lat, 0.5)
     p99 = percentile_ms(lat, 0.99)
     return {
@@ -648,6 +863,17 @@ class InferenceServer:
         'inflight_peak': peak,
         'chain_recoveries': recoveries,
         'slots_free': self.slots_free() if self._state_cache else None,
+        # Admission/overload telemetry (round 9): the shed fraction is
+        # sheds / acquires — the serving-plane overload SLO number.
+        'admission': self._admission,
+        'acquires': acquires,
+        'admission_waits': admission_waits,
+        'sheds': sheds,
+        'admission_timeouts': admission_timeouts,
+        'admission_wait_p99_ms': wait_p99_ms,
+        'arena_grows': arena_grows,
+        'waitlist_depth': waitlist_depth,
+        'unjoined_threads': unjoined,
     }
 
   def update_params(self, params, version=None):
@@ -727,13 +953,34 @@ class InferenceServer:
     return out, (new_c, new_h)
 
   def close(self):
-    if self._closed:
-      return
-    self._closed = True
+    with self._slot_lock:
+      if self._closed:
+        return
+      self._closed = True
+      # Parked admission waiters get a CLEAN InferenceClosed answer —
+      # a caller waiting out an overload must not block forever on a
+      # server that is going away.
+      waiters, self._waiters = self._waiters, []
+      for w in waiters:
+        w.closed = True
+        w.event.set()
     # Close wakes the dispatch thread's get_batch (None) and cancels
     # parked callers; the dispatch thread forwards the sentinel so the
     # completion thread drains in-flight batches first.
     self._batcher.close()
+    unjoined = []
     for t in (self._dispatch_thread, self._completion_thread):
       if t is not None:
         t.join(timeout=10)
+        if t.is_alive():
+          unjoined.append(t.name)
+    if unjoined:
+      # Leaked threads used to vanish silently; a wedged dispatch/
+      # completion thread pins device buffers and a staging ring for
+      # the rest of the process lifetime — say so, and count it.
+      with self._stats_lock:
+        self._unjoined_threads = len(unjoined)
+      log.warning(
+          'InferenceServer.close(): %d thread(s) missed the join '
+          'deadline and leak as daemons: %s', len(unjoined),
+          ', '.join(unjoined))

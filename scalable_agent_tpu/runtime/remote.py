@@ -315,7 +315,22 @@ class Backoff:
 # non-blocking publisher, isolating blob traffic from unroll acks);
 # 'get_params' on the trajectory lane stays answered for the
 # handshake and protocol-level tests.
+# v5 extension (round 9, no version bump — compatible both ways):
+# 'unroll' frames MAY carry a third element, the params version the
+# client currently acts with; servers running a staleness window
+# (--max_unroll_staleness) answer too-stale unrolls with a benign
+# ('stale', current_version) reply instead of an ack. Old servers
+# ignore the extra element; old clients read 'stale' as an ack whose
+# version triggers exactly the refetch the reply intends.
 PROTOCOL_VERSION = 5
+
+# Bound on the reader→worker handoff queue. The request→reply
+# lockstep already implies at most one in-flight unroll per live
+# connection, but that bound is a CLIENT property — a misbehaving
+# peer pipelining unrolls without awaiting acks could otherwise grow
+# the handoff queue without limit. A blocked reader is the correct
+# backpressure: the peer's sendall stalls against the unread socket.
+_INGEST_QUEUE_DEPTH = 256
 
 
 def _is_signature_leaf(x) -> bool:
@@ -554,8 +569,11 @@ class _Conn:
     self.addr = addr
     self.send_lock = threading.Lock()
     # Per-connection ingest ledger (observability: the driver reports
-    # unrolls/sec per connection from deltas of these).
+    # unrolls/sec per connection from deltas of these; stale
+    # rejections are counted per connection so one starved/lagging
+    # host is tellable from a uniformly stale fleet).
     self.unrolls = 0
+    self.stale_rejected = 0
 
   def send(self, obj) -> None:
     with self.send_lock:
@@ -822,20 +840,32 @@ class TrajectoryIngestServer:
     ingest_workers: size of the validate/commit pool that drains the
       reader threads' handoff queue (validation + buffer.put + ack off
       the reader thread). 0 = auto (min(4, cpu count)). The handoff
-      queue needs no bound of its own: clients are request→reply
-      lockstep, so at most one unroll per live connection is ever in
-      flight between a reader and a worker.
+      queue is bounded (`_INGEST_QUEUE_DEPTH`): well-behaved clients
+      are request→reply lockstep (one in-flight unroll per live
+      connection), and a misbehaving pipelined peer blocks its own
+      reader instead of growing server memory.
+    max_unroll_staleness: admit an unroll only when the client's
+      params version is within this many published versions of the
+      current one (0 = no window). Too-stale unrolls get a benign
+      ('stale', current_version) reply — the client drops the unroll
+      and refetches — counted per connection and in
+      stats()['stale_rejected']. Off-policy V-trace tolerates bounded
+      lag; this bounds it at the ADMISSION seam instead of letting a
+      lagging host poison the batch mix (IMPACT's staleness window,
+      arXiv:1912.00167, applied at ingest).
   """
 
   def __init__(self, buffer, params, host: str = '127.0.0.1',
                port: int = 0, contract=None,
                wire_dtype: Optional[str] = None,
-               ingest_workers: int = 0):
+               ingest_workers: int = 0,
+               max_unroll_staleness: int = 0):
     if wire_dtype not in (None, '', 'bfloat16'):
       raise ValueError(f'unsupported wire_dtype {wire_dtype!r}')
     self._wire_bf16 = wire_dtype == 'bfloat16'
     self._buffer = buffer
     self._contract = contract
+    self._max_staleness = int(max_unroll_staleness)
     self._validate = (FastUnrollValidator(contract)
                       if contract is not None else None)
     self._params_lock = threading.Lock()
@@ -850,6 +880,7 @@ class TrajectoryIngestServer:
     self._stats_lock = threading.Lock()
     self._unrolls = 0
     self._rejected = 0
+    self._stale_rejected = 0  # staleness-window admission rejections
     self._quarantined = 0  # connections dropped for unparseable frames
     self._connections = 0
     self._param_subscribers = 0  # cumulative hello_params adoptions
@@ -861,11 +892,13 @@ class TrajectoryIngestServer:
     self._threads: List[threading.Thread] = []
     self._conns: List[_Conn] = []
     self._conns_lock = threading.Lock()
-    # Trajectory-lane handoff: readers push (conn, unroll, t_recv);
-    # the worker pool validates, commits (backpressure lives in the
-    # blocking put) and acks. SimpleQueue put/get are single C calls —
-    # the GIL-atomic handoff that keeps readers off the buffer lock.
-    self._ingest_q: 'queue.SimpleQueue' = queue.SimpleQueue()
+    # Trajectory-lane handoff: readers push (conn, unroll, t_recv,
+    # client_version); the worker pool validates, commits
+    # (backpressure lives in the blocking put) and acks. BOUNDED
+    # (see _INGEST_QUEUE_DEPTH): a reader blocked in put is socket-
+    # level backpressure on its peer, not unbounded server memory.
+    self._ingest_q: 'queue.Queue' = queue.Queue(
+        maxsize=_INGEST_QUEUE_DEPTH)
     if ingest_workers <= 0:
       ingest_workers = max(1, min(4, os.cpu_count() or 1))
     self._workers = [
@@ -937,11 +970,21 @@ class TrajectoryIngestServer:
     with self._conns_lock:
       live = len(self._conns)
       per_conn = {f'{c.addr}': c.unrolls for c in self._conns}
+      per_conn_stale = {f'{c.addr}': c.stale_rejected
+                        for c in self._conns if c.stale_rejected}
     lane = self._param_lane.stats()
-    ack_p50, ack_p99 = self._ack_reservoir.percentiles(0.5, 0.99)
+    ack_p50_ms, ack_p99_ms = self._ack_reservoir.percentile_ms(
+        0.5, 0.99)
     with self._stats_lock:
       return {'unrolls': self._unrolls,
               'rejected': self._rejected,
+              # Staleness-window rejections (round 9): unrolls refused
+              # because the client's params version fell behind the
+              # admission window — benign for the client (it refetches
+              # and keeps its connection), but a host whose EVERY
+              # unroll is stale is starving; the per-conn map names it.
+              'stale_rejected': self._stale_rejected,
+              'per_conn_stale_rejected': per_conn_stale,
               # Connections dropped after an unparseable/garbage frame
               # (protocol error path): the wire-level quarantine — a
               # corrupting peer loses its connection, the server and
@@ -952,8 +995,8 @@ class TrajectoryIngestServer:
               # Per-lane transport counters (round 6): the driver
               # turns these into summary-interval rates/latencies.
               'per_conn_unrolls': per_conn,
-              'ack_p50_ms': ack_p50 * 1e3,
-              'ack_p99_ms': ack_p99 * 1e3,
+              'ack_p50_ms': ack_p50_ms,
+              'ack_p99_ms': ack_p99_ms,
               'param_blobs': lane['blobs'],
               'param_bytes': lane['bytes'],
               'param_subscribers': self._param_subscribers}
@@ -966,8 +1009,22 @@ class TrajectoryIngestServer:
       job = self._ingest_q.get()
       if job is None:
         return
-      conn, unroll, t_recv = job
+      conn, unroll, t_recv, client_version = job
       try:
+        if self._max_staleness and client_version is not None:
+          with self._params_lock:
+            current = self._version
+          if current - int(client_version) > self._max_staleness:
+            # Version-windowed admission: refuse the unroll BEFORE
+            # validation or the buffer put, but keep the connection —
+            # the 'stale' reply carries the current version, so the
+            # client's refetch-on-newer-version path fires and the
+            # next unroll arrives fresh.
+            with self._stats_lock:
+              self._stale_rejected += 1
+            conn.stale_rejected += 1
+            conn.send(('stale', current))
+            continue
         if self._validate is not None:
           problems = self._validate(unroll)
           if problems:
@@ -1086,8 +1143,10 @@ class TrajectoryIngestServer:
           # Reader half of the trajectory lane ends here: validation,
           # the backpressure put and the ack all happen on the worker
           # pool, so this thread is back inside recv for the next
-          # frame immediately.
-          self._ingest_q.put((conn, msg[1], time.monotonic()))
+          # frame immediately. msg[2] (when present) is the client's
+          # params version for the staleness window (v5 extension).
+          self._ingest_q.put((conn, msg[1], time.monotonic(),
+                              msg[2] if len(msg) > 2 else None))
         else:
           conn.send(('error', f'unknown message kind {kind!r}'))
     except ring_buffer.Closed:
@@ -1149,8 +1208,16 @@ class TrajectoryIngestServer:
     # Drain the worker pool (one sentinel per worker) and the param
     # lane before touching the trajectory conns: a worker mid-commit
     # may still send one last ack, which try_send below tolerates.
+    # The handoff queue is bounded now: a full queue must not hang
+    # close() — workers that miss their sentinel still exit with the
+    # closed flag on their next buffer-put poll, or leak as daemons.
     for _ in self._workers:
-      self._ingest_q.put(None)
+      try:
+        self._ingest_q.put(None, timeout=2.0)
+      except queue.Full:
+        log.warning('ingest close: handoff queue full; worker will '
+                    'exit via the closed flag or leak as a daemon')
+        break
     self._param_lane.close()
     with self._conns_lock:
       conns = list(self._conns)
@@ -1197,6 +1264,9 @@ class RemoteActorClient:
     host, port = address.rsplit(':', 1)
     self._addr = (host, int(port))
     self._param_sock: Optional[socket.socket] = None
+    # Unrolls the learner's staleness window refused (benign: dropped
+    # + refetch; the pump reads this for its logs).
+    self.stale_rejections = 0
     deadline = time.monotonic() + connect_timeout_secs
     last_err = None
     # Capped exponential backoff + full jitter: after a learner
@@ -1324,11 +1394,23 @@ class RemoteActorClient:
         pass
       self._param_sock = None
 
-  def send_unroll(self, unroll) -> int:
+  def send_unroll(self, unroll,
+                  params_version: Optional[int] = None) -> int:
     """Ship one ActorOutput; returns the learner's params version.
     Uses the out-of-band frame: the unroll's frame stacks ARE the
-    message, so they go raw instead of through the pickler."""
-    reply = self._rpc(('unroll', unroll), oob=True)
+    message, so they go raw instead of through the pickler.
+
+    `params_version` (when known) rides the frame so a learner running
+    a staleness window (--max_unroll_staleness) can judge admission. A
+    ('stale', current) reply means the unroll was REFUSED benignly:
+    counted on `stale_rejections`, and the returned (newer) version
+    makes the caller's refetch-on-newer path fire — the same contract
+    as an ack, minus the landed unroll."""
+    msg = (('unroll', unroll) if params_version is None
+           else ('unroll', unroll, int(params_version)))
+    reply = self._rpc(msg, oob=True)
+    if reply[0] == 'stale':
+      self.stale_rejections += 1
     return reply[1]
 
   def close(self):
@@ -1486,7 +1568,12 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
               raise errors[0]
             continue
         try:
-          ack_version = client.send_unroll(unroll)
+          # The current params version rides along so a staleness-
+          # windowed learner can judge admission; a 'stale' refusal
+          # still returns the newer version, so the refetch below
+          # fires and the NEXT unroll ships fresh.
+          ack_version = client.send_unroll(unroll,
+                                           params_version=version)
         except OSError:
           # OSError, not just ConnectionError: a blackholed learner
           # host surfaces as ETIMEDOUT, which must also trigger the

@@ -70,6 +70,14 @@ class TrajectoryBuffer:
     self._not_full = threading.Condition(self._lock)
     self._not_empty = threading.Condition(self._lock)
     self._closed = False
+    # Occupancy telemetry (round 9 — the bounded-queueing guard made
+    # observable): the high-water mark (which also exposes get_batch's
+    # transient push-back overshoot), and how often/long producers
+    # actually blocked on the full buffer — the producer-side
+    # backpressure the capacity bound exists to apply.
+    self._high_water = 0
+    self._put_waits = 0
+    self._put_wait_secs = 0.0
 
   def put(self, unroll: ActorOutput, timeout: Optional[float] = None):
     """Block while full (backpressure). Raises Closed after close().
@@ -78,12 +86,20 @@ class TrajectoryBuffer:
     wakeups under contention don't restart the clock)."""
     deadline = None if timeout is None else time.monotonic() + timeout
     with self._not_full:
-      _wait_until(self._not_full,
-                  lambda: len(self._deque) < self._capacity or self._closed,
-                  deadline, 'TrajectoryBuffer.put')
+      if len(self._deque) >= self._capacity and not self._closed:
+        self._put_waits += 1
+        t0 = time.monotonic()
+        try:
+          _wait_until(self._not_full,
+                      lambda: (len(self._deque) < self._capacity
+                               or self._closed),
+                      deadline, 'TrajectoryBuffer.put')
+        finally:
+          self._put_wait_secs += time.monotonic() - t0
       if self._closed:
         raise Closed()
       self._deque.append(unroll)
+      self._high_water = max(self._high_water, len(self._deque))
       self._not_empty.notify()
 
   def get(self, timeout: Optional[float] = None) -> ActorOutput:
@@ -132,6 +148,7 @@ class TrajectoryBuffer:
         # excess drains. Wake other consumers — the restored items are
         # consumable (lost-wakeup otherwise).
         self._deque.extendleft(reversed(items))
+        self._high_water = max(self._high_water, len(self._deque))
         if items:
           self._not_empty.notify_all()
         raise
@@ -142,6 +159,21 @@ class TrajectoryBuffer:
       self._closed = True
       self._not_full.notify_all()
       self._not_empty.notify_all()
+
+  def stats(self):
+    """Occupancy/backpressure counters (driver summary surface):
+    {'occupancy', 'capacity', 'high_water', 'put_waits',
+    'put_wait_secs'}. high_water at (or briefly above) capacity with
+    growing put_waits means producers are throttled by backpressure —
+    the bounded-occupancy guarantee working, not a failure."""
+    with self._lock:
+      return {
+          'occupancy': len(self._deque),
+          'capacity': self._capacity,
+          'high_water': self._high_water,
+          'put_waits': self._put_waits,
+          'put_wait_secs': round(self._put_wait_secs, 4),
+      }
 
   def __len__(self):
     with self._lock:

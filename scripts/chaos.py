@@ -29,14 +29,36 @@ layer —
     keep flowing afterwards,
   * health/fault counters present in summaries.jsonl + incidents.jsonl.
 
+Round 9 adds the OVERLOAD storm (`run_overload_storm`): real training
+with the actor fleet at 2× the inference state-arena capacity
+(admission=shed), a slow-learner burst forcing buffer backpressure,
+and a REAL mid-storm SIGTERM driving the preemption drain — asserting
+the actor-plane SLOs:
+
+  * zero learner crashes with the fleet at 2× slot capacity,
+  * sheds counted and the shed fraction bounded (the slotless actors
+    quarantine after their respawn budget instead of shedding
+    forever),
+  * bounded trajectory-buffer occupancy under the slow learner
+    (high-water ≤ capacity + batch push-back bound),
+  * the SIGTERM drain lands a VERIFIED checkpoint + resume manifest
+    within the drain budget,
+  * `driver.train` resumes from the manifest with the parity gate
+    green (contiguous, monotone learner step sequence across the
+    preemption).
+
 Writes CHAOS_OUT (default CHAOS.json at the repo root). Invocation:
 
-    python scripts/chaos.py               # full storm, ~2-4 min CPU
-    CHAOS_SMOKE=1 python scripts/chaos.py # CI smoke, < 60 s
+    python scripts/chaos.py               # both storms, ~3-5 min CPU
+    CHAOS_SMOKE=1 python scripts/chaos.py # CI smoke (both), < 120 s
+    CHAOS_STORM=fault    python scripts/chaos.py  # just the r7 storm
+    CHAOS_STORM=overload python scripts/chaos.py  # just the overload
     CHAOS_SEED=7 python scripts/chaos.py  # different garbage bytes
 
-The schedule is a pure function of the arguments (the seed only
-perturbs garbage payload content), so a failure reproduces exactly.
+The fault schedule is a pure function of the arguments (the seed only
+perturbs garbage payload content), so a failure reproduces exactly;
+the overload storm's SIGTERM is wall-clock-timed (the drain must be
+correct WHENEVER it lands — that is the point of the drill).
 """
 
 import json
@@ -272,15 +294,223 @@ def run_storm(logdir: str, smoke: bool = SMOKE, seed: int = SEED):
   return results, errors
 
 
+def run_overload_storm(logdir: str, smoke: bool = SMOKE,
+                       seed: int = SEED):
+  """The actor-plane overload + preemption drill; returns (results,
+  hard-assert errors). Fleet at 2× slot capacity, shed admission, a
+  slow-learner backpressure burst, a REAL mid-storm SIGTERM → drain →
+  resume with the parity gate."""
+  import signal
+  import threading
+
+  import jax
+
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.runtime import faults as faults_lib
+
+  slots = 2
+  fleet_size = 2 * slots                  # 2x slot pressure
+  resume_steps = 3
+  sigterm_after = 8.0 if smoke else 18.0
+  drain_budget = 20.0
+  cfg_kwargs = dict(
+      logdir=logdir,
+      env_backend='bandit',
+      num_actors=fleet_size,
+      batch_size=2,
+      unroll_length=5,
+      num_action_repeats=1,
+      episode_length=4,
+      height=24, width=32,
+      torso='shallow',
+      use_py_process=False,
+      use_instruction=False,
+      total_environment_frames=10 ** 9,
+      inference_timeout_ms=5,
+      inference_state_cache=True,         # the slot arena under test
+      inference_state_slots=slots,
+      inference_admission='shed',
+      inference_admission_timeout_secs=0.3,
+      fleet_quarantine_after=2,
+      preempt_drain_timeout_secs=drain_budget,
+      checkpoint_secs=0,
+      summary_secs=0,
+      seed=seed)
+  cfg = Config(**cfg_kwargs)
+
+  # Slow-learner burst early: the buffer must fill and producer
+  # backpressure engage (bounded occupancy), never unbounded queueing.
+  plan = faults_lib.FaultPlan.storm(
+      seed, slow_learner_at=4, slow_learner_len=3,
+      slow_learner_secs=0.3 if smoke else 0.6)
+
+  # The REAL preemption path: SIGTERM (from a timer thread) → handler
+  # sets the drain event — exactly experiment.py's wiring.
+  drain_event = threading.Event()
+  old_handler = signal.signal(signal.SIGTERM,
+                              lambda s, f: drain_event.set())
+  timer = threading.Timer(sigterm_after,
+                          lambda: os.kill(os.getpid(), signal.SIGTERM))
+  timer.daemon = True
+
+  faults_lib.install(plan)
+  t0 = time.monotonic()
+  crash = None
+  run = None
+  try:
+    timer.start()
+    run = driver.train(cfg, stall_timeout_secs=5.0,
+                       drain_event=drain_event)
+  except BaseException as e:  # SLO: zero learner crashes at 2x load
+    crash = f'{type(e).__name__}: {e}'
+  finally:
+    faults_lib.clear()
+    timer.cancel()
+    signal.signal(signal.SIGTERM, old_handler)
+  wall_secs = time.monotonic() - t0
+
+  errors = []
+  results = {
+      'smoke': smoke,
+      'seed': seed,
+      'slots': slots,
+      'fleet_size': fleet_size,
+      'sigterm_after_secs': sigterm_after,
+      'wall_secs': round(wall_secs, 2),
+      'crash': crash,
+      'fault_plan': plan.stats(),
+  }
+  if crash is not None:
+    errors.append(f'learner crashed under overload: {crash}')
+    return results, errors
+
+  # --- SLO: sheds counted, fraction bounded, slotless slots
+  # quarantined instead of shedding forever.
+  snap = run.server.stats()
+  fleet_stats = run.fleet.stats()
+  sheds = snap['sheds']
+  acquires = snap['acquires']
+  shed_fraction = sheds / acquires if acquires else 0.0
+  if sheds < 1:
+    errors.append('no sheds despite fleet at 2x slot capacity')
+  if shed_fraction > 0.9:
+    errors.append(f'shed fraction {shed_fraction:.2f} > 0.9 — '
+                  'admission never converged')
+  if fleet_stats['slots_quarantined'] != fleet_size - slots:
+    errors.append(
+        f"slots_quarantined={fleet_stats['slots_quarantined']}, "
+        f'expected {fleet_size - slots} (the slotless actors must '
+        'give up, not retry forever)')
+
+  # --- SLO: bounded buffer occupancy under the slow-learner burst.
+  buf_stats = run.prefetcher._buffer.stats() if hasattr(
+      run.prefetcher, '_buffer') else None
+  capacity = max(cfg.queue_capacity_batches * cfg.batch_size,
+                 cfg.batch_size)
+  if buf_stats is not None:
+    bound = capacity + cfg.batch_size - 1  # get_batch push-back bound
+    if buf_stats['high_water'] > bound:
+      errors.append(f"buffer high_water {buf_stats['high_water']} > "
+                    f'bound {bound} — occupancy not bounded')
+    if buf_stats['put_waits'] < 1:
+      errors.append('no producer put ever blocked — the slow-learner '
+                    'burst exercised no backpressure')
+
+  # --- SLO: the drain landed a verified checkpoint + manifest within
+  # the budget.
+  manifest = driver.read_resume_manifest(logdir)
+  device_steps = int(jax.device_get(run.state.update_steps))
+  if manifest is None:
+    errors.append('no resume_manifest.json after the SIGTERM drain')
+  else:
+    if manifest['update_steps'] != device_steps:
+      errors.append(f"manifest update_steps {manifest['update_steps']}"
+                    f' != device {device_steps}')
+    if not manifest['checkpoint_verified']:
+      errors.append('drain checkpoint not verified '
+                    f"(checkpoint_step={manifest['checkpoint_step']})")
+    if manifest['drain_latency_secs'] > drain_budget + 10.0:
+      errors.append(f"drain latency {manifest['drain_latency_secs']}s "
+                    f'> budget {drain_budget}s (+10s grace)')
+    results['drain_latency_secs'] = manifest['drain_latency_secs']
+
+  # --- SLO: resume from the manifest; parity gate — the combined
+  # learner step sequence is contiguous and monotone across the
+  # preemption, no frames lost or double-counted.
+  resume_crash = None
+  try:
+    run2 = driver.train(cfg, max_steps=resume_steps,
+                        stall_timeout_secs=5.0)
+  except BaseException as e:
+    resume_crash = f'{type(e).__name__}: {e}'
+  if resume_crash is not None:
+    errors.append(f'resume from manifest crashed: {resume_crash}')
+    final_steps = None
+  else:
+    final_steps = int(jax.device_get(run2.state.update_steps))
+    if final_steps != device_steps + resume_steps:
+      errors.append(f'resume step accounting broken: {final_steps} != '
+                    f'{device_steps} + {resume_steps}')
+    if driver.read_resume_manifest(logdir) is not None:
+      errors.append('resume manifest not consumed by the resuming run')
+  summaries = _read_jsonl(os.path.join(logdir, 'summaries.jsonl'))
+  frame_steps = [e['step'] for e in summaries
+                 if e.get('tag') == 'env_frames_per_sec']
+  if final_steps is not None and frame_steps != list(
+      range(1, final_steps + 1)):
+    errors.append('parity gate: combined step sequence is not the '
+                  f'contiguous 1..{final_steps} (got {frame_steps})')
+
+  # --- SLO: counters present in the summary/incident streams.
+  tags = {e['tag'] for e in summaries if 'tag' in e}
+  for tag in ('inference_sheds', 'slots_quarantined',
+              'buffer_high_water', 'drain_latency_secs'):
+    if tag not in tags:
+      errors.append(f'summary tag {tag!r} missing')
+  incidents = _read_jsonl(os.path.join(logdir, 'incidents.jsonl'))
+  kinds = {e['kind'] for e in incidents}
+  for kind in ('preempt_drain_start', 'preempt_drain_complete',
+               'actor_slots_quarantined'):
+    if kind not in kinds:
+      errors.append(f'incident kind {kind!r} missing')
+
+  results.update({
+      'inference': {k: snap[k] for k in
+                    ('acquires', 'sheds', 'admission_waits',
+                     'admission_timeouts', 'waitlist_depth')},
+      'shed_fraction': round(shed_fraction, 3),
+      'slots_quarantined': fleet_stats['slots_quarantined'],
+      'buffer': buf_stats,
+      'device_update_steps': device_steps,
+      'final_update_steps': final_steps,
+      'incident_kinds': sorted(kinds),
+  })
+  return results, errors
+
+
 def main():
-  with tempfile.TemporaryDirectory(prefix='chaos_') as logdir:
-    results, errors = run_storm(logdir)
+  which = os.environ.get('CHAOS_STORM', 'all')
+  results = {}
+  errors = []
+  if which in ('all', 'fault'):
+    with tempfile.TemporaryDirectory(prefix='chaos_') as logdir:
+      storm_results, storm_errors = run_storm(logdir)
+    results.update(storm_results)  # top-level keys: the r7 layout
+    errors += storm_errors
+  if which in ('all', 'overload'):
+    with tempfile.TemporaryDirectory(prefix='chaos_ovl_') as logdir:
+      results['overload'], overload_errors = run_overload_storm(logdir)
+    errors += [f'overload: {e}' for e in overload_errors]
   results['slo_violations'] = errors
   results['ok'] = not errors
   with open(OUT_PATH, 'w') as f:
     json.dump(results, f, indent=2, sort_keys=True)
   print(json.dumps({'chaos_ok': results['ok'],
-                    'wall_secs': results['wall_secs'],
+                    'storms': which,
+                    'wall_secs': results.get('wall_secs'),
+                    'overload_wall_secs':
+                        results.get('overload', {}).get('wall_secs'),
                     'violations': errors,
                     'out': OUT_PATH}))
   if errors:

@@ -31,7 +31,18 @@ SOAK_SMOKE=1 SOAK_CHURN=1 python scripts/soak.py
 echo '== chaos smoke (deterministic fault storm: env hang/crash +'
 echo '   socket garbage + NaN burst + interrupted save; asserts zero'
 echo '   learner crashes, >=1 rollback, monotone frames — <60 s) =='
-CHAOS_SMOKE=1 python scripts/chaos.py
+CHAOS_SMOKE=1 CHAOS_STORM=fault python scripts/chaos.py
+
+echo '== overload-chaos smoke (fleet at 2x inference slots under shed'
+echo '   admission + slow-learner backpressure + REAL mid-storm'
+echo '   SIGTERM -> drain -> verified checkpoint + resume manifest ->'
+echo '   resume parity; plus the drain/resume + admission selector'
+echo '   and the tiny 1x/2x/4x shed-rate bench rows — <60 s CPU) =='
+CHAOS_SMOKE=1 CHAOS_STORM=overload python scripts/chaos.py
+JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py -q \
+  -k 'drain or admission or shed or waitlist or staleness' \
+  -p no:cacheprovider
+BENCH_SMOKE=1 BENCH_ONLY=overload python bench.py
 
 echo '== inference-plane smoke (state-cache golden parity + slot'
 echo '   lifecycle selector, then the tiny cache×depth bench rows'

@@ -200,8 +200,94 @@ def test_respawn_failure_contained_and_retried():
   assert bad == [0]
   assert spawn_fail['raised'] == 1
   assert fleet.errors()  # failure recorded on the slot
-  # Next check retries and recovers: unrolls flow again.
-  fleet.check_health()
-  got = buffer.get(timeout=15)
+  # A later check retries (respawns are backoff-paced now — round 9)
+  # and recovers: unrolls flow again.
+  deadline = time.monotonic() + 15
+  got = None
+  while got is None and time.monotonic() < deadline:
+    fleet.check_health()
+    try:
+      got = buffer.get(timeout=0.5)
+    except TimeoutError:
+      pass
   assert got is not None
   fleet.stop(timeout=5)
+
+
+def test_respawn_backoff_then_quarantine():
+  """Round 9 satellite: a persistently failing env is respawned on a
+  jittered backoff (no hot loop) and QUARANTINED after
+  `quarantine_after` consecutive respawns without a completed unroll —
+  surfaced as `slots_quarantined`, with the rest of the fleet
+  untouched."""
+  buffer = ring_buffer.TrajectoryBuffer(8)
+
+  class AlwaysCrashingEnv(FakeEnv):
+    def step(self, action):
+      raise RuntimeError('permanently broken env')
+
+  def env_factory(i):
+    if i == 0:
+      return AlwaysCrashingEnv(height=H, width=W, num_actions=A, seed=i)
+    return FakeEnv(height=H, width=W, num_actions=A, seed=i)
+
+  fleet = ActorFleet(_make_actor_factory(env_factory), buffer,
+                     num_actors=2, quarantine_after=2)
+  # Shrink the backoff so the give-up ladder runs inside test time.
+  for slot in fleet._slots:
+    slot.backoff._base = 0.01
+    slot.backoff._cap = 0.05
+  fleet.start()
+  deadline = time.monotonic() + 20
+  while time.monotonic() < deadline:
+    fleet.check_health()
+    if fleet.stats()['slots_quarantined'] == 1:
+      break
+    time.sleep(0.02)
+  stats = fleet.stats()
+  assert stats['slots_quarantined'] == 1
+  # Quarantine means give-up-after-N, not hot-loop-forever.
+  assert fleet._slots[0].respawns == 3  # quarantine_after=2 -> 3rd quits
+  assert fleet._slots[0].quarantined
+  # The healthy actor keeps feeding.
+  assert buffer.get(timeout=10) is not None
+  # A quarantined slot is never acted on again.
+  assert fleet.check_health() == []
+  fleet.stop(timeout=2)
+
+
+def test_stop_reports_unjoined_and_buffer_refuses_writes():
+  """Round 9 satellite: stop() names actors that missed the join
+  deadline instead of dropping them, and the buffer accepts NO writes
+  after stop() returns (the '_respawn stale unroll' regression)."""
+  buffer = ring_buffer.TrajectoryBuffer(8)
+  stall = threading.Event()
+
+  class StallingEnv(FakeEnv):
+    def __init__(self, stall_me=False, **kw):
+      super().__init__(**kw)
+      self._stall_me = stall_me
+
+    def step(self, action):
+      if self._stall_me and stall.is_set():
+        time.sleep(30)
+      return super().step(action)
+
+  def env_factory(i):
+    return StallingEnv(stall_me=(i == 0), height=H, width=W,
+                       num_actions=A, seed=i)
+
+  fleet = ActorFleet(_make_actor_factory(env_factory), buffer,
+                     num_actors=2)
+  fleet.start()
+  buffer.get(timeout=10)  # healthy first
+  stall.set()
+  time.sleep(0.3)         # actor 0 wedges mid-step
+  report = fleet.stop(timeout=1.0)
+  assert report['unjoined_actors'] == [0]
+  # After stop() returns, a straggler's put cannot land a stale
+  # unroll: the buffer is closed.
+  import pytest
+  with pytest.raises(ring_buffer.Closed):
+    buffer.put('stale-unroll')
+  stall.clear()

@@ -634,15 +634,27 @@ class TestStateCache:
     assert server.slots_free() == total
     buf.close()
 
-  def test_arena_exhaustion_raises(self):
+  def test_arena_exhaustion_degrades_not_raises(self):
+    """Round 9: the old `RuntimeError('state arena exhausted')` is
+    UNREACHABLE — under the default (block) admission policy an
+    exhausted arena parks the caller, and only the deadline produces
+    a clean, counted SlotUnavailable; a freed slot unparks a waiter
+    or is acquirable again."""
+    from scalable_agent_tpu.runtime.inference import SlotUnavailable
     agent, params, _ = _mk()
     cfg = Config(**_cfg_variant(inference_state_cache=True,
-                                inference_state_slots=1))
+                                inference_state_slots=1,
+                                inference_admission_timeout_secs=0.2))
+    assert cfg.inference_admission == 'block'  # the default policy
     server = InferenceServer(agent, params, cfg, seed=3)
     try:
       h1 = server.initial_core_state()
-      with pytest.raises(RuntimeError, match='arena exhausted'):
+      with pytest.raises(SlotUnavailable, match='admission timeout'):
         server.initial_core_state()
+      stats = server.stats()
+      assert stats['admission_timeouts'] == 1
+      assert stats['admission_waits'] == 1
+      assert stats['sheds'] == 0
       h1.release()
       server.initial_core_state()  # freed slot is acquirable again
     finally:
