@@ -278,6 +278,50 @@ flags.DEFINE_enum('staging_mode', _DEFAULTS.staging_mode,
                   'overlapped with compute — parity-gated, measured '
                   'per round by bench.py learner_plane; docs/PERF.md '
                   'r8).')
+# --- Sample reuse (round 10; IMPACT arXiv 1912.00167 — docs/PERF.md
+# r9, RUNBOOK §5 knob guidance). ---
+flags.DEFINE_enum('surrogate', _DEFAULTS.surrogate,
+                  ['vtrace', 'impact'],
+                  'Loss surrogate: vtrace (reference IMPALA path, '
+                  'default) or impact (clipped-target surrogate: '
+                  'on-device target-network anchor for the V-trace IS '
+                  'ratios plus a PPO-style clip of the current/target '
+                  'ratio — the staleness-tolerant form sample reuse '
+                  'needs; bit-identical to vtrace at replay_k=1, '
+                  'replay_ratio=0, target_update_interval=1).')
+flags.DEFINE_float('impact_epsilon', _DEFAULTS.impact_epsilon,
+                   'Clip width of the impact surrogate\'s '
+                   'current/target policy ratio.')
+flags.DEFINE_integer('target_update_interval',
+                     _DEFAULTS.target_update_interval,
+                     'Learner steps between target-network refreshes '
+                     '(impact surrogate; in-graph select, no host '
+                     'round trip). Interacts with replay staleness: '
+                     'the anchor must not refresh slower than the '
+                     'replay window ages (RUNBOOK §5).')
+flags.DEFINE_integer('replay_k', _DEFAULTS.replay_k,
+                     'Times each staged device batch is served to the '
+                     'learner before release (no re-stage, no added '
+                     'H2D). Default 1 = no reuse, per the measured '
+                     'accept/reject discipline — bench.py\'s replay '
+                     'stage carries the flip call.')
+flags.DEFINE_float('replay_ratio', _DEFAULTS.replay_ratio,
+                   'Fraction of each batch\'s unroll slots drawn from '
+                   'the circular replay tier ([0, 1); 0 = off). '
+                   'Replayed unrolls re-stage (one H2D each), unlike '
+                   'replay_k re-serves.')
+flags.DEFINE_integer('replay_capacity_unrolls',
+                     _DEFAULTS.replay_capacity_unrolls,
+                     'Circular replay tier capacity in unrolls '
+                     '(0 = auto: 4x batch). Oldest entries overwrite '
+                     'IMPACT-style when full.')
+flags.DEFINE_integer('replay_max_staleness',
+                     _DEFAULTS.replay_max_staleness,
+                     'Replay eviction window in PUBLISHED '
+                     'PARAM-VERSION deltas — the same unit as '
+                     '--max_unroll_staleness (which gates ingest '
+                     'admission; this gates re-serving). 0 = defer '
+                     'to max_unroll_staleness; both 0 = no bound.')
 flags.DEFINE_enum('publish_codec', _DEFAULTS.publish_codec,
                   ['bf16', 'f32'],
                   'Wire codec for served param snapshots: bf16 '

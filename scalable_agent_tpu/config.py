@@ -7,7 +7,7 @@ DMLab values. A dataclass + absl-flags overlay replaces TF1 app flags
 """
 
 import dataclasses
-from typing import Optional
+from typing import List, Optional
 
 
 @dataclasses.dataclass
@@ -227,6 +227,59 @@ class Config:
   # × staging_depth head-to-head every round (exposed H2D ms/step,
   # stack_ms, step gap), so BENCH_r08's chip rows carry the flip call.
   staging_mode: str = 'batch'            # batch | unroll
+  # --- Sample reuse (round 10; IMPACT, arXiv 1912.00167 —
+  # docs/PERF.md r9). The e2e bench shows the actor/env plane bounding
+  # throughput at ~150 fps while the compiled learner step runs ~300k
+  # frames/s synthetic: V-trace consumes each frame exactly once, so
+  # >99% of learner capacity idles. These knobs multiply learner
+  # updates per env frame by re-serving staged batches and replaying
+  # retained unrolls. ---
+  # Loss surrogate: 'vtrace' is the reference IMPALA path (default);
+  # 'impact' is the IMPACT clipped-target surrogate — a target-network
+  # param copy held on device anchors both the V-trace corrections
+  # (IS ratios pi_target/mu, clipped exactly like the reference's
+  # rho-bar) and a PPO-style clip of the pi_theta/pi_target ratio, so
+  # replayed/stale data cannot push an unbounded policy-gradient step.
+  # Parity-gated: with replay_k=1, replay_ratio=0 and
+  # target_update_interval=1 the impact path is bit-identical to the
+  # vtrace path (tests/test_replay.py) — the surrogate only diverges
+  # when reuse/staleness makes the anchor differ from the live params.
+  surrogate: str = 'vtrace'               # vtrace | impact
+  # PPO-style clip width of the impact surrogate's current/target
+  # ratio (the paper's epsilon).
+  impact_epsilon: float = 0.2
+  # Learner steps between target-network refreshes (impact only; the
+  # version-gated publish cadence applied to the on-device anchor —
+  # the refresh is an in-graph select, no host round trip). 1 pins
+  # the target to the live params (the parity-gate operating point).
+  target_update_interval: int = 1
+  # Times each staged device batch is served to the learner before
+  # release (IMPACT's sample-reuse K). The staged arena is re-served
+  # AS IS — no re-stage, no additional H2D traffic — so K updates ride
+  # one transfer; episode stats/frame counters only count the first
+  # serve. DEFAULT 1 (no reuse) per the measured accept/reject
+  # discipline: bench.py's `replay` stage measures step_ms and
+  # learner-updates/env-frame across replay_k x replay_ratio every
+  # round, and the cue_memory return-vs-wallclock artifact carries
+  # the flip call.
+  replay_k: int = 1
+  # Fraction of each batch's unroll slots drawn from the circular
+  # replay tier instead of fresh production ([0, 1); 0 = off). Unlike
+  # replay_k, replayed unrolls re-stage (one H2D per replayed unroll)
+  # but decouple batch composition from the env plane's rate.
+  replay_ratio: float = 0.0
+  # Circular replay tier capacity in unrolls (0 = auto: 4x batch).
+  # Oldest entries are overwritten IMPACT-style when full (counted as
+  # evictions-by-age).
+  replay_capacity_unrolls: int = 0
+  # Replay staleness window, in PUBLISHED PARAM-VERSION deltas — the
+  # SAME unit as --max_unroll_staleness (round 10 unified them; the
+  # ingest knob gates admission, this one gates re-serving): a
+  # retained unroll whose insert-time param version is more than this
+  # many published versions behind the current one is evicted instead
+  # of replayed (evictions-by-version). 0 = defer to
+  # max_unroll_staleness (both windows then agree); both 0 = no bound.
+  replay_max_staleness: int = 0
   # Remote actors (reference --job_name=actor gRPC topology, SURVEY
   # §3.4): learner listens on this port for actor-host connections
   # (0 = disabled); actor hosts point learner_address at it.
@@ -309,6 +362,26 @@ class Config:
         f'{self.publish_codec!r}')
 
   @property
+  def resolved_replay_capacity(self) -> int:
+    """Replay-tier capacity with the 0-auto rule applied (4x batch —
+    enough history for ratio .75 at replay_k 4 without letting mean
+    staleness run away)."""
+    if self.replay_capacity_unrolls > 0:
+      return self.replay_capacity_unrolls
+    return 4 * self.batch_size
+
+  @property
+  def resolved_replay_max_staleness(self) -> int:
+    """The replay staleness window in published param-version deltas —
+    the unit shared with `max_unroll_staleness` (round 10 unified the
+    two; they used to be spelled in different units). 0 defers to the
+    ingest window so an operator bounding admission staleness bounds
+    replay staleness for free; both 0 = unbounded."""
+    if self.replay_max_staleness > 0:
+      return self.replay_max_staleness
+    return self.max_unroll_staleness
+
+  @property
   def resolved_use_instruction(self) -> bool:
     """`use_instruction` with the None-auto rule applied (must be
     deterministic in the config alone: train, evaluate, and remote
@@ -319,6 +392,70 @@ class Config:
     if self.level_name == 'dmlab30':
       return True
     return self.level_name.startswith(('language_', 'psychlab_'))
+
+
+def validate_replay(config: Config) -> List[str]:
+  """Validate the sample-reuse knob group (round 10); raises
+  ValueError on hard errors, returns human-readable warnings for the
+  caller to log (config.py has no logger; driver.train and bench.py
+  both call this before spin-up so a bad knob combination fails
+  before any env/checkpoint cost).
+
+  The staleness cross-link (the round-10 unit unification): both
+  `max_unroll_staleness` (ingest admission) and `replay_max_staleness`
+  (replay eviction) are in PUBLISHED PARAM-VERSION deltas. A replay
+  window narrower than the admission window means a remote unroll can
+  be admitted as fresh enough to train on once, yet already be too
+  stale to ever replay — legal (admission is about training at all,
+  replay about training again) but worth a warning since the operator
+  probably meant one window."""
+  warnings = []
+  if config.surrogate not in ('vtrace', 'impact'):
+    raise ValueError(f'surrogate must be vtrace|impact, got '
+                     f'{config.surrogate!r}')
+  if config.replay_k < 1:
+    raise ValueError(f'replay_k must be >= 1, got {config.replay_k}')
+  if not 0.0 <= config.replay_ratio < 1.0:
+    raise ValueError(f'replay_ratio must be in [0, 1) (a batch needs '
+                     f'at least one fresh slot), got '
+                     f'{config.replay_ratio}')
+  if config.target_update_interval < 1:
+    raise ValueError(f'target_update_interval must be >= 1, got '
+                     f'{config.target_update_interval}')
+  if config.impact_epsilon <= 0:
+    raise ValueError(f'impact_epsilon must be > 0, got '
+                     f'{config.impact_epsilon}')
+  if config.replay_capacity_unrolls < 0:
+    raise ValueError(f'replay_capacity_unrolls must be >= 0, got '
+                     f'{config.replay_capacity_unrolls}')
+  if config.replay_max_staleness < 0:
+    raise ValueError(f'replay_max_staleness must be >= 0, got '
+                     f'{config.replay_max_staleness}')
+  reuse_on = config.replay_k > 1 or config.replay_ratio > 0
+  if reuse_on and config.surrogate == 'vtrace':
+    warnings.append(
+        'sample reuse (replay_k=%d, replay_ratio=%.2f) with '
+        'surrogate=vtrace: plain V-trace has no clipped-target anchor '
+        'against reused/stale data (IMPACT, arXiv 1912.00167) — '
+        'consider --surrogate=impact' %
+        (config.replay_k, config.replay_ratio))
+  if (config.replay_max_staleness > 0 and
+      config.max_unroll_staleness > 0 and
+      config.replay_max_staleness < config.max_unroll_staleness):
+    warnings.append(
+        'replay_max_staleness=%d is narrower than '
+        'max_unroll_staleness=%d (both in published param-version '
+        'deltas): unrolls admitted near the ingest window will be '
+        'version-evicted from the replay tier without ever being '
+        'replayed' %
+        (config.replay_max_staleness, config.max_unroll_staleness))
+  if config.replay_ratio > 0 and config.resolved_replay_capacity < \
+      config.batch_size:
+    warnings.append(
+        'replay capacity %d is below batch_size %d: replayed slots '
+        'will repeat the same few unrolls within adjacent batches' %
+        (config.resolved_replay_capacity, config.batch_size))
+  return warnings
 
 
 def apply_overrides(config: Config, **overrides) -> Config:

@@ -43,7 +43,7 @@ from scalable_agent_tpu import checkpoint as checkpoint_lib
 from scalable_agent_tpu import health as health_lib
 from scalable_agent_tpu import learner as learner_lib
 from scalable_agent_tpu import observability
-from scalable_agent_tpu.config import Config
+from scalable_agent_tpu.config import Config, validate_replay
 from scalable_agent_tpu.envs import factory, suites
 from scalable_agent_tpu.models import ImpalaAgent, init_params
 from scalable_agent_tpu.parallel import mesh as mesh_lib
@@ -250,9 +250,16 @@ class TrainRun:
     self.fps_meter = fps_meter
     self.ingest = ingest
     self.health = health  # HealthMonitor (None when watchdog is off)
+    # Set by train() when sample reuse is on: a closure over the
+    # prefetcher's serve-time fresh-slot counter, so `frames` reports
+    # FRESH env frames (reuse makes update_steps × frames_per_step an
+    # overcount).
+    self._env_frames_fn = None
 
   @property
   def frames(self) -> int:
+    if self._env_frames_fn is not None:
+      return int(self._env_frames_fn())
     return int(jax.device_get(self.state.update_steps)) * \
         self.config.frames_per_step
 
@@ -317,6 +324,11 @@ def train(config: Config, max_steps: Optional[int] = None,
   if config.staging_mode not in ('batch', 'unroll'):
     raise ValueError(f'unknown staging_mode {config.staging_mode!r} '
                      '(batch | unroll)')
+  # Sample-reuse knob group (round 10): fail on bad ranges before any
+  # env/checkpoint spin-up; soft cross-link findings (vtrace-without-
+  # anchor, mismatched staleness windows) are logged, not fatal.
+  for warning in validate_replay(config):
+    log.warning('%s', warning)
   # NOTE round 8: the fused Pallas V-trace is no longer rejected under
   # a mesh — the sharded step runs it shard_map'ped over the data axis
   # (vtrace.py / ops/vtrace_pallas.sharded_from_importance_weights;
@@ -440,7 +452,22 @@ def train(config: Config, max_steps: Optional[int] = None,
     # source-oblivious). ---
     capacity = max(config.queue_capacity_batches * config.batch_size,
                    config.batch_size)
-    buffer = ring_buffer.TrajectoryBuffer(capacity)
+    # Circular replay tier (round 10, IMPACT): retains consumed
+    # unrolls behind the FIFO so get_unrolls can compose
+    # fresh:replayed batches; staleness is measured in published
+    # param-version deltas against the version fed by the publish
+    # cadence below (the same unit --max_unroll_staleness gates
+    # ingest admission with).
+    replay_tier = None
+    if config.replay_ratio > 0:
+      replay_tier = ring_buffer.ReplayTier(
+          config.resolved_replay_capacity,
+          max_staleness=config.resolved_replay_max_staleness)
+    buffer = ring_buffer.TrajectoryBuffer(
+        capacity, replay=replay_tier, replay_ratio=config.replay_ratio)
+    buffer.note_param_version(_initial_steps)
+    frames_per_unroll = config.unroll_length * config.num_action_repeats
+    reuse_on = config.replay_k > 1 or config.replay_ratio > 0
     # ONE localization for both the ingest snapshot and the inference
     # server, UNCONDITIONALLY before the ingest branch: actor_params
     # is a cross-host collective in multi-host-TP mode, and
@@ -497,21 +524,28 @@ def train(config: Config, max_steps: Optional[int] = None,
       fleet = fleet_factory(config, agent, server.policy, buffer,
                             levels)
 
-    def stage(host_batch):
+    def stage(host_batch, n_fresh=None):
       """Prefetcher stage: peel off a tiny host-side stats view (done /
       info / level ids / action counts — the batch is host numpy right
       here) BEFORE the device transfer, so the train loop never
-      device_gets frames just to read episode stats."""
+      device_gets frames just to read episode stats.
+
+      `n_fresh` (passed by the prefetcher when a replay tier composes
+      the batch) bounds the peel to the FRESH columns — replayed slots
+      already recorded their episodes/actions on first consumption, so
+      peeling them again would double-count env-plane stats."""
+      nf = (np.asarray(host_batch.level_name).shape[0]
+            if n_fresh is None else n_fresh)
       stats_view = _stats_only_view(
-          np.asarray(host_batch.level_name),
-          jax.tree_util.tree_map(np.asarray,
+          np.asarray(host_batch.level_name)[:nf],
+          jax.tree_util.tree_map(lambda x: np.asarray(x)[:, :nf],
                                  host_batch.env_outputs.info),
-          np.asarray(host_batch.env_outputs.done))
+          np.asarray(host_batch.env_outputs.done)[:, :nf])
       # Action histogram source (reference build_learner's
       # tf.summary.histogram, ≈L395): bincount of the trained-on
       # actions ([1:] drops the overlap row, like the loss shift).
       action_counts = np.bincount(
-          np.asarray(host_batch.agent_outputs.action)[1:].ravel(),
+          np.asarray(host_batch.agent_outputs.action)[1:, :nf].ravel(),
           minlength=num_actions)
       return stats_view, action_counts, place_fn(host_batch)
 
@@ -556,9 +590,44 @@ def train(config: Config, max_steps: Optional[int] = None,
             '(model-axis batch sharding or local batch %d not '
             'divisible by the local data width) — falling back to '
             'batch staging', local_batch_size)
+    _reserve_counts = np.zeros((num_actions,), np.int64)
+
+    def reserve_view(item):
+      """Re-serve transform (replay_k > 1): the staged device batch
+      rides again untouched; the env-plane view must NOT — a re-serve
+      consumes zero new env frames, so its episode stats are None and
+      its action counts zero (the loop skips both)."""
+      return None, _reserve_counts, item[2]
+
     prefetcher = ring_buffer.BatchPrefetcher(
         buffer, local_batch_size, place_fn=stage,
-        depth=config.staging_depth, stager=stager)
+        depth=config.staging_depth, stager=stager,
+        replay_k=config.replay_k, reserve_fn=reserve_view)
+
+    # Env-frame accounting under sample reuse (round 10): with
+    # replay_k > 1 or replay_ratio > 0 a learner step no longer
+    # consumes frames_per_step FRESH env frames, so the frame budget,
+    # fps meter, TrainRun.frames, and the drain manifest count fresh
+    # unroll slots at SERVE time instead — the prefetcher's
+    # fresh_slots_served counter, credited at each batch's first
+    # serve, so the figure is immune to prefetch lookahead. The
+    # pre-resume base is still approximated as steps ×
+    # frames_per_step — exact for histories trained without reuse
+    # (the counter does not survive the process). With reuse off this
+    # stays the old steps-derived arithmetic exactly.
+    env_frames_fn = None
+    if reuse_on:
+      # Per-host counter → global frames (local_batch_size slots per
+      # host-local batch; multi-host reuse keeps the same scale-up the
+      # steps-derived arithmetic applies).
+      hosts_scale = max(config.batch_size // max(local_batch_size, 1),
+                        1)
+      resumed_frames = _initial_steps * config.frames_per_step
+
+      def env_frames_fn():
+        return (resumed_frames +
+                prefetcher.fresh_slots_served() *
+                frames_per_unroll * hosts_scale)
 
     # Multi-host: every host logs its OWN fleet's stream; process 0
     # keeps the canonical filename (shared logdirs must not interleave
@@ -598,6 +667,7 @@ def train(config: Config, max_steps: Optional[int] = None,
     run = TrainRun(config, agent, state, fleet, prefetcher, server,
                    checkpointer, writer, stats, fps_meter,
                    ingest=ingest, health=health)
+    run._env_frames_fn = env_frames_fn
     fleet.start()
   except BaseException:
     # Best-effort bounded teardown, most-critical-first: the ingest
@@ -658,6 +728,11 @@ def train(config: Config, max_steps: Optional[int] = None,
   last_quarantined_slots = 0
   last_remote_publish = float('-inf')
   last_pf_snap = {'gets': 0, 'wait_secs': 0.0}
+  # Sample-reuse / plane-utilization snapshot (round 10): per-interval
+  # deltas for learner_updates_per_env_frame and the env-vs-learner
+  # utilization split.
+  last_reuse_snap = {'steps': 0, 'fresh_unrolls': 0,
+                     'put_wait_secs': 0.0, 'time': time.monotonic()}
   last_inference_snap = {'calls': 0, 'requests': 0}
   last_ingest_snap = {'unrolls': 0, 'per_conn_unrolls': {}}
   last_ingest_time = time.monotonic()
@@ -703,7 +778,8 @@ def train(config: Config, max_steps: Optional[int] = None,
       if draining and time.monotonic() > drain_deadline:
         log.warning('preemption drain budget exhausted; finalizing')
         break
-      frames = (_initial_steps + steps_done) * config.frames_per_step
+      frames = (env_frames_fn() if env_frames_fn is not None else
+                (_initial_steps + steps_done) * config.frames_per_step)
       if frames >= config.total_environment_frames:
         break
       if max_steps is not None and steps_done >= max_steps:
@@ -772,7 +848,13 @@ def train(config: Config, max_steps: Optional[int] = None,
       state, metrics = train_step(run.state, batch_device)
       run.state = state
       steps_done += 1
-      fps_meter.update(config.frames_per_step)
+      if env_frames_fn is None:
+        fps_meter.update(config.frames_per_step)
+      else:
+        # `frames` is this iteration's pre-serve reading, so the delta
+        # is exactly the fresh frames this batch's first serve
+        # credited — 0 on a re-serve, keeping fps an ENV-frame rate.
+        fps_meter.update(max(env_frames_fn() - frames, 0))
       action_counts_acc += action_counts
 
       # Episode stats ride in the trajectory; the prefetcher peeled a
@@ -788,10 +870,13 @@ def train(config: Config, max_steps: Optional[int] = None,
       # values).
       prev_metrics = pending_metrics
       pending_metrics = (step_now, observability.stack_metrics(metrics))
-      for name, ep_return, ep_frames in stats.record_batch(
-          stats_view, step_now):
-        log.info('episode %s return=%.2f frames=%d', name, ep_return,
-                 ep_frames)
+      # A re-served batch (replay_k > 1) carries no env-plane view —
+      # its episodes/actions were recorded on the first serve.
+      if stats_view is not None:
+        for name, ep_return, ep_frames in stats.record_batch(
+            stats_view, step_now):
+          log.info('episode %s return=%.2f frames=%d', name, ep_return,
+                   ep_frames)
 
       # --- Escalation ladder (health.py): skip-and-count (the device
       # guard already withheld a non-finite update) → roll back to the
@@ -889,6 +974,10 @@ def train(config: Config, max_steps: Optional[int] = None,
         published = actor_params(state.params)
         server.update_params(published, version=step_now)
         last_publish_step = step_now
+        # Replay staleness clock (round 10): retained unrolls age in
+        # published param versions — the same unit the ingest
+        # admission window uses.
+        buffer.note_param_version(step_now)
         if (ingest is not None and
             time.monotonic() - last_remote_publish >=
             config.remote_publish_secs and
@@ -997,6 +1086,77 @@ def train(config: Config, max_steps: Optional[int] = None,
                       step_now)
         writer.scalar('buffer_put_waits', buf_stats['put_waits'],
                       step_now)
+        # --- Sample-reuse + plane-split telemetry (round 10): the
+        # measurement that motivates replay and later judges it. ---
+        pf = prefetcher.stats()
+        d_steps = steps_done - last_reuse_snap['steps']
+        # Fresh counted at SERVE time (fresh_slots_served — credited
+        # at each batch's first serve), matching bench_replay's
+        # composition attribution: dequeue-time fresh_unrolls runs
+        # ahead by the prefetch lookahead, reading the headline low.
+        d_fresh = (pf['fresh_slots_served'] -
+                   last_reuse_snap['fresh_unrolls'])
+        d_fresh_frames = d_fresh * frames_per_unroll
+        # Learner updates per FRESH env frame over this interval: the
+        # IMPACT headline. 1/frames_per_step at replay off; scales
+        # with replay_k and 1/(1-replay_ratio).
+        writer.scalar('learner_updates_per_env_frame',
+                      (d_steps / d_fresh_frames) if d_fresh_frames
+                      else 0.0, step_now)
+        interval = now - last_reuse_snap['time']
+        writer.scalar('env_frames_fresh_per_sec',
+                      d_fresh_frames / interval if interval > 0
+                      else 0.0, step_now)
+        # Utilization split: how much of the interval each plane was
+        # actually working. Learner-plane = wall fraction NOT blocked
+        # on the feed (prefetcher wait); env-plane = fraction its
+        # producer threads were NOT parked on buffer backpressure
+        # (put_wait_secs is summed across producers, hence the
+        # fleet-size normalization). Learner low + env high = env
+        # bound (the regime replay attacks); the reverse = learner
+        # bound.
+        d_feed_wait = pf['wait_secs'] - last_reuse_snap.get(
+            'feed_wait_secs', 0.0)
+        writer.scalar(
+            'learner_plane_utilization',
+            min(max(1.0 - d_feed_wait / interval, 0.0), 1.0)
+            if interval > 0 else 0.0, step_now)
+        d_put_wait = (buf_stats['put_wait_secs'] -
+                      last_reuse_snap['put_wait_secs'])
+        # Producer-thread count for the normalization: local actors
+        # PLUS live ingest connections — the remote topology runs
+        # num_actors=0 with N connection threads summing their waits,
+        # which would otherwise clamp the metric to 0.
+        producers = config.num_actors
+        if ingest is not None:
+          producers += ingest.stats()['live']
+        producers = max(producers, 1)
+        writer.scalar(
+            'env_plane_utilization',
+            min(max(1.0 - d_put_wait / (interval * producers), 0.0),
+                1.0) if interval > 0 else 0.0, step_now)
+        # Fresh vs reused frame counters (cumulative): reused = tier
+        # replays (re-staged) + whole-batch re-serves (zero-H2D).
+        frames_fresh = pf['fresh_slots_served'] * frames_per_unroll
+        frames_reused = (
+            buf_stats.get('replay_reused_unrolls', 0) +
+            pf.get('batch_reserves', 0) * local_batch_size
+        ) * frames_per_unroll
+        writer.scalar('frames_fresh', frames_fresh, step_now)
+        writer.scalar('frames_reused', frames_reused, step_now)
+        if replay_tier is not None:
+          for key in ('replay_occupancy', 'replay_evictions_age',
+                      'replay_evictions_version',
+                      'replay_reused_unrolls',
+                      'replay_mean_staleness'):
+            writer.scalar(key, buf_stats[key], step_now)
+        last_reuse_snap = {
+            'steps': steps_done,
+            'fresh_unrolls': pf['fresh_slots_served'],
+            'put_wait_secs': buf_stats['put_wait_secs'],
+            'feed_wait_secs': pf['wait_secs'],
+            'time': now,
+        }
         # Per-interval action distribution (cumulative would hide a
         # late policy collapse).
         writer.histogram('actions', action_counts_acc, step_now)
@@ -1121,7 +1281,8 @@ def train(config: Config, max_steps: Optional[int] = None,
       drain_latency = time.monotonic() - drain_t0
       manifest = {
           'update_steps': step_final,
-          'frames': step_final * config.frames_per_step,
+          'frames': (env_frames_fn() if env_frames_fn is not None
+                     else step_final * config.frames_per_step),
           'params_version_step': last_publish_step,
           'params_publishes': server.stats()['params_version'],
           'checkpoint_step': ckpt_step,

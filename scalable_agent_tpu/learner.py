@@ -40,6 +40,19 @@ class TrainState(NamedTuple):
   opt_state: Any
   update_steps: Any  # i32 [] — device-side; frames derived host-side.
   popart: Any = None  # PopArtState when config.use_popart
+  # IMPACT clipped-target anchor (config.surrogate='impact', round
+  # 10): an on-device param copy refreshed every
+  # config.target_update_interval steps by an in-graph select. None
+  # (the vtrace default) is an empty pytree subtree, so existing
+  # checkpoints and the vtrace state structure are unchanged.
+  target_params: Any = None
+  # PopArt stats snapshot taken WITH target_params at each refresh
+  # (impact + use_popart only): apply_preservation rewrites only the
+  # LIVE value head as the stats move, so the frozen anchor head must
+  # be unnormalized with the stats it was frozen under — current
+  # stats would mis-scale the V-trace values by the drift since the
+  # last refresh.
+  target_popart: Any = None
 
 
 class VTraceInputs(NamedTuple):
@@ -89,7 +102,8 @@ def align_batch(env_outputs, agent_outputs, learner_outputs, config):
 
 
 def loss_fn(params, agent, batch: ActorOutput, config: Config,
-            popart_state=None, mesh=None):
+            popart_state=None, mesh=None, target_params=None,
+            target_popart=None):
   """Total IMPALA loss for one batch; returns (loss, (metrics, aux)).
 
   `mesh` is the sharded step's mesh (train_parallel passes it; None on
@@ -102,7 +116,20 @@ def loss_fn(params, agent, batch: ActorOutput, config: Config,
   the baseline loss in normalized space with the CURRENT statistics
   (the stats/preservation update happens in train_step, one step
   behind — standard PopArt ordering). aux carries the vs targets for
-  that update."""
+  that update.
+
+  `target_params` (config.surrogate='impact', round 10 — IMPACT,
+  arXiv 1912.00167): the clipped-target anchor. The V-trace IS ratios
+  and value estimates then come from a SECOND forward pass through the
+  anchor (rho = pi_target/mu, values/bootstrap from the target
+  critic — both clipped exactly like the reference's rho-bar), the
+  baseline loss regresses the CURRENT critic toward those vs targets,
+  and the policy gradient is the PPO-style clipped
+  pi_theta/pi_target surrogate (losses.compute_impact_surrogate_loss)
+  instead of -log pi * A. Behavior-vs-target staleness is therefore
+  handled per the paper: mu may lag arbitrarily (V-trace corrects it
+  against the anchor), and theta may run ahead of the anchor only as
+  far as the clip band allows."""
   task_ids = jnp.asarray(batch.level_name).astype(jnp.int32)
   use_pc = config.pixel_control_cost > 0
   if use_pc:
@@ -126,20 +153,60 @@ def loss_fn(params, agent, batch: ActorOutput, config: Config,
   inputs = align_batch(batch.env_outputs, batch.agent_outputs,
                        learner_for_align, config)
 
+  use_impact = config.surrogate == 'impact' and target_params is not None
+  metrics_extra = {}
+  if use_impact:
+    # Anchor forward pass: the target network's logits and baseline
+    # over the same batch. target_params is a constant of this loss
+    # (refreshed by train_step's cadence select), so no gradient
+    # flows — stop_gradient makes that explicit for readers and for
+    # any jvp reaching the anchor subtree.
+    target_outputs, _ = agent.apply(
+        target_params, batch.agent_outputs.action, batch.env_outputs,
+        batch.agent_state, level_ids=task_ids)
+    target_outputs = jax.lax.stop_gradient(target_outputs)
+    if popart_state is not None:
+      # Unnormalize with the stats snapshotted AT the anchor's refresh
+      # (target_popart): preservation only rewrites the LIVE head as
+      # stats drift, so current stats would mis-scale the frozen head
+      # by sigma_now/sigma_refresh between refreshes.
+      anchor_stats = (target_popart if target_popart is not None
+                      else popart_state)
+      target_for_align = target_outputs._replace(
+          baseline=popart_lib.unnormalize(
+              anchor_stats, target_outputs.baseline, task_ids))
+    else:
+      target_for_align = target_outputs
+    # V-trace anchored on the target network: IS ratios pi_target/mu
+    # (clipped at rho-bar like the reference) and the target critic's
+    # values/bootstrap. The vs targets train the CURRENT critic below.
+    vtrace_src = align_batch(batch.env_outputs, batch.agent_outputs,
+                             target_for_align, config)
+  else:
+    vtrace_src = inputs
   vtrace_returns = vtrace.from_logits(
-      behaviour_policy_logits=inputs.behaviour_logits,
-      target_policy_logits=inputs.target_logits,
-      actions=inputs.actions,
-      discounts=inputs.discounts,
-      rewards=inputs.rewards,
-      values=inputs.values,
-      bootstrap_value=inputs.bootstrap_value,
+      behaviour_policy_logits=vtrace_src.behaviour_logits,
+      target_policy_logits=vtrace_src.target_logits,
+      actions=vtrace_src.actions,
+      discounts=vtrace_src.discounts,
+      rewards=vtrace_src.rewards,
+      values=vtrace_src.values,
+      bootstrap_value=vtrace_src.bootstrap_value,
       use_associative_scan=config.use_associative_scan,
       use_pallas=config.use_pallas_vtrace,
       mesh=mesh)
-
-  pg_loss = losses_lib.compute_policy_gradient_loss(
-      inputs.target_logits, inputs.actions, vtrace_returns.pg_advantages)
+  if use_impact:
+    log_ratio = (vtrace.log_probs_from_logits_and_actions(
+        inputs.target_logits, inputs.actions) -
+        vtrace_returns.target_action_log_probs)
+    pg_loss = losses_lib.compute_impact_surrogate_loss(
+        log_ratio, vtrace_returns.pg_advantages, config.impact_epsilon)
+    metrics_extra['impact_clip_fraction'] = losses_lib.\
+        impact_clip_fraction(log_ratio, config.impact_epsilon)
+  else:
+    pg_loss = losses_lib.compute_policy_gradient_loss(
+        inputs.target_logits, inputs.actions,
+        vtrace_returns.pg_advantages)
   if popart_state is not None:
     # Regress the normalized head toward normalized targets.
     norm_targets = popart_lib.normalize(
@@ -160,6 +227,7 @@ def loss_fn(params, agent, batch: ActorOutput, config: Config,
       'baseline_loss': baseline_loss,
       'entropy_loss': entropy_loss,
   }
+  metrics.update(metrics_extra)
   if use_pc:
     # UNREAL pixel control (unreal.py): pseudo-rewards from frame
     # deltas; action on the t→t+1 transition is agent_outputs[t+1]
@@ -191,8 +259,21 @@ def make_schedule(config: Config):
   """Polynomial (linear) LR decay to 0 over total env frames, driven by
   the update-step count × frames-per-step (reference ≈L380–390). The
   single source of truth for the LR — used by both the optimizer and
-  the logged `learning_rate` metric."""
-  fps = float(config.frames_per_step)
+  the logged `learning_rate` metric.
+
+  Under sample reuse (round 10) the frame clock is FRESH env frames:
+  each update consumes frames_per_step × (1 − replay_ratio)/replay_k
+  of them at steady state, and the driver's frame budget counts fresh
+  frames too — without this the schedule would hit zero at ~1/reuse
+  of the run and train the rest at lr=0. Identical to frames_per_step
+  with reuse off (the parity-gate operating point). The (1−ratio)/K
+  factor assumes the tier sustains the configured composition: a
+  chronically under-filled tier (tight staleness windows, cold start)
+  serves extra fresh slots, so such a run exhausts its fresh-frame
+  budget with the schedule only partly decayed — watch
+  `replay_occupancy` vs capacity in summaries (RUNBOOK §5)."""
+  fps = (float(config.frames_per_step) *
+         (1.0 - config.replay_ratio) / config.replay_k)
 
   def schedule(count):
     frames = jnp.asarray(count).astype(jnp.float32) * fps
@@ -218,12 +299,25 @@ def make_optimizer(config: Config):
 def make_train_state(params, config: Config,
                      num_popart_tasks: int = 0) -> TrainState:
   optimizer = make_optimizer(config)
+  target = None
+  if config.surrogate == 'impact':
+    # DISTINCT buffers: the state is donated every step, and a target
+    # leaf aliasing its param leaf would be donated twice. The copy
+    # preserves the params' placement/sharding (eager copy follows its
+    # input); make_sharded_train_state re-pins it explicitly anyway.
+    target = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True),
+                                    params)
+  popart = (popart_lib.init(max(num_popart_tasks, 1))
+            if config.use_popart else None)
   return TrainState(
       params=params,
       opt_state=optimizer.init(params),
       update_steps=jnp.zeros((), jnp.int32),
-      popart=(popart_lib.init(max(num_popart_tasks, 1))
-              if config.use_popart else None))
+      popart=popart,
+      target_params=target,
+      target_popart=(jax.tree_util.tree_map(
+          lambda x: jnp.array(x, copy=True), popart)
+          if target is not None and popart is not None else None))
 
 
 def make_train_step_fn(agent, config: Config, mesh=None):
@@ -237,7 +331,8 @@ def make_train_step_fn(agent, config: Config, mesh=None):
   def train_step(state: TrainState, batch: ActorOutput):
     (total_loss, (metrics, aux)), grads = jax.value_and_grad(
         loss_fn, has_aux=True)(state.params, agent, batch, config,
-                               state.popart, mesh)
+                               state.popart, mesh, state.target_params,
+                               state.target_popart)
     # Pre-clip norm: explosions must stay visible even with clipping on.
     metrics['grad_norm'] = optax.global_norm(grads)
     updates, new_opt_state = optimizer.update(
@@ -277,8 +372,31 @@ def make_train_step_fn(agent, config: Config, mesh=None):
       if new_popart is not None:
         new_popart = keep(new_popart, state.popart)
       metrics['step_ok'] = step_ok.astype(jnp.float32)
+    new_target = state.target_params
+    new_target_popart = state.target_popart
+    if state.target_params is not None:
+      # Target-network refresh on its own cadence (IMPACT round 10):
+      # an in-graph select — the version-gated publish pattern applied
+      # to the on-device anchor (a non-refresh step copies nothing; a
+      # refresh is one select per leaf, no host round trip). Runs
+      # AFTER the watchdog keep() so a skipped step's anchor snapshots
+      # the kept (old) params, never a withheld non-finite update.
+      # With interval=1 the anchor entering step N+1 IS the params
+      # entering step N+1 — the parity-gate operating point.
+      refresh = ((state.update_steps + 1) %
+                 config.target_update_interval) == 0
+      new_target = jax.tree_util.tree_map(
+          lambda p, t: jnp.where(refresh, p, t),
+          new_params, state.target_params)
+      if state.target_popart is not None:
+        # The stats snapshot refreshes WITH the anchor head — the pair
+        # is what unnormalizes the frozen baseline exactly (loss_fn).
+        new_target_popart = jax.tree_util.tree_map(
+            lambda p, t: jnp.where(refresh, p, t),
+            new_popart, state.target_popart)
     new_state = TrainState(new_params, new_opt_state,
-                           state.update_steps + 1, new_popart)
+                           state.update_steps + 1, new_popart,
+                           new_target, new_target_popart)
     metrics['learning_rate'] = schedule(state.update_steps)
     return new_state, metrics
 

@@ -31,3 +31,39 @@ def compute_policy_gradient_loss(logits, actions, advantages):
       log_probs, actions[..., None].astype(jnp.int32), axis=-1).squeeze(-1)
   advantages = jax.lax.stop_gradient(advantages)
   return jnp.sum(cross_entropy * advantages)
+
+
+def compute_impact_surrogate_loss(log_ratio, advantages, epsilon):
+  """IMPACT clipped-target surrogate (arXiv 1912.00167, round 10).
+
+  `log_ratio` is log pi_theta(a|x) - log pi_target(a|x): the CURRENT
+  policy against the on-device target-network anchor (the paper's
+  preferred of its three ratio choices — the anchor is what buys
+  staleness tolerance under sample reuse). The PPO-style form
+
+      -sum over T,B of min(r * A, clip(r, 1-eps, 1+eps) * A)
+
+  bounds how far one (possibly replayed) batch can push the policy
+  away from the anchor. Sum-reduced like every loss in this module
+  (load-bearing for hyperparameter parity with the tuned LR).
+
+  At the parity-gate operating point (target == current params, so
+  log_ratio == 0 exactly and r == 1), the clip never binds and the
+  gradient reduces to A * grad(log pi) — bit-identical to
+  `compute_policy_gradient_loss`'s gradient (tests/test_replay.py
+  pins this)."""
+  advantages = jax.lax.stop_gradient(advantages)
+  ratio = jnp.exp(log_ratio)
+  unclipped = ratio * advantages
+  clipped = jnp.clip(ratio, 1.0 - epsilon, 1.0 + epsilon) * advantages
+  return -jnp.sum(jnp.minimum(unclipped, clipped))
+
+
+def impact_clip_fraction(log_ratio, epsilon):
+  """Fraction of (t, b) elements whose current/target ratio left the
+  clip band — the reuse-health signal (≈0 fresh, climbing with
+  staleness; persistently high means the target cadence or replay
+  windows are too loose)."""
+  ratio = jnp.exp(jax.lax.stop_gradient(log_ratio))
+  outside = jnp.abs(ratio - 1.0) > epsilon
+  return jnp.mean(outside.astype(jnp.float32))

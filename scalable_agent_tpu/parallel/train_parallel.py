@@ -31,6 +31,12 @@ def make_sharded_train_state(params, config: Config, mesh: Mesh,
   p_shard = mesh_lib.param_shardings(params, mesh, enable_tp)
   params = jax.tree_util.tree_map(jax.device_put, params, p_shard)
   state = learner_lib.make_train_state(params, config, num_popart_tasks)
+  if state.target_params is not None:
+    # The IMPACT anchor shards EXACTLY like the params (the in-graph
+    # refresh is a leafwise select between the two trees, so mixed
+    # placements would force a resharding copy every step).
+    state = state._replace(target_params=jax.tree_util.tree_map(
+        jax.device_put, state.target_params, p_shard))
   replicated = NamedSharding(mesh, P())
   mesh_devices = set(mesh.devices.flat)
 

@@ -32,12 +32,21 @@ SURVEY §2.b "async pipeline"):
 Episode stats ride inside the trajectories (StepOutputInfo), so there
 is no side channel to drain — consume them from the dequeued batch
 like the reference's learner loop does (≈L590–620).
+
+Round 10 adds the sample-reuse tier (IMPACT, arXiv 1912.00167;
+docs/PERF.md r9): `ReplayTier` is a circular arena of already-consumed
+unrolls sitting BEHIND the TrajectoryBuffer — `get_unrolls` composes
+each batch fresh:replayed per the replay ratio — and the
+`BatchPrefetcher` re-serves every staged device batch `replay_k` times
+before release (the staged arena is handed out AS IS: no re-stage, no
+additional H2D), multiplying learner updates per env frame while the
+actor/env plane stays the rate limiter it measures as.
 """
 
 import collections
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Tuple
 
 from scalable_agent_tpu.runtime.actor import batch_unrolls
 from scalable_agent_tpu.structs import ActorOutput
@@ -45,6 +54,126 @@ from scalable_agent_tpu.structs import ActorOutput
 
 class Closed(Exception):
   """The buffer was closed while blocking."""
+
+
+class ReplayTier:
+  """Circular replay arena of completed unrolls (round 10 — IMPACT's
+  circular buffer, host tier).
+
+  Consumed unrolls are retained (by reference — they are immutable
+  host numpy once the actor enqueued them) with the param version
+  current at retention time. `sample(n)` hands out up to n unrolls via
+  a circular read cursor (IMPACT reads its buffer sequentially, not
+  uniformly — recent data recurs at a bounded cadence), evicting
+  entries that aged past the staleness window in passing. Eviction is
+  two-fold and separately counted:
+
+  - by AGE: the ring is full and a new unroll overwrites the oldest
+    (`evictions_age`) — capacity IS the age bound;
+  - by VERSION: an entry's retention-time param version has fallen
+    more than `max_staleness` PUBLISHED VERSIONS behind the current
+    one (`evictions_version`). The unit is the same param-version
+    delta `--max_unroll_staleness` uses for ingest admission (the
+    round-10 unification); 0 = no version bound.
+
+  Thread-safe (own lock; never calls back into the buffer).
+  """
+
+  def __init__(self, capacity_unrolls: int, max_staleness: int = 0):
+    if capacity_unrolls < 1:
+      raise ValueError('replay capacity must be >= 1')
+    self._capacity = capacity_unrolls
+    self._max_staleness = max_staleness
+    self._entries = collections.deque()  # (unroll, insert_version)
+    self._cursor = 0
+    self._lock = threading.Lock()
+    self._version = 0
+    # Telemetry (summary surface via TrajectoryBuffer.stats()).
+    self.evictions_age = 0
+    self.evictions_version = 0
+    self.reused_unrolls = 0
+    self._staleness_sum = 0
+    self._staleness_samples = 0
+    self._last_sample = (0, 0)  # (count, staleness_sum) — unsample_last
+
+  def note_param_version(self, version: int):
+    """Advance the current published param version (driver publish
+    cadence) — the clock both staleness accounting and version
+    eviction read."""
+    with self._lock:
+      self._version = max(self._version, int(version))
+
+  def add(self, unroll: ActorOutput):
+    with self._lock:
+      if len(self._entries) >= self._capacity:
+        self._entries.popleft()
+        self.evictions_age += 1
+        if self._cursor > 0:
+          self._cursor -= 1  # keep the cursor on the same entry
+      self._entries.append((unroll, self._version))
+
+  def sample(self, n: int) -> List[ActorOutput]:
+    """Up to `n` unrolls from the circular cursor (fewer when the tier
+    is short or version eviction thins it mid-scan). Each serve counts
+    toward `reused_unrolls` and the mean-staleness accumulator."""
+    out: List[ActorOutput] = []
+    with self._lock:
+      sample_staleness = 0
+      budget = len(self._entries)  # at most one full lap per call
+      while len(out) < n and self._entries and budget > 0:
+        budget -= 1
+        if self._cursor >= len(self._entries):
+          self._cursor = 0
+        unroll, version = self._entries[self._cursor]
+        staleness = self._version - version
+        if self._max_staleness and staleness > self._max_staleness:
+          del self._entries[self._cursor]
+          self.evictions_version += 1
+          continue
+        out.append(unroll)
+        self.reused_unrolls += 1
+        self._staleness_sum += staleness
+        sample_staleness += staleness
+        self._staleness_samples += 1
+        self._cursor += 1
+      self._last_sample = (len(out), sample_staleness)
+    return out
+
+  def unsample_last(self):
+    """Undo the ACCOUNTING of the most recent sample() — the caller
+    failed to deliver its batch (fresh-side timeout/close push-back in
+    get_unrolls): the cursor steps back so the sequential scan
+    re-serves the same entries next call, and the reuse/staleness
+    counters forget them. Version evictions stand (the entries really
+    were too stale). One outstanding sample at a time — the
+    single-consumer prefetcher pattern; a repeated call is a no-op."""
+    with self._lock:
+      n, staleness_sum = self._last_sample
+      self._last_sample = (0, 0)
+      if n == 0:
+        return
+      if self._entries:
+        self._cursor = (self._cursor - n) % len(self._entries)
+      self.reused_unrolls -= n
+      self._staleness_sum -= staleness_sum
+      self._staleness_samples -= n
+
+  def __len__(self):
+    with self._lock:
+      return len(self._entries)
+
+  def stats(self):
+    with self._lock:
+      mean_staleness = (self._staleness_sum / self._staleness_samples
+                        if self._staleness_samples else 0.0)
+      return {
+          'replay_occupancy': len(self._entries),
+          'replay_capacity': self._capacity,
+          'replay_evictions_age': self.evictions_age,
+          'replay_evictions_version': self.evictions_version,
+          'replay_reused_unrolls': self.reused_unrolls,
+          'replay_mean_staleness': round(mean_staleness, 3),
+      }
 
 
 def _wait_until(cond: threading.Condition, predicate: Callable[[], bool],
@@ -59,12 +188,28 @@ def _wait_until(cond: threading.Condition, predicate: Callable[[], bool],
 
 
 class TrajectoryBuffer:
-  """Bounded FIFO of unrolls with blocking put/get and backpressure."""
+  """Bounded FIFO of unrolls with blocking put/get and backpressure.
 
-  def __init__(self, capacity_unrolls: int):
+  With a `ReplayTier` attached (round 10), every FRESH unroll dequeued
+  is retained into the tier on its way out, and `get_unrolls` composes
+  each batch's slots fresh-first:replayed per `replay_ratio`. The
+  bounded FIFO semantics of the fresh path — backpressure, FIFO order,
+  push-back on timeout/close — are untouched; the tier is pure
+  retention behind it.
+  """
+
+  def __init__(self, capacity_unrolls: int,
+               replay: Optional[ReplayTier] = None,
+               replay_ratio: float = 0.0):
     if capacity_unrolls < 1:
       raise ValueError('capacity must be >= 1')
+    if not 0.0 <= replay_ratio < 1.0:
+      raise ValueError('replay_ratio must be in [0, 1)')
+    if replay_ratio > 0 and replay is None:
+      raise ValueError('replay_ratio > 0 needs a ReplayTier')
     self._capacity = capacity_unrolls
+    self._replay = replay
+    self._replay_ratio = replay_ratio
     self._deque = collections.deque()
     self._lock = threading.Lock()
     self._not_full = threading.Condition(self._lock)
@@ -78,6 +223,22 @@ class TrajectoryBuffer:
     self._high_water = 0
     self._put_waits = 0
     self._put_wait_secs = 0.0
+    # Fresh-dequeue counter (round 10): cumulative unrolls that left
+    # the FIFO toward the learner (stats()['fresh_unrolls']). NOTE
+    # this runs AHEAD of training by the prefetch lookahead — frame
+    # budgets and the learner_updates_per_env_frame denominator read
+    # the prefetcher's serve-time fresh_slots_served instead.
+    self._fresh_unrolls = 0
+
+  @property
+  def replay(self) -> Optional[ReplayTier]:
+    return self._replay
+
+  def note_param_version(self, version: int):
+    """Driver publish cadence → the replay tier's staleness clock
+    (no-op without a tier, so call sites stay unconditional)."""
+    if self._replay is not None:
+      self._replay.note_param_version(version)
 
   def put(self, unroll: ActorOutput, timeout: Optional[float] = None):
     """Block while full (backpressure). Raises Closed after close().
@@ -113,32 +274,56 @@ class TrajectoryBuffer:
       if not self._deque:
         raise Closed()
       item = self._deque.popleft()
+      self._fresh_unrolls += 1
       self._not_full.notify()
-      return item
+    if self._replay is not None:
+      self._replay.add(item)
+    return item
 
-  def get_batch(self, batch_size: int,
-                timeout: Optional[float] = None) -> ActorOutput:
-    """Dequeue `batch_size` unrolls and stack to a [T+1, B] batch (the
-    reference's `dequeue_many` + time-major transpose).
+  def sample_replay(self, batch_size: int) -> List[ActorOutput]:
+    """The replayed slice of one composed batch: up to
+    floor(batch_size * replay_ratio) unrolls from the tier (fewer when
+    it is short), [] without a tier. Sampled BEFORE the fresh fetch so
+    a batch never replays an unroll it is also consuming fresh. Split
+    out of get_unrolls so the unroll staging path can plan its slot
+    composition while still staging each fresh unroll the moment it
+    dequeues (the per-unroll trickle is the mode's whole point)."""
+    if self._replay is None or self._replay_ratio == 0:
+      return []
+    return self._replay.sample(int(batch_size * self._replay_ratio))
 
-    Accumulates incrementally — dequeued unrolls free producer slots
-    immediately, so `batch_size > capacity` works exactly like the
-    reference's capacity-1 FIFOQueue feeding `dequeue_many(batch)`.
-    On timeout or close with a partial batch, the accumulated unrolls
-    are pushed back to the FRONT of the queue (FIFO order preserved),
-    so no trajectories are ever dropped.
-    The timeout bounds total blocking (deadline-based)."""
+  def get_unrolls(self, batch_size: int,
+                  timeout: Optional[float] = None
+                  ) -> Tuple[List[ActorOutput], int]:
+    """Dequeue one batch's unrolls composed fresh:replayed (round 10).
+
+    Returns `(unrolls, n_fresh)` — FRESH unrolls first (slots
+    [0, n_fresh)), replayed after, so downstream stats peels can slice
+    the env-plane view (episode events, action histograms) without
+    double-counting replays. Replayed slots are sampled from the tier
+    BEFORE the blocking fresh fetch (so a batch never replays an
+    unroll it is also consuming fresh); with no tier or ratio 0 every
+    slot is fresh and this is exactly the old `get_batch` dequeue.
+
+    Fresh fetch semantics are unchanged from get_batch: incremental
+    accumulation (dequeued unrolls free producer slots immediately),
+    deadline-bounded blocking, and push-back to the FRONT on
+    timeout/close so no trajectory is dropped (replayed samples need
+    no push-back — the tier still holds them). Every completed fresh
+    dequeue is retained into the replay tier."""
+    replayed = self.sample_replay(batch_size)
+    n_fresh = batch_size - len(replayed)
     deadline = None if timeout is None else time.monotonic() + timeout
     items: List[ActorOutput] = []
     with self._not_empty:
       try:
-        while len(items) < batch_size:
+        while len(items) < n_fresh:
           _wait_until(self._not_empty,
                       lambda: self._deque or self._closed,
                       deadline, 'TrajectoryBuffer.get_batch')
           if not self._deque:  # closed and drained: partial batch
             raise Closed()
-          while self._deque and len(items) < batch_size:
+          while self._deque and len(items) < n_fresh:
             items.append(self._deque.popleft())
           self._not_full.notify_all()
       except (TimeoutError, Closed):
@@ -151,7 +336,25 @@ class TrajectoryBuffer:
         self._high_water = max(self._high_water, len(self._deque))
         if items:
           self._not_empty.notify_all()
+        if replayed:
+          # The replayed slice never reached the learner either: give
+          # its accounting back so the tier's sequential scan and the
+          # reuse/staleness counters only see DELIVERED serves.
+          self._replay.unsample_last()
         raise
+      self._fresh_unrolls += len(items)
+    if self._replay is not None:
+      for item in items:
+        self._replay.add(item)
+    return items + replayed, n_fresh
+
+  def get_batch(self, batch_size: int,
+                timeout: Optional[float] = None) -> ActorOutput:
+    """Dequeue `batch_size` unrolls and stack to a [T+1, B] batch (the
+    reference's `dequeue_many` + time-major transpose). Composes
+    fresh:replayed when a replay tier is attached — see get_unrolls,
+    which owns the dequeue/push-back semantics."""
+    items, _ = self.get_unrolls(batch_size, timeout)
     return batch_unrolls(items)
 
   def close(self):
@@ -163,17 +366,23 @@ class TrajectoryBuffer:
   def stats(self):
     """Occupancy/backpressure counters (driver summary surface):
     {'occupancy', 'capacity', 'high_water', 'put_waits',
-    'put_wait_secs'}. high_water at (or briefly above) capacity with
-    growing put_waits means producers are throttled by backpressure —
-    the bounded-occupancy guarantee working, not a failure."""
+    'put_wait_secs', 'fresh_unrolls'}, plus the replay tier's
+    occupancy/eviction/reuse counters when one is attached (round 10).
+    high_water at (or briefly above) capacity with growing put_waits
+    means producers are throttled by backpressure — the
+    bounded-occupancy guarantee working, not a failure."""
     with self._lock:
-      return {
+      out = {
           'occupancy': len(self._deque),
           'capacity': self._capacity,
           'high_water': self._high_water,
           'put_waits': self._put_waits,
           'put_wait_secs': round(self._put_wait_secs, 4),
+          'fresh_unrolls': self._fresh_unrolls,
       }
+    if self._replay is not None:
+      out.update(self._replay.stats())
+    return out
 
   def __len__(self):
     with self._lock:
@@ -325,13 +534,16 @@ class UnrollBatchStager:
         self.donation_fallback = True
     return self._insert_plain(arena, unroll_dev, slot)
 
-  def add(self, unroll):
+  def add(self, unroll, peel_view: bool = True):
     """Stage one unroll into the current batch (called with host
-    numpy, straight off the TrajectoryBuffer)."""
+    numpy, straight off the TrajectoryBuffer). `peel_view=False` skips
+    the host stats peel — REPLAYED unrolls (round 10) already peeled
+    their episode view on first consumption; peeling again would
+    double-count episodes in the summaries."""
     import jax
     if self._next_slot >= self._batch_size:
       raise RuntimeError('batch already full; call finish()')
-    if self._host_view_fn is not None:
+    if self._host_view_fn is not None and peel_view:
       self._views.append(self._host_view_fn(unroll))
     if self._arenas is None:
       self._arenas = [self._zero_arena(unroll, n, d)
@@ -408,19 +620,47 @@ class BatchPrefetcher:
   conflates data starvation with transfer stalls by design — both are
   "the learner waited" — so read it together with `buffer_unrolls`
   (≈0 means starvation upstream of staging).
+
+  Sample reuse (round 10): with `replay_k` > 1 each staged batch is
+  SERVED `replay_k` times before its slot frees — the staged device
+  arena is handed out AS IS (the same arrays; the train step donates
+  only its state, and the unroll stager backs every batch with fresh
+  arenas, so re-serves are bit-identical), which is `replay_k` learner
+  updates per ONE stage/H2D. Serves after the first pass through
+  `reserve_fn` (when given) so the caller can blank the host stats
+  view — a re-serve consumes zero new env frames. A batch being
+  re-served still occupies its depth slot until the Kth serve, and
+  `close()` drops partially-served batches with everything else (no
+  staged HBM outlives the prefetcher).
+
+  When the buffer carries a replay tier, `place_fn` is called as
+  `place_fn(batch, n_fresh)` — the composed batch's fresh slot count —
+  so the driver's stats peel can exclude replayed columns; without a
+  tier the one-argument contract is unchanged.
   """
 
   def __init__(self, buffer: TrajectoryBuffer, batch_size: int,
-               place_fn: Callable = lambda x: x, depth: int = 2,
-               stager: Optional[UnrollBatchStager] = None):
+               place_fn: Callable = lambda batch, n_fresh=None: batch,
+               depth: int = 2,
+               stager: Optional[UnrollBatchStager] = None,
+               replay_k: int = 1,
+               reserve_fn: Optional[Callable] = None):
     if depth < 1:
       raise ValueError('staging depth must be >= 1')
+    if replay_k < 1:
+      raise ValueError('replay_k must be >= 1')
     self._buffer = buffer
     self._batch_size = batch_size
     self._place_fn = place_fn
     # staging_mode='unroll': per-unroll device staging + on-device
     # assembly replaces get_batch + place_fn (which is then unused).
     self._stager = stager
+    self._replay_k = replay_k
+    self._reserve_fn = reserve_fn
+    self._fresh_aware = buffer.replay is not None
+    self._serves = 0
+    self._reserves = 0
+    self._fresh_served = 0
     self._out = collections.deque()
     self._lock = threading.Lock()
     self._ready = threading.Condition(self._lock)
@@ -438,28 +678,50 @@ class BatchPrefetcher:
     self._thread.start()
 
   def _stage_next(self):
-    """Assemble + stage one batch. Batch mode: host stack via
-    get_batch, then one place_fn burst. Unroll mode: each unroll is
-    transferred the moment it dequeues and the batch assembles on
-    device (UnrollBatchStager) — the transfers overlap the step that
-    is computing RIGHT NOW, not just each other."""
+    """Assemble + stage one batch; returns (staged, n_fresh). Batch
+    mode: host stack via get_unrolls, then one place_fn burst. Unroll
+    mode: each unroll is transferred the moment it dequeues and the
+    batch assembles on device (UnrollBatchStager) — the transfers
+    overlap the step that is computing RIGHT NOW, not just each other.
+    Both modes compose fresh:replayed slots through the buffer's
+    replay tier (fresh first); replayed unrolls skip the host stats
+    peel."""
     if self._stager is None:
-      batch = self._buffer.get_batch(self._batch_size)
-      return self._place_fn(batch)  # async device_put: overlaps
-    for _ in range(self._batch_size):
+      items, n_fresh = self._buffer.get_unrolls(self._batch_size)
+      batch = batch_unrolls(items)
+      if self._fresh_aware:
+        return self._place_fn(batch, n_fresh), n_fresh
+      return self._place_fn(batch), n_fresh  # async put: overlaps
+    # Unroll mode stays INCREMENTAL: each fresh unroll stages (and
+    # starts its H2D) the moment it dequeues — batching the dequeue
+    # would turn the trickle back into a step-boundary burst. Replayed
+    # slots (available instantly) fill the tail of the batch.
+    replayed = self._buffer.sample_replay(self._batch_size)
+    n_fresh = self._batch_size - len(replayed)
+    for _ in range(n_fresh):
       self._stager.add(self._buffer.get())
-    return self._stager.finish()
+    for unroll in replayed:
+      self._stager.add(unroll, peel_view=False)
+    return self._stager.finish(), n_fresh
 
   def _loop(self):
     try:
       while True:
-        staged = self._stage_next()
+        staged, n_fresh = self._stage_next()
         with self._space:
           while len(self._out) >= self._depth and not self._closed:
             self._space.wait()
           if self._closed:
             return
-          self._out.append(staged)
+          # [staged, serves_remaining, n_fresh]: the entry leaves the
+          # deque — freeing its depth slot AND its device arrays —
+          # only after the replay_k-th serve. n_fresh is credited to
+          # `fresh_slots_served` at FIRST serve, so the fresh-vs-serve
+          # accounting is attributed at consumption time (a batch
+          # staged ahead by the prefetcher but never served counts
+          # nothing — the lookahead-free invariant bench.py's
+          # composition rows rely on).
+          self._out.append([staged, self._replay_k, n_fresh])
           self._staged += 1
           self._ready.notify()
     except Closed:
@@ -497,9 +759,30 @@ class BatchPrefetcher:
         raise self._error
       if not self._out:
         raise Closed()
-      item = self._out.popleft()
-      self._space.notify()
+      entry = self._out[0]
+      item = entry[0]
+      first_serve = entry[1] == self._replay_k
+      entry[1] -= 1
+      if entry[1] <= 0:  # Kth serve: release the slot + the arrays
+        self._out.popleft()
+        self._space.notify()
+      self._serves += 1
+      if first_serve:
+        self._fresh_served += entry[2]
+      if not first_serve:
+        self._reserves += 1
+        if self._reserve_fn is not None:
+          item = self._reserve_fn(item)
       return item
+
+  def fresh_slots_served(self) -> int:
+    """Cumulative fresh unroll slots of FIRST-served batches — the
+    serve-time env-frame counter (immune to prefetch lookahead). Split
+    from stats() because the driver's frame budget reads it every
+    step; building the full stats dict there would add lock hold time
+    the staging thread contends on."""
+    with self._lock:
+      return self._fresh_served
 
   def stats(self):
     """Staging/overlap counters: staged batches, consumer gets, how
@@ -507,6 +790,11 @@ class BatchPrefetcher:
     `h2d_overlap_fraction` (1.0 = no step ever waited on staging)."""
     with self._lock:
       gets = self._gets
+      # Overlap is denominated on FIRST serves: a re-serve (replay_k
+      # > 1) hands back the entry already at the deque head, so it can
+      # never block — counting it would dilute the fraction by 1/K and
+      # mask real staging stalls on reuse configs.
+      first_gets = max(gets - self._reserves, 0)
       out = {
           'depth': self._depth,
           'mode': 'unroll' if self._stager is not None else 'batch',
@@ -515,7 +803,18 @@ class BatchPrefetcher:
           'blocked_gets': self._blocked_gets,
           'wait_secs': round(self._wait_secs, 4),
           'h2d_overlap_fraction': (
-              (gets - self._blocked_gets) / gets if gets else 0.0),
+              (first_gets - self._blocked_gets) / first_gets
+              if first_gets else 0.0),
+          # Sample reuse (round 10): serves counts every batch handed
+          # to the learner; batch_reserves the serves beyond each
+          # batch's first (zero-H2D re-serves of the staged arena);
+          # fresh_slots_served the fresh unroll slots of FIRST-served
+          # batches (credited at serve time, so composition ratios
+          # derived from it are immune to prefetch lookahead).
+          'replay_k': self._replay_k,
+          'serves': self._serves,
+          'batch_reserves': self._reserves,
+          'fresh_slots_served': self._fresh_served,
       }
     if self._stager is not None:
       out.update(self._stager.stats())

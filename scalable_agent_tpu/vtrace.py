@@ -61,6 +61,15 @@ def from_logits(behaviour_policy_logits, target_policy_logits, actions,
   axis (ops/vtrace_pallas.sharded_from_importance_weights) — V-trace
   is per-batch-column independent, so the mapping is exact. The pure
   JAX forms partition under GSPMD without help and ignore it.
+
+  `target_policy_logits` need not be the differentiated policy: the
+  IMPACT surrogate (learner.loss_fn with config.surrogate='impact';
+  arXiv 1912.00167) passes the TARGET-NETWORK logits here, so the IS
+  ratios become pi_target/mu — clipped at the same rho-bar — and the
+  returned `target_action_log_probs` double as the anchor log-probs
+  the clipped surrogate's pi_theta/pi_target ratio is built from.
+  Nothing differentiates through this function's outputs either way
+  (vs/pg_advantages are stop-gradient'ed below).
   """
   behaviour_action_log_probs = log_probs_from_logits_and_actions(
       behaviour_policy_logits, actions)

@@ -65,6 +65,17 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_learner_plane.py \
 XLA_FLAGS='--xla_force_host_platform_device_count=8' \
   BENCH_SMOKE=1 BENCH_ONLY=learner_plane python bench.py
 
+echo '== sample-reuse smoke (circular replay tier + staged-arena'
+echo '   re-serve lifecycle + IMPACT clipped-target parity selector,'
+echo '   then the tiny replay_k x ratio rows + cue_memory curve run'
+echo '   via BENCH_ONLY=replay — <60 s CPU) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_replay.py \
+  -q -k 'parity or tier or compos or validation or cadence' \
+  -p no:cacheprovider
+JAX_PLATFORMS=cpu python -m pytest tests/test_learner_plane.py \
+  -q -k 'reserve or reuse' -p no:cacheprovider
+BENCH_SMOKE=1 BENCH_ONLY=replay python bench.py
+
 echo '== pixel-control fast-path parity (integer rewards + d2s head'
 echo '   + bf16-Q levers vs the r5 reference forms — <60 s CPU) =='
 JAX_PLATFORMS=cpu python -m pytest tests/test_unreal.py -q \

@@ -219,6 +219,90 @@ class TestUnrollModeFailurePaths:
       stager.add(_unrolls(1)[0])
 
 
+class TestStagedArenaReserve:
+  """Satellite (round 10): the replay_k re-serve lifecycle. A staged
+  batch served K times must be THE SAME device arrays every serve (no
+  re-stage, no extra H2D), release its depth slot only after the Kth
+  serve, and a close mid-reuse must drop it with everything else."""
+
+  def _put(self, buf, n, seed0=0):
+    for u in _unrolls(n, seed0=seed0):
+      buf.put(u)
+
+  def test_reserves_are_bit_identical_and_release_after_kth(self):
+    buf = ring_buffer.TrajectoryBuffer(16)
+    stager = ring_buffer.UnrollBatchStager(2)
+    pf = ring_buffer.BatchPrefetcher(buf, 2, stager=stager, depth=2,
+                                     replay_k=3)
+    self._put(buf, 4)
+    serves = [pf.get(timeout=10) for _ in range(3)]
+    # The SAME staged object every serve — re-serving is a pointer
+    # hand-out, not a re-stage (zero added H2D by construction).
+    assert serves[1] is serves[0] and serves[2] is serves[0]
+    next_batch = pf.get(timeout=10)
+    assert next_batch is not serves[0]
+    _assert_tree_equal(next_batch, batch_unrolls(_unrolls(2, seed0=2)))
+    stats = pf.stats()
+    assert stats['replay_k'] == 3
+    assert stats['serves'] == 4
+    assert stats['batch_reserves'] == 2
+    # Exactly two batches were ever staged for the four serves.
+    assert stager.stats()['batches_assembled'] == 2
+    pf.close()
+
+  def test_depth_slot_held_until_kth_serve(self):
+    """A half-served batch still occupies its depth slot: with
+    depth=1 and replay_k=2, the second staged batch cannot enter the
+    queue until the first batch's second serve frees the slot."""
+    buf = ring_buffer.TrajectoryBuffer(16)
+    stager = ring_buffer.UnrollBatchStager(1)
+    pf = ring_buffer.BatchPrefetcher(buf, 1, stager=stager, depth=1,
+                                     replay_k=2)
+    self._put(buf, 3)
+    first = pf.get(timeout=10)
+    deadline = time.monotonic() + 1
+    while time.monotonic() < deadline:
+      time.sleep(0.02)
+    assert len(pf._out) == 1  # batch 2 parked outside the queue
+    assert pf.get(timeout=10) is first      # second serve frees it
+    second = pf.get(timeout=10)
+    assert second is not first
+    pf.close()
+
+  def test_close_mid_reuse_aborts_without_leak(self):
+    buf = ring_buffer.TrajectoryBuffer(16)
+    stager = ring_buffer.UnrollBatchStager(2)
+    pf = ring_buffer.BatchPrefetcher(buf, 2, stager=stager, depth=2,
+                                     replay_k=4)
+    self._put(buf, 2)
+    pf.get(timeout=10)  # 3 serves still owed on this batch
+    pf.close()
+    # The partially-served batch was dropped with the rest — no staged
+    # device arrays outlive the prefetcher.
+    assert len(pf._out) == 0
+    with pytest.raises(ring_buffer.Closed):
+      pf.get(timeout=1)
+
+  def test_reserve_fn_transforms_reserves_only(self):
+    buf = ring_buffer.TrajectoryBuffer(16)
+    seen = []
+
+    def reserve_fn(item):
+      seen.append(item)
+      return {'reused': item}
+
+    pf = ring_buffer.BatchPrefetcher(buf, 2, place_fn=lambda b: b,
+                                     depth=2, replay_k=2,
+                                     reserve_fn=reserve_fn)
+    self._put(buf, 2)
+    first = pf.get(timeout=10)
+    second = pf.get(timeout=10)
+    assert not isinstance(first, dict)
+    assert isinstance(second, dict) and second['reused'] is first
+    assert len(seen) == 1 and seen[0] is first
+    pf.close()
+
+
 class TestShardedPallasVtrace:
   """The lifted mesh restriction: the fused kernel under shard_map on
   the 8-virtual-device mesh vs the single-device forms, at the
