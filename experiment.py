@@ -65,8 +65,26 @@ flags.DEFINE_float('remote_publish_secs',
 flags.DEFINE_float('actor_reconnect_secs',
                    _DEFAULTS.actor_reconnect_secs,
                    'Actor: on disconnect, retry the learner for this '
-                   'many seconds (survives a learner restart); '
-                   '0 = exit on disconnect.')
+                   'many seconds (survives a learner restart — size '
+                   'it ABOVE the learner restart budget of restore + '
+                   'recompile, ~90s; validate_transport warns '
+                   'otherwise); 0 = exit on disconnect. Default '
+                   'nonzero since round 11 (docs/RUNBOOK.md §8).')
+flags.DEFINE_float('remote_heartbeat_secs',
+                   _DEFAULTS.remote_heartbeat_secs,
+                   'Transport heartbeat cadence (protocol v6, '
+                   'negotiated off for v5 peers): idle actors ping '
+                   'inside the reaping window, and the learner emits '
+                   "'busy' keepalives while backpressure holds an "
+                   'ack. 0 = no heartbeats (docs/TRANSPORT.md).')
+flags.DEFINE_float('remote_conn_idle_timeout_secs',
+                   _DEFAULTS.remote_conn_idle_timeout_secs,
+                   'Reap ingest/param-lane connections that received '
+                   'no bytes for this long (half-open peers used to '
+                   'pin a reader forever); doubles as the mid-frame '
+                   'stall + send no-progress deadline and the '
+                   "actor's I/O deadline on a silent learner. "
+                   '0 = never reap, no deadlines.')
 flags.DEFINE_integer('num_actors', _DEFAULTS.num_actors,
                      'Actor (environment) count.')
 flags.DEFINE_integer('total_environment_frames',

@@ -310,7 +310,35 @@ class Config:
   # Actor-host elasticity: on disconnect, keep retrying the learner
   # for this many seconds (surviving a learner restart-from-
   # checkpoint) instead of exiting. 0 = exit on disconnect.
-  actor_reconnect_secs: float = 0.0
+  # DEFAULT FLIPPED round 11 (0.0 -> 180.0): the hard-crash restart
+  # story (docs/RUNBOOK.md §8) needs the fleet to outlive a learner
+  # kill -9 + restore + recompile by default — exiting on the first
+  # disconnect turned every learner blip into a dead fleet. The
+  # window must cover the learner restart budget (validate_transport
+  # warns when it doesn't); envs stay alive and paused on buffer
+  # backpressure for the duration.
+  actor_reconnect_secs: float = 180.0
+  # --- Transport-plane liveness (round 11; docs/TRANSPORT.md v6,
+  # docs/ROBUSTNESS.md transport rows). ---
+  # Application-level heartbeat interval for the ingest/param lanes:
+  # a v6 client pings when its trajectory lane is idle this long (the
+  # pong carries the current params version, so an idle fleet still
+  # learns about publishes), and the server emits 'busy' keepalives
+  # at this cadence while an ack is held back by buffer backpressure
+  # (a slow learner stays tellable from a dead one). Negotiated per
+  # connection at hello — a v5 peer gets neither. 0 = no heartbeats.
+  remote_heartbeat_secs: float = 10.0
+  # Idle/half-open connection reaping window: a connection (either
+  # lane) that has received NO bytes for this long is reaped —
+  # half-open peers (silent partition, killed host behind a live NAT
+  # entry) used to pin their reader thread and its buffers forever.
+  # With heartbeats on, a live-but-idle peer is never silent longer
+  # than remote_heartbeat_secs, so the reap only fires on genuinely
+  # dead/blackholed peers. Doubles as the client-side I/O deadline
+  # (how long an actor waits on a silent learner before entering its
+  # reconnect window) and the server's mid-frame recv/send stall
+  # deadline. 0 = never reap, no deadlines (pre-round-11 semantics).
+  remote_conn_idle_timeout_secs: float = 60.0
   # Validate/commit workers draining the ingest readers' handoff
   # queue (runtime/remote.py — validation, the backpressure put and
   # the ack run here, off the per-connection reader threads).
@@ -455,6 +483,68 @@ def validate_replay(config: Config) -> List[str]:
         'replay capacity %d is below batch_size %d: replayed slots '
         'will repeat the same few unrolls within adjacent batches' %
         (config.resolved_replay_capacity, config.batch_size))
+  return warnings
+
+
+# What a learner restart-from-checkpoint actually costs before the
+# ingest port answers hellos again: process spawn + jax import +
+# checkpoint restore + the 20-40 s inference/train compiles. An actor
+# reconnect window shorter than this turns every learner hard-crash
+# into a dead fleet — validate_transport cross-links the two.
+LEARNER_RESTART_BUDGET_SECS = 90.0
+
+
+def validate_transport(config: Config) -> List[str]:
+  """Validate the transport-liveness knob group (round 11); raises
+  ValueError on hard errors, returns human-readable warnings for the
+  caller to log (same contract as validate_replay — driver.train and
+  run_remote_actor both call it before spin-up).
+
+  The reconnect/restart cross-link: `actor_reconnect_secs` is how long
+  an actor host survives a dead learner, and a learner hard-crash
+  restart (docs/RUNBOOK.md §8) costs LEARNER_RESTART_BUDGET_SECS
+  before the new ingest port answers — a window shorter than the
+  budget means the fleet gives up mid-restart and the restarted
+  learner comes back to nobody."""
+  warnings = []
+  if config.remote_heartbeat_secs < 0:
+    raise ValueError(f'remote_heartbeat_secs must be >= 0, got '
+                     f'{config.remote_heartbeat_secs}')
+  if config.remote_conn_idle_timeout_secs < 0:
+    raise ValueError(f'remote_conn_idle_timeout_secs must be >= 0, '
+                     f'got {config.remote_conn_idle_timeout_secs}')
+  if config.actor_reconnect_secs < 0:
+    raise ValueError(f'actor_reconnect_secs must be >= 0, got '
+                     f'{config.actor_reconnect_secs}')
+  if 0 < config.actor_reconnect_secs < LEARNER_RESTART_BUDGET_SECS:
+    warnings.append(
+        'actor_reconnect_secs=%.1f is shorter than the learner '
+        'restart budget (~%.0fs: restore + recompile before the '
+        'ingest port answers) — the fleet will give up mid-restart '
+        'and a hard-crashed learner comes back to nobody '
+        '(docs/RUNBOOK.md §8)' %
+        (config.actor_reconnect_secs, LEARNER_RESTART_BUDGET_SECS))
+  hb = config.remote_heartbeat_secs
+  idle = config.remote_conn_idle_timeout_secs
+  if hb > 0 and idle > 0 and hb >= idle:
+    warnings.append(
+        'remote_heartbeat_secs=%.1f >= remote_conn_idle_timeout_secs'
+        '=%.1f: heartbeats cannot keep an idle-but-healthy connection '
+        'inside the reaping window — every quiet period becomes a '
+        'reap + reconnect cycle' % (hb, idle))
+  if idle > 0 and hb == 0:
+    warnings.append(
+        'remote_conn_idle_timeout_secs=%.1f with heartbeats disabled: '
+        'idle-but-healthy peers (slow envs, v5 clients) will be '
+        'reaped and must reconnect — set remote_heartbeat_secs > 0 '
+        'or size the window above the slowest unroll cadence' % idle)
+  if hb > 0 and idle == 0:
+    warnings.append(
+        'remote_heartbeat_secs=%.1f with idle reaping disabled '
+        '(remote_conn_idle_timeout_secs=0): mid-frame stalls still '
+        'abort, but a BETWEEN-frames half-open connection is never '
+        'reaped and heartbeat misses are not counted — set a nonzero '
+        'idle window to get the full liveness story' % hb)
   return warnings
 
 

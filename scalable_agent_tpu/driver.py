@@ -43,7 +43,8 @@ from scalable_agent_tpu import checkpoint as checkpoint_lib
 from scalable_agent_tpu import health as health_lib
 from scalable_agent_tpu import learner as learner_lib
 from scalable_agent_tpu import observability
-from scalable_agent_tpu.config import Config, validate_replay
+from scalable_agent_tpu.config import (Config, validate_replay,
+                                       validate_transport)
 from scalable_agent_tpu.envs import factory, suites
 from scalable_agent_tpu.models import ImpalaAgent, init_params
 from scalable_agent_tpu.parallel import mesh as mesh_lib
@@ -329,6 +330,11 @@ def train(config: Config, max_steps: Optional[int] = None,
   # anchor, mismatched staleness windows) are logged, not fatal.
   for warning in validate_replay(config):
     log.warning('%s', warning)
+  # Transport-liveness knob group (round 11): same contract — hard
+  # range errors raise, cross-links (reconnect window shorter than the
+  # learner restart budget, heartbeat outside the reaping window) log.
+  for warning in validate_transport(config):
+    log.warning('%s', warning)
   # NOTE round 8: the fused Pallas V-trace is no longer rejected under
   # a mesh — the sharded step runs it shard_map'ped over the data axis
   # (vtrace.py / ops/vtrace_pallas.sharded_from_importance_weights;
@@ -489,8 +495,11 @@ def train(config: Config, max_steps: Optional[int] = None,
                                               num_actions),
           wire_dtype=config.resolved_wire_dtype,
           ingest_workers=config.ingest_workers,
-          max_unroll_staleness=config.max_unroll_staleness)
-      log.info('remote-actor ingest listening on port %d', ingest.port)
+          max_unroll_staleness=config.max_unroll_staleness,
+          heartbeat_secs=config.remote_heartbeat_secs,
+          idle_timeout_secs=config.remote_conn_idle_timeout_secs)
+      log.info('remote-actor ingest listening on port %d '
+               '(session epoch %d)', ingest.port, ingest.session_epoch)
     # --- Inference server (weights served host-side to actor
     # threads). Per-process seed offset: params/init use config.seed
     # IDENTICALLY on every host (multi-host device_put asserts
@@ -814,6 +823,15 @@ def train(config: Config, max_steps: Optional[int] = None,
           raise errors[0]
         raise
       last_batch_time = time.monotonic()
+      # Fault site 'learner_crash' (round 11): one event per CONSUMED
+      # batch — a scheduled event hard-kills this process (SIGKILL: no
+      # unwind, no drain, no 'bye'). kill -9/OOM made deterministic
+      # for chaos.py's run_partition_storm, which runs the learner as
+      # a child, restarts it, and asserts the restore-from-LAST_GOOD +
+      # fleet re-attach SLOs.
+      crash = faults_lib.fire('learner_crash')
+      if crash is not None:
+        faults_lib.hard_crash(crash)
       # Data is flowing again: captured errors are from a recovered
       # incident; keeping them would misattribute a much later stall.
       errors = []
@@ -1017,6 +1035,11 @@ def train(config: Config, max_steps: Optional[int] = None,
         # producing — the quorum fraction is the honest fleet signal.
         writer.scalar('actors_healthy', fleet_stats['healthy'],
                       step_now)
+        # Alive-but-silent actors (blocked in env.step / parked on
+        # backpressure past the horizon): the fleet-side member of
+        # the zero-deadlocked-threads ledger (round 11).
+        writer.scalar('actors_wedged', fleet_stats.get('wedged', 0),
+                      step_now)
         writer.scalar('fleet_healthy_fraction',
                       fleet_stats['healthy_fraction'], step_now)
         writer.scalar('actor_respawns', fleet_stats['respawns'],
@@ -1215,6 +1238,43 @@ def train(config: Config, max_steps: Optional[int] = None,
                         step_now)
           writer.scalar('remote_param_blobs', ing['param_blobs'],
                         step_now)
+          # Transport-liveness counters (round 11): reaped idle/
+          # half-open connections and dropped param subscribers are
+          # the fan-out shrinkage signals; heartbeat misses lead the
+          # reaps; reattach count/latency is the restarted learner's
+          # fleet-recovery ledger; wedged threads should be ZERO —
+          # any nonzero is an incident, not a trend.
+          writer.scalar('remote_conns_reaped',
+                        ing.get('conns_reaped', 0), step_now)
+          writer.scalar('remote_heartbeat_misses',
+                        ing.get('heartbeat_misses', 0), step_now)
+          writer.scalar('param_subs_dropped',
+                        ing.get('param_subs_dropped', 0), step_now)
+          writer.scalar('remote_stale_epoch_rejected',
+                        ing.get('stale_epoch_rejected', 0), step_now)
+          writer.scalar('remote_reattached',
+                        ing.get('reattached', 0), step_now)
+          writer.scalar('remote_reattach_latency_secs',
+                        ing.get('reattach_latency_secs', 0.0),
+                        step_now)
+          wedged_now = ing.get('ingest_threads_wedged', 0)
+          writer.scalar('ingest_threads_wedged', wedged_now, step_now)
+          if (ing.get('conns_reaped', 0) >
+              last_ingest_snap.get('conns_reaped', 0)):
+            incidents.event(
+                'remote_conn_reaped', step=step_now,
+                total=ing['conns_reaped'],
+                delta=(ing['conns_reaped'] -
+                       last_ingest_snap.get('conns_reaped', 0)))
+          if wedged_now > last_ingest_snap.get(
+              'ingest_threads_wedged', 0):
+            names = ing.get('wedged_thread_names', [])
+            incidents.event('ingest_threads_wedged', step=step_now,
+                            count=wedged_now, names=names)
+            if health is not None:
+              health.note_external('ingest_threads_wedged')
+            log.error('ingest watchdog: %d wedged thread(s): %s',
+                      wedged_now, ', '.join(names))
           dt_summary = now - last_ingest_time
           d_unrolls = ing['unrolls'] - last_ingest_snap['unrolls']
           writer.scalar('remote_unrolls_per_sec',

@@ -151,6 +151,12 @@ class HealthMonitor:
     self.rollbacks = 0
     self.halts = 0
     self.last_reason = ''     # why the most recent bad step was bad
+    # External (non-learner-step) incidents other planes report into
+    # the health surface (round 11: the transport watchdog's wedged
+    # ingest threads, reaped half-open connections) — counted per kind
+    # so the drain manifest / postmortem carries them next to the
+    # step-health counters instead of only in summaries.jsonl.
+    self._external: Dict[str, int] = {}
 
   # --- detectors ---
 
@@ -251,6 +257,18 @@ class HealthMonitor:
   def consecutive_bad(self) -> int:
     return self._consecutive_bad
 
+  def note_external(self, kind: str, count: int = 1):
+    """Record an incident another plane detected (transport wedge,
+    connection reap burst). Does NOT feed the escalation ladder —
+    these are not learner-step verdicts — but the counts ride
+    `stats()`/`drain_report()` so the drain manifest and the halt
+    bundle name what the transport plane absorbed."""
+    self._external[kind] = self._external.get(kind, 0) + int(count)
+
+  @property
+  def external_incidents(self) -> Dict[str, int]:
+    return dict(self._external)
+
   def stats(self) -> Dict[str, float]:
     """Counters the driver writes to summaries every interval."""
     return {'skipped_steps': self.skipped_steps,
@@ -269,6 +287,8 @@ class HealthMonitor:
     of re-deriving it from summaries.jsonl."""
     report = dict(self.stats())
     report['last_reason'] = self.last_reason
+    if self._external:
+      report['external_incidents'] = dict(self._external)
     return report
 
   # --- diagnostics ---

@@ -144,6 +144,46 @@ class FpsMeter:
     return sum(delta for _, delta in self._events) / span
 
 
+class ThreadWatchdog:
+  """Liveness ledger for long-running service threads (round 11).
+
+  A wedged thread — an ingest reader stuck mid-recv against a
+  half-open peer, a param-lane selector loop that died, a worker
+  parked forever in a send — used to leak SILENTLY: the socket stayed
+  open, the thread stayed alive, and the only symptom was a slowly
+  starving pipeline. Each service thread `beat()`s once per loop
+  iteration (including idle poll timeouts, so an idle thread is not a
+  wedged thread); `wedged(stall_secs)` names the threads that have
+  made no progress past the deadline. The owner (the ingest server's
+  `stats()`) surfaces the count so the driver can write the
+  `ingest_threads_wedged` summary + incident instead of the operator
+  discovering the leak hours later.
+
+  Thread-safe; registration is idempotent (a beat registers)."""
+
+  def __init__(self):
+    self._beats: Dict[str, float] = {}
+    self._lock = threading.Lock()
+
+  def beat(self, name: str):
+    with self._lock:
+      self._beats[name] = time.monotonic()
+
+  def unregister(self, name: str):
+    with self._lock:
+      self._beats.pop(name, None)
+
+  def names(self) -> List[str]:
+    with self._lock:
+      return sorted(self._beats)
+
+  def wedged(self, stall_secs: float) -> List[str]:
+    """Registered threads with no beat for `stall_secs` (sorted)."""
+    cutoff = time.monotonic() - stall_secs
+    with self._lock:
+      return sorted(n for n, t in self._beats.items() if t < cutoff)
+
+
 class LatencyReservoir:
   """Bounded recent-sample reservoir for latency percentiles
   (thread-safe) — the per-lane transport counters' backing store

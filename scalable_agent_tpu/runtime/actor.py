@@ -18,12 +18,24 @@ Faithfully preserved semantics:
   stats while the carried state resets to zero.
 """
 
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
 from scalable_agent_tpu.structs import (
     ActorOutput, AgentOutput, StepOutput, StepOutputInfo)
+
+# run_actor_loop's put is a POLL (not one unbounded block): each
+# timeout re-checks the stop event, so a stopping/quiescing fleet can
+# join producers parked on a full buffer even when nobody closes it.
+_PUT_POLL_SECS = 0.5
+# After stop is requested, how long a parked producer keeps trying to
+# land its completed unroll before dropping it and exiting (the drain
+# path WANTS the unroll — the learner is flushing and room appears;
+# this bound only fires when nothing is draining, where the old
+# behavior was an unjoinable thread).
+_STOP_PUT_GRACE_SECS = 5.0
 
 
 def _tree_stack(items):
@@ -189,7 +201,27 @@ def run_actor_loop(actor: Actor, buffer, stop_event,
 
   try:
     while not stop_event.is_set():
-      buffer.put(actor.unroll())
+      unroll = actor.unroll()
+      # Poll-put with a stop-aware grace (round 11): an actor parked
+      # on a full buffer used to block UNBOUNDED — quiesce() (which
+      # deliberately keeps the buffer open so in-flight unrolls land)
+      # could never join it unless the learner drained. Now the park
+      # re-checks the stop event every poll; once stopping, the unroll
+      # gets a bounded grace to land (the drain path drains, so it
+      # normally does) and is then dropped — a joined thread with a
+      # named lost unroll beats a wedged one.
+      stop_deadline = None
+      while True:
+        try:
+          buffer.put(unroll, timeout=_PUT_POLL_SECS)
+          break
+        except TimeoutError:
+          if not stop_event.is_set():
+            continue
+          if stop_deadline is None:
+            stop_deadline = time.monotonic() + _STOP_PUT_GRACE_SECS
+          elif time.monotonic() > stop_deadline:
+            return  # stopping and nobody is draining: drop + exit
       if on_unroll is not None and not on_unroll():
         return  # orphaned: a replacement owns this actor's slot
   except (ring_buffer.Closed, BatcherCancelled) as e:

@@ -46,6 +46,27 @@ purpose:
                       and producer-side backpressure (actor put
                       blocking, ingest ack delay, staleness growth)
                       must engage instead of unbounded queueing.
+    conn_partition    RemoteActorClient._rpc (round 11): 'blackhole'
+                      goes silent for `param` seconds WITHOUT closing
+                      the socket — the half-open shape a network
+                      partition/dead NAT entry produces. The learner's
+                      idle reaper must reap the silent connection
+                      within its budget; the client resumes after the
+                      partition "heals" and its next send finds the
+                      reaped socket (reconnect window runs).
+    conn_delay        RemoteActorClient._rpc: 'delay' sleeps exactly
+                      `param` seconds before the send; 'jitter'
+                      sleeps a seeded U[0, param] — injected transport
+                      latency the liveness machinery must tolerate
+                      WITHOUT reaping (delay < idle window).
+    learner_crash     driver.train loop, one event per consumed batch:
+                      'kill' hard-aborts the process with SIGKILL — no
+                      finally blocks, no drain, no 'bye' frame.
+                      kill -9 / OOM made deterministic; only ever
+                      scheduled against a learner running as a CHILD
+                      process (scripts/chaos.py run_partition_storm),
+                      which then restarts it and asserts the
+                      restore-from-LAST_GOOD + fleet re-attach SLOs.
 
 The plan is installed process-globally (`install`/`clear`); sites are
 consulted via `fire(site)` which is a no-op returning None when no
@@ -70,7 +91,8 @@ import time
 from typing import Dict, List, Optional
 
 SITES = ('env_step', 'transport_send', 'checkpoint_save', 'nan_burst',
-         'slot_exhaustion', 'preempt_signal', 'slow_learner')
+         'slot_exhaustion', 'preempt_signal', 'slow_learner',
+         'conn_partition', 'conn_delay', 'learner_crash')
 
 _LEN = struct.Struct('>Q')
 
@@ -171,7 +193,12 @@ class FaultPlan:
             preempt_at: Optional[int] = None,
             slow_learner_at: Optional[int] = None,
             slow_learner_len: int = 0,
-            slow_learner_secs: float = 0.5
+            slow_learner_secs: float = 0.5,
+            conn_partition_at: Optional[int] = None,
+            conn_partition_secs: float = 3.0,
+            conn_delay: Optional[List[int]] = None,
+            conn_delay_secs: float = 0.2,
+            learner_crash_at: Optional[int] = None
             ) -> 'FaultPlan':
     """The scripted multi-fault storm chaos.py runs: one builder so
     the schedule is a pure function of its arguments (+ seed, which
@@ -198,6 +225,14 @@ class FaultPlan:
     for i in range(slow_learner_len):
       faults.append(Fault('slow_learner', (slow_learner_at or 0) + i,
                           'hang', param=slow_learner_secs))
+    if conn_partition_at is not None:
+      faults.append(Fault('conn_partition', conn_partition_at,
+                          'blackhole', param=conn_partition_secs))
+    for idx in conn_delay or []:
+      faults.append(Fault('conn_delay', idx, 'delay',
+                          param=conn_delay_secs))
+    if learner_crash_at is not None:
+      faults.append(Fault('learner_crash', learner_crash_at, 'kill'))
     return cls(faults, seed=seed)
 
 
@@ -319,6 +354,47 @@ def apply_transport_fault(fault: Fault, sock: socket.socket,
     pass
   raise ConnectionError(
       f'injected transport fault: {fault.kind} (index {fault.index})')
+
+
+# --- sites: conn_partition / conn_delay (round 11) ---
+
+
+def apply_conn_partition(fault: Fault) -> None:
+  """Blackhole the connection for `fault.param` seconds: the caller
+  goes completely silent — no send, no recv, NO close — exactly the
+  half-open shape a network partition produces (the peer's socket
+  stays ESTABLISHED with nothing flowing). Returns when the partition
+  'heals'; the caller then proceeds normally and discovers whatever
+  the other side did meanwhile (idle reap → RST on the next send)."""
+  time.sleep(float(fault.param))
+
+
+def apply_conn_delay(fault: Fault, seed: int = 0) -> None:
+  """Injected transport latency: 'delay' sleeps exactly `param`
+  seconds (deterministic — tests assert the floor); 'jitter' sleeps a
+  seeded U[0, param]."""
+  if fault.kind == 'jitter':
+    import numpy as np
+    rng = np.random.RandomState((seed + fault.index) % (2 ** 31))
+    time.sleep(float(rng.uniform(0.0, float(fault.param))))
+  else:
+    time.sleep(float(fault.param))
+
+
+# --- site: learner_crash ---
+
+
+def hard_crash(fault: Fault) -> None:
+  """kill -9 the current process: no exception unwind, no finally
+  blocks, no drain, no 'bye' frame — the OOM-killer/preempt shape the
+  restart story (docs/RUNBOOK.md §8) must survive. Logged first so
+  the chaos harness can tell a scheduled crash from an organic one."""
+  import logging
+  import signal
+  logging.getLogger('scalable_agent_tpu').error(
+      'learner_crash fault firing (index %d): hard-killing pid %d',
+      fault.index, os.getpid())
+  os.kill(os.getpid(), signal.SIGKILL)
 
 
 # --- site: checkpoint_save ---

@@ -297,11 +297,20 @@ class ActorFleet:
       healthy = [s for s in alive
                  if s.error is None and not s.quarantined and
                  now - s.last_heartbeat <= healthy_horizon_secs]
+      # Wedged = alive with NO heartbeat inside the horizon and no
+      # recorded error: the thread runs but produces nothing — the
+      # blocked-in-env.step / parked-on-backpressure shape the
+      # zero-deadlocked-threads chaos SLO counts (an errored slot is
+      # 'dead pending respawn', a different bucket).
+      wedged = [s for s in alive
+                if s.error is None and not s.quarantined and
+                now - s.last_heartbeat > healthy_horizon_secs]
       return {
           'unrolls': sum(s.unrolls_done for s in self._slots),
           'respawns': sum(s.respawns for s in self._slots),
           'alive': len(alive),
           'healthy': len(healthy),
+          'wedged': len(wedged),
           'healthy_fraction': (len(healthy) / len(self._slots)
                                if self._slots else 1.0),
           # Give-up slots (round 9): respawn exhausted its budget —

@@ -56,6 +56,24 @@ Transport planes (round 6 — BENCH_r05 measured all three pathologies):
   (config.publish_codec; measured ratio 0.5 for ~5 ms vs zlib-1's
   0.926 for 209 ms).
 
+Transport fault tolerance (round 11 — docs/TRANSPORT.md v6,
+docs/ROBUSTNESS.md transport rows): every blocking socket path now
+carries a deadline. Server readers poll with short timeouts
+(`_ConnLiveness` — a half-open peer stalling MID-frame is reaped
+instead of pinning its reader forever), sends are progress-bounded
+(`_sendall_bounded` — a non-reading peer can't wedge a worker in
+sendall), an idle reaper closes connections silent past
+`remote_conn_idle_timeout_secs` on both lanes, v6 clients heartbeat
+('ping'/'pong' with the current params version) to stay inside the
+window, ingest workers emit ('busy',) keepalives while backpressure
+holds an ack (slow learner ≠ dead learner), and a per-run SESSION
+EPOCH rides the handshake so a hard-crashed-and-restarted learner
+tells reattaching clients from fresh ones, times the fleet re-attach,
+and provably accepts zero stale-incarnation unrolls
+(`scripts/chaos.py run_partition_storm` asserts the SLOs). A
+`ThreadWatchdog` surfaces any service thread that still wedges
+(stats()['ingest_threads_wedged'] → driver summaries + incidents).
+
 Trust model: pickle over cluster-internal sockets — identical trust to
 the reference's unauthenticated TF gRPC runtime. Never expose the
 ingest port outside the job's network.
@@ -73,7 +91,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from scalable_agent_tpu.observability import LatencyReservoir
+from scalable_agent_tpu.observability import (LatencyReservoir,
+                                              ThreadWatchdog)
 
 import numpy as np
 
@@ -163,21 +182,153 @@ def _send_oob(sock: socket.socket, obj) -> None:
   flush()
 
 
-def _recv_exact(sock: socket.socket, n: int):
+class _FrameStall(OSError):
+  """A peer stopped sending MID-frame past the stall deadline (a
+  half-open connection trickling to silence) — the reader reaps the
+  connection instead of pinning itself on the partial frame forever."""
+
+
+class _ServerClosing(ConnectionError):
+  """The server's close() began while this reader was parked in its
+  poll loop. The reader exits WITHOUT closing or unlisting its
+  connection: close() already holds the shutdown sequence ('bye' →
+  half-close → close) for every listed conn, and a reader racing it
+  with its own close() would discard the buffered 'bye' with an RST
+  (legacy blocking readers never woke here, so the bye always won)."""
+
+
+class _SendStall(OSError):
+  """A send made no progress past the stall deadline (a blackholed /
+  non-reading peer with a full TCP window) — the sender gives up on
+  the connection instead of wedging its thread in sendall forever."""
+
+
+class _ConnLiveness:
+  """Per-connection recv liveness for the server's reader threads
+  (round 11). The socket runs in timeout mode (short poll); every poll
+  expiry lands here:
+
+  - `progress(n)` on received bytes: refreshes the connection's
+    last-recv clock (the reaper's idle measure) and beats the server's
+    thread watchdog.
+  - `idle(got)` on a poll timeout: beats the watchdog (an idle reader
+    is NOT a wedged reader), aborts cleanly when the server is
+    closing, raises `_FrameStall` when the timeout fired MID-frame
+    past the stall deadline (a half-open peer must not pin the reader
+    on a partial frame — `in_frame` spans the WHOLE frame, set by
+    _recv_msg once the header lands, so the deadline cannot reset at
+    sub-frame read boundaries), and emits the ('busy',) backpressure
+    keepalive for a conn whose unroll is in flight — the READER owns
+    the keepalive, so it flows whether the job is held by a worker or
+    still parked in the handoff queue (workers < connections under
+    load). Idle BETWEEN frames with nothing in flight is legal here;
+    the reaper owns that budget (it closes the socket, which surfaces
+    as an OSError in the reader).
+  """
+
+  def __init__(self, conn, closed_event, stall_secs, watchdog=None,
+               name='', heartbeat_secs: float = 0.0):
+    self._conn = conn
+    self._closed = closed_event
+    self._stall_secs = stall_secs
+    self._watchdog = watchdog
+    self._name = name
+    self._heartbeat_secs = heartbeat_secs
+    self._last_busy = time.monotonic()
+    self.in_frame = False  # header received, frame body outstanding
+
+  def beat(self):
+    if self._watchdog is not None:
+      self._watchdog.beat(self._name)
+
+  def progress(self, nbytes):
+    del nbytes
+    self._conn.last_recv = time.monotonic()
+    self._conn.hb_missed = False
+    self.beat()
+
+  def idle(self, got):
+    self.beat()
+    if self._closed.is_set():
+      raise _ServerClosing('server closing')
+    now = time.monotonic()
+    if (got or self.in_frame) and (now - self._conn.last_recv
+                                   > self._stall_secs):
+      raise _FrameStall(
+          f'peer silent mid-frame for more than {self._stall_secs}s')
+    if (self._conn.heartbeat and self._conn.is_waiting_on_us()
+        and self._heartbeat_secs > 0
+        and now - self._last_busy >= self._heartbeat_secs):
+      # Backpressure keepalive: the peer is parked lockstep awaiting
+      # our reply (worker blocked in put OR job still queued) — tell
+      # it we're slow, not dead, at the heartbeat cadence.
+      self._last_busy = now
+      try:
+        self._conn.send(('busy',))
+      except OSError:
+        pass  # peer gone; the recv path will notice
+
+
+def _recv_into(sock: socket.socket, view, n: int, liveness=None) -> int:
+  """Fill view[:n] from the socket; returns bytes received (< n only
+  on EOF). With `liveness`, the socket is expected to be in timeout
+  mode: poll expiries route to liveness.idle (which may raise to abort
+  a stalled frame) and received bytes to liveness.progress."""
+  got = 0
+  while got < n:
+    try:
+      r = sock.recv_into(view[got:n])
+    except socket.timeout:
+      if liveness is None:
+        raise
+      liveness.idle(got)
+      continue
+    if r == 0:
+      return got  # EOF
+    got += r
+    if liveness is not None:
+      liveness.progress(r)
+  return got
+
+
+def _recv_exact(sock: socket.socket, n: int, liveness=None):
   """n bytes as a bytearray (writable — OOB array views alias it), or
   None on clean EOF."""
   buf = bytearray(n)
-  view = memoryview(buf)
-  got = 0
-  while got < n:
-    r = sock.recv_into(view[got:])
-    if r == 0:
-      return None  # clean EOF
-    got += r
+  got = _recv_into(sock, memoryview(buf), n, liveness)
+  if got == 0:
+    return None  # clean EOF
+  if got < n:
+    return None  # EOF mid-read; callers map non-header Nones to errors
   return buf
 
 
-def _recv_msg(sock: socket.socket):
+def _sendall_bounded(sock: socket.socket, data, stall_secs: float,
+                     beat=None) -> None:
+  """sendall with a NO-PROGRESS deadline, for sockets in timeout mode:
+  a live-but-slow peer keeps the transfer going chunk by chunk (each
+  successful send resets the clock — a big snapshot over a thin pipe
+  is fine), while a blackholed/non-reading peer whose TCP window
+  filled makes no progress and aborts with `_SendStall` instead of
+  wedging the sending thread forever."""
+  view = memoryview(data)
+  last_progress = time.monotonic()
+  while view.nbytes:
+    try:
+      sent = sock.send(view)
+    except socket.timeout:
+      if beat is not None:
+        beat()
+      if time.monotonic() - last_progress > stall_secs:
+        raise _SendStall(
+            f'send made no progress for more than {stall_secs}s '
+            f'({view.nbytes} byte(s) unsent)')
+      continue
+    view = view[sent:]
+    last_progress = time.monotonic()
+
+
+def _recv_msg(sock: socket.socket, liveness=None):
   """One message (either frame kind), or None on clean EOF.
 
   OOB frames recv each array buffer straight into its own
@@ -185,24 +336,38 @@ def _recv_msg(sock: socket.socket):
   used to land in a zero-filled bytearray first — ~95 µs of memset
   holding the GIL per message, one of the two per-message costs that
   kept multi-connection ingest from scaling (round 6)."""
-  header = _recv_exact(sock, _LEN.size)
+  header = _recv_exact(sock, _LEN.size, liveness)
   if header is None:
     return None
   (length,) = _LEN.unpack(header)
   if length > _MAX_MSG:
     raise ValueError(f'message length {length} exceeds bound')
-  tag = _recv_exact(sock, 1)
+  if liveness is not None:
+    # The frame has begun: from here to return, peer silence past the
+    # stall window is a half-open MID-frame stall — the flag spans
+    # every sub-frame read, so the deadline cannot reset at
+    # _recv_exact boundaries.
+    liveness.in_frame = True
+  try:
+    return _recv_msg_body(sock, length, liveness)
+  finally:
+    if liveness is not None:
+      liveness.in_frame = False
+
+
+def _recv_msg_body(sock: socket.socket, length: int, liveness):
+  tag = _recv_exact(sock, 1, liveness)
   if tag is None:
     raise ConnectionError('EOF mid-message')
   kind = tag[0]
   if kind == _FRAME_PLAIN:
-    payload = _recv_exact(sock, length - 1)
+    payload = _recv_exact(sock, length - 1, liveness)
     if payload is None:
       raise ConnectionError('EOF mid-message')
     return pickle.loads(memoryview(payload))
   if kind == _FRAME_OOB:
     head_len = _OOB_META.size
-    head = _recv_exact(sock, head_len)
+    head = _recv_exact(sock, head_len, liveness)
     if head is None:
       raise ConnectionError('EOF mid-message')
     nbufs, skel_len = _OOB_META.unpack(head)
@@ -215,7 +380,8 @@ def _recv_msg(sock: socket.socket):
       raise ValueError(
           f'OOB header inconsistent with frame length {length}: '
           f'{nbufs} buffers, skeleton {skel_len}')
-    table = _recv_exact(sock, skel_len + _OOB_BUFLEN.size * nbufs)
+    table = _recv_exact(sock, skel_len + _OOB_BUFLEN.size * nbufs,
+                        liveness)
     if table is None:
       raise ConnectionError('EOF mid-message')
     view = memoryview(table)
@@ -230,12 +396,8 @@ def _recv_msg(sock: socket.socket):
     buffers = []
     for size in sizes:
       buf = memoryview(np.empty(int(size), np.uint8))
-      got = 0
-      while got < size:
-        r = sock.recv_into(buf[got:])
-        if r == 0:
-          raise ConnectionError('EOF mid-message')
-        got += r
+      if _recv_into(sock, buf, int(size), liveness) < size:
+        raise ConnectionError('EOF mid-message')
       buffers.append(buf)
     return pickle.loads(skeleton, buffers=buffers)
   raise ValueError(f'unknown frame kind {kind}')
@@ -256,6 +418,14 @@ class ProtocolError(RuntimeError):
   always a version-skewed peer (e.g. a pre-v4 role whose frames are
   untagged). Terminal: retrying against the same peer cannot succeed,
   so actors surface this instead of burning their reconnect window."""
+
+
+class SessionEpochMismatch(ConnectionError):
+  """The learner refused an unroll stamped with a FOREIGN session
+  epoch ('stale_epoch' reply): this client's handshake belongs to a
+  learner incarnation that no longer exists. A ConnectionError on
+  purpose — the reconnect path (full re-handshake, fresh epoch +
+  params) is exactly the right response."""
 
 
 class Backoff:
@@ -322,7 +492,40 @@ class Backoff:
 # ('stale', current_version) reply instead of an ack. Old servers
 # ignore the extra element; old clients read 'stale' as an ack whose
 # version triggers exactly the refetch the reply intends.
-PROTOCOL_VERSION = 5
+# v6 (round 11): connection liveness + the hard-crash restart story,
+# v5-COMPATIBLE both ways (the handshake accepts any protocol in
+# _COMPATIBLE_PROTOCOLS and negotiates the new machinery OFF for v5
+# peers — the same extension pattern as the round-9 staleness field):
+#   - params replies carry a 4th element, the server-info dict
+#     {'protocol', 'session_epoch', 'heartbeat_secs',
+#     'idle_timeout_secs'} (old clients index [0..2] and never see
+#     it); 'hello' MAY carry a 3rd element, the client-info dict
+#     {'epoch': last-known session epoch} — a restarted learner tells
+#     REATTACHING clients (prior epoch != current) from fresh ones and
+#     records the fleet re-attach latency.
+#   - 'ping' on either lane answers ('pong', current_version) — the
+#     application-level heartbeat idle clients send so the server's
+#     idle reaper can tell live-but-quiet from half-open/dead (and an
+#     idle fleet still learns about new publishes from the pong).
+#   - ('busy',) keepalives: while an ack is held back by buffer
+#     backpressure the server emits 'busy' at the heartbeat cadence to
+#     v6 peers — a slow learner stays tellable from a dead one, so the
+#     client's I/O deadline can be tight without breaking the
+#     backpressure contract. v6 clients skip them; v5 peers never get
+#     them.
+#   - 'unroll' frames MAY carry a 4th element, the session epoch the
+#     client handshook under; a server seeing a FOREIGN epoch refuses
+#     with ('stale_epoch', current_epoch) — the client re-handshakes.
+#     Structurally unreachable over plain TCP (the connection dies
+#     with the learner process), but it makes "zero stale-epoch
+#     unrolls accepted across a restart" an asserted invariant instead
+#     of an assumption (chaos.py run_partition_storm).
+PROTOCOL_VERSION = 6
+
+# Handshakes accepted without negotiation failure: v5 peers get the
+# round-9 wire exactly (no heartbeats, no busy keepalives, no epoch
+# checks); everything else about the lanes is unchanged.
+_COMPATIBLE_PROTOCOLS = (5, 6)
 
 # Bound on the reader→worker handoff queue. The request→reply
 # lockstep already implies at most one in-flight unroll per live
@@ -442,9 +645,15 @@ def contract_mismatch_message(expected, offered) -> Optional[str]:
     return ('actor sent a legacy hello with no contract (protocol < '
             f'{PROTOCOL_VERSION}); upgrade the actor host')
   problems = []
-  if offered.get('protocol') != expected['protocol']:
+  # v6 is v5-compatible: a peer offering any protocol in the
+  # compatible set handshakes fine (the v6-only machinery — heartbeat
+  # pings, busy keepalives, epoch stamps — negotiates OFF per
+  # connection for v5 peers); anything else is a true skew.
+  offered_protocol = offered.get('protocol')
+  if (offered_protocol != expected['protocol']
+      and offered_protocol not in _COMPATIBLE_PROTOCOLS):
     problems.append(f"protocol: learner={expected['protocol']} "
-                    f"actor={offered.get('protocol')}")
+                    f"actor={offered_protocol}")
   for key in sorted(set(expected['fields']) |
                     set(offered.get('fields', {}))):
     e = expected['fields'].get(key, '<missing>')
@@ -562,29 +771,82 @@ class FastUnrollValidator:
 
 class _Conn:
   """One actor connection: socket + send lock (worker threads and
-  close()'s 'bye' frame must not interleave writes mid-message)."""
+  close()'s 'bye' frame must not interleave writes mid-message).
 
-  def __init__(self, sock: socket.socket, addr=None):
+  Liveness fields (round 11): `last_recv` is the reaper's idle clock
+  (refreshed on EVERY received byte, so a trickling half-open peer is
+  distinguishable from a live slow one); `protocol`/`heartbeat` are
+  negotiated at hello (v5 peers get no busy keepalives and no
+  heartbeat-miss accounting); `reaped` marks a reaper-initiated close
+  so the reader's unwind logs/counts it once. When `send_stall_secs`
+  is set (liveness mode — the socket runs short poll timeouts), every
+  send path is progress-bounded: a non-reading peer aborts the send
+  with `_SendStall` instead of wedging the sending thread."""
+
+  def __init__(self, sock: socket.socket, addr=None,
+               send_stall_secs: Optional[float] = None,
+               base_timeout: Optional[float] = None):
     self.sock = sock
     self.addr = addr
     self.send_lock = threading.Lock()
+    self.send_stall_secs = send_stall_secs
+    # The socket timeout try_send must RESTORE (None = blocking legacy
+    # mode; the reader's poll interval in liveness mode — restoring
+    # None there would silently turn the reader's bounded recv
+    # back into an unbounded one).
+    self.base_timeout = base_timeout
     # Per-connection ingest ledger (observability: the driver reports
     # unrolls/sec per connection from deltas of these; stale
     # rejections are counted per connection so one starved/lagging
     # host is tellable from a uniformly stale fleet).
     self.unrolls = 0
     self.stale_rejected = 0
+    # Liveness state.
+    self.last_recv = time.monotonic()
+    self.protocol = 5          # until a hello says otherwise
+    self.heartbeat = False     # negotiated: v6 peer + server heartbeat
+    self.hb_missed = False     # current silence window already counted
+    self.reaped = False        # reaper-initiated close in progress
+    # Unrolls handed to the worker pool whose ack has not gone out
+    # yet. A LOCKSTEP client is silent BY PROTOCOL while its unroll is
+    # in flight (it may be parked for minutes behind buffer
+    # backpressure) — the reaper and the heartbeat-miss counter must
+    # exempt such conns or they would reap/flag protocol-obedient
+    # peers exactly when the learner is slowest.
+    self.inflight = 0
+    self.inflight_lock = threading.Lock()
+
+  def job_started(self):
+    with self.inflight_lock:
+      self.inflight += 1
+
+  def job_finished(self):
+    with self.inflight_lock:
+      self.inflight -= 1
+
+  def is_waiting_on_us(self) -> bool:
+    with self.inflight_lock:
+      return self.inflight > 0
+
+  def _write(self, data) -> None:
+    """One bounded-or-legacy write; callers hold send_lock."""
+    if self.send_stall_secs is not None:
+      _sendall_bounded(self.sock, data, self.send_stall_secs)
+    else:
+      self.sock.sendall(data)
 
   def send(self, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     with self.send_lock:
-      _send_msg(self.sock, obj)
+      self._write(_LEN.pack(len(payload) + 1) + bytes((_FRAME_PLAIN,))
+                  + payload)
 
   def send_bytes(self, payload: bytes) -> None:
     """Ship pre-serialized bytes (a cached plain frame): handler
     threads must not re-pickle the whole tree per request."""
     with self.send_lock:
-      self.sock.sendall(_LEN.pack(len(payload) + 1)
-                        + bytes((_FRAME_PLAIN,)) + payload)
+      self._write(_LEN.pack(len(payload) + 1)
+                  + bytes((_FRAME_PLAIN,)) + payload)
 
   def send_segments(self, segments) -> None:
     """Ship a pre-built wire frame as its segments (the cached param
@@ -592,7 +854,7 @@ class _Conn:
     giant bytes object first."""
     with self.send_lock:
       for seg in segments:
-        self.sock.sendall(seg)
+        self._write(seg)
 
   def try_send(self, obj, timeout: float = 2.0) -> bool:
     """Bounded best-effort send: never blocks shutdown behind a stuck
@@ -608,7 +870,7 @@ class _Conn:
       return False
     finally:
       try:
-        self.sock.settimeout(None)
+        self.sock.settimeout(self.base_timeout)
       except OSError:
         pass
       self.send_lock.release()
@@ -632,14 +894,23 @@ class _ParamLane:
   memoryviews of bytes the publisher already built.
   """
 
-  def __init__(self, blob_fn, chunk_bytes: int = 128 * 1024):
+  def __init__(self, blob_fn, chunk_bytes: int = 128 * 1024,
+               idle_timeout_secs: float = 0.0,
+               watchdog: Optional[ThreadWatchdog] = None):
     self._blob_fn = blob_fn  # () -> cached COMPLETE frame segments
     self._chunk = chunk_bytes
+    self._idle_timeout = float(idle_timeout_secs)
+    self._watchdog = watchdog
     self._selector = selectors.DefaultSelector()
     self._lock = threading.Lock()  # guards adopt vs close
     self._closed = False
     self._blobs_served = 0
     self._bytes_sent = 0
+    # Fan-out shrinkage ledger (round 11): EVERY dropped subscriber is
+    # counted — a param lane that quietly loses hosts used to be
+    # invisible until the fleet's params went uniformly stale.
+    self._subs_dropped = 0
+    self._subs_reaped = 0   # the idle/half-open subset of the drops
     # Self-pipe: adopt()/close() must wake a parked select().
     self._wake_r, self._wake_w = socket.socketpair()
     self._wake_r.setblocking(False)
@@ -656,6 +927,7 @@ class _ParamLane:
       self.sock = sock
       self.rbuf = bytearray()
       self.out: List[memoryview] = []  # remaining reply bytes
+      self.last_recv = time.monotonic()  # idle-reaping clock
 
   def adopt(self, sock: socket.socket) -> bool:
     """Hand a connected socket to the lane (called from the accept
@@ -672,9 +944,15 @@ class _ParamLane:
 
   def stats(self):
     with self._lock:
-      return {'blobs': self._blobs_served, 'bytes': self._bytes_sent}
+      return {'blobs': self._blobs_served, 'bytes': self._bytes_sent,
+              'subs_dropped': self._subs_dropped,
+              'subs_reaped': self._subs_reaped}
 
-  def _drop(self, sub):
+  def _drop(self, sub, reaped: bool = False):
+    with self._lock:
+      self._subs_dropped += 1
+      if reaped:
+        self._subs_reaped += 1
     try:
       self._selector.unregister(sub.sock)
     except (KeyError, ValueError):
@@ -702,6 +980,7 @@ class _ParamLane:
       return False
     if not data:
       return False
+    sub.last_recv = time.monotonic()
     sub.rbuf += data
     while True:
       if len(sub.rbuf) < _LEN.size:
@@ -724,13 +1003,19 @@ class _ParamLane:
         log.warning('param lane: unparseable request (%r); dropping '
                     'subscriber', e)
         return False
-      if kind in ('get_params', 'hello_params'):
+      if kind in ('get_params', 'hello_params', 'ping'):
         # hello_params may arrive here when the peer pipelined it with
         # its first fetch; it needs no reply of its own.
         if kind == 'get_params':
           with self._lock:
             self._blobs_served += 1
           self._queue_segments(sub, self._blob_fn())
+        elif kind == 'ping':
+          # The v6 keepalive: an idle subscriber pings inside the
+          # reaping window; the pong keeps the conversation protocol-
+          # shaped (and last_recv above already refreshed the clock).
+          self._queue_reply(sub, pickle.dumps(
+              ('pong',), protocol=pickle.HIGHEST_PROTOCOL))
       else:
         self._queue_reply(sub, pickle.dumps(
             ('error', f'param lane only serves get_params, got '
@@ -769,6 +1054,8 @@ class _ParamLane:
 
   def _loop_body(self):
     while True:
+      if self._watchdog is not None:
+        self._watchdog.beat('param-lane')
       with self._lock:
         if self._closed:
           return
@@ -780,6 +1067,20 @@ class _ParamLane:
                                   self._Sub(sock))
         except (KeyError, ValueError, OSError):
           sock.close()
+      # Idle/half-open subscriber reaping (round 11): a silent sub
+      # past the window is dropped HERE, on the lane thread — selector
+      # mutation must never race the select loop. A live v6 client
+      # pings inside the window; a sub mid-reply (pending out) is
+      # making progress on the write side and is left alone.
+      if self._idle_timeout > 0:
+        cutoff = time.monotonic() - self._idle_timeout
+        stale = [key.data for key in self._selector.get_map().values()
+                 if key.data is not None and not key.data.out
+                 and key.data.last_recv < cutoff]
+        for sub in stale:
+          log.warning('param lane: reaping idle subscriber (silent '
+                      'for > %.1fs)', self._idle_timeout)
+          self._drop(sub, reaped=True)
       for key, events in self._selector.select(timeout=0.5):
         if key.data is None:  # wake pipe
           try:
@@ -796,22 +1097,59 @@ class _ParamLane:
         if not ok:
           self._drop(sub)
 
-  def close(self):
+  def close(self, graceful: bool = True) -> int:
+    """Shut the lane down; returns the join-deadline-missed thread
+    count (0 or 1 — the selector thread), which the owning server
+    folds into its `unjoined_threads` stat instead of dropping.
+
+    graceful=True answers every live subscriber with a ('bye',) frame
+    before the close (best-effort, non-blocking — the sockets are
+    already non-blocking): a subscriber parked in recv gets a clean
+    LearnerShutdown instead of a raw EOF it must diagnose. Crash-path
+    closes (graceful=False) skip it — actors must keep their reconnect
+    window."""
     with self._lock:
       if self._closed:
-        return
+        return 0
       self._closed = True
     try:
       self._wake_w.send(b'x')
     except OSError:
       pass
     self._thread.join(timeout=5.0)
+    unjoined = 1 if self._thread.is_alive() else 0
+    if unjoined:
+      # The leaked thread still OWNS the selector and its sockets: a
+      # teardown here would race its select loop (use-after-close on
+      # the selector, corrupted mid-chunk replies). Leak the lot with
+      # the thread — counted and named; the process is going away.
+      log.warning('param lane close: selector thread missed the join '
+                  'deadline and leaks as a daemon (selector/sockets '
+                  'leaked with it)')
+      return unjoined
+    if graceful:
+      bye = pickle.dumps(('bye',), protocol=pickle.HIGHEST_PROTOCOL)
+      frame = (_LEN.pack(len(bye) + 1) + bytes((_FRAME_PLAIN,)) + bye)
+      for key in list(self._selector.get_map().values()):
+        # Only subscribers with NO partially-sent reply: appending the
+        # bye where a client expects the rest of a chunked params
+        # frame would corrupt the stream mid-message (that sub gets
+        # the EOF path instead — indistinguishable from a crash, which
+        # its half-fetched state already is).
+        if key.data is not None and not key.data.out:
+          try:
+            key.fileobj.send(frame)  # non-blocking best effort
+          except OSError:
+            pass
     for key in list(self._selector.get_map().values()):
       if key.data is not None:
         key.fileobj.close()
     self._selector.close()
     self._wake_r.close()
     self._wake_w.close()
+    if self._watchdog is not None:
+      self._watchdog.unregister('param-lane')
+    return unjoined
 
 
 class TrajectoryIngestServer:
@@ -853,13 +1191,27 @@ class TrajectoryIngestServer:
       lag; this bounds it at the ADMISSION seam instead of letting a
       lagging host poison the batch mix (IMPACT's staleness window,
       arXiv:1912.00167, applied at ingest).
+    heartbeat_secs: v6 connection-liveness cadence (round 11;
+      config.remote_heartbeat_secs): v6 clients ping at this interval
+      when idle, and ingest workers emit ('busy',) keepalives at this
+      cadence to v6 peers while an ack is held back by buffer
+      backpressure. 0 disables (v5 wire exactly).
+    idle_timeout_secs: idle/half-open reaping window (round 11;
+      config.remote_conn_idle_timeout_secs): a connection — either
+      lane — that received NO bytes for this long is reaped
+      (stats()['conns_reaped']), and it doubles as the mid-frame
+      recv stall and send no-progress deadline on every blocking
+      socket path. 0 disables reaping AND deadlines (pre-round-11
+      behavior: a half-open peer pins its reader forever).
   """
 
   def __init__(self, buffer, params, host: str = '127.0.0.1',
                port: int = 0, contract=None,
                wire_dtype: Optional[str] = None,
                ingest_workers: int = 0,
-               max_unroll_staleness: int = 0):
+               max_unroll_staleness: int = 0,
+               heartbeat_secs: float = 0.0,
+               idle_timeout_secs: float = 0.0):
     if wire_dtype not in (None, '', 'bfloat16'):
       raise ValueError(f'unsupported wire_dtype {wire_dtype!r}')
     self._wire_bf16 = wire_dtype == 'bfloat16'
@@ -868,6 +1220,33 @@ class TrajectoryIngestServer:
     self._max_staleness = int(max_unroll_staleness)
     self._validate = (FastUnrollValidator(contract)
                       if contract is not None else None)
+    # --- Connection liveness (round 11). A per-run session epoch
+    # rides every params reply: a restarted learner's epoch differs,
+    # so reattaching clients are tellable from fresh ones (and from
+    # clients of a DIFFERENT learner incarnation — the stale-epoch
+    # unroll guard). Wall-clock microseconds + pid: unique across
+    # restarts of the same port without any on-disk state.
+    self.session_epoch = ((int(time.time() * 1e6) << 10)
+                          ^ (os.getpid() & 0x3ff))
+    self._t_start = time.monotonic()
+    self._heartbeat_secs = float(heartbeat_secs)
+    self._idle_timeout = float(idle_timeout_secs)
+    self._liveness_on = (self._heartbeat_secs > 0
+                         or self._idle_timeout > 0)
+    # Mid-frame/send stall deadline: the idle window when set, else a
+    # heartbeat-derived floor (a frame should never trickle longer
+    # than a few missed heartbeats).
+    self._stall_secs = (self._idle_timeout if self._idle_timeout > 0
+                        else max(3 * self._heartbeat_secs, 10.0))
+    # Reader/reaper poll interval: short enough that fast test windows
+    # (idle 0.5 s) resolve, bounded below so we never spin.
+    polls = [1.0]
+    if self._idle_timeout > 0:
+      polls.append(self._idle_timeout / 4)
+    if self._heartbeat_secs > 0:
+      polls.append(self._heartbeat_secs / 2)
+    self._poll_secs = max(min(polls), 0.05)
+    self._watchdog = ThreadWatchdog()
     self._params_lock = threading.Lock()
     self._version = 1
     self._blob_version = 1
@@ -884,6 +1263,14 @@ class TrajectoryIngestServer:
     self._quarantined = 0  # connections dropped for unparseable frames
     self._connections = 0
     self._param_subscribers = 0  # cumulative hello_params adoptions
+    # Liveness/restart counters (round 11).
+    self._conns_reaped = 0       # idle/half-open connections closed
+    self._heartbeat_misses = 0   # v6 conns silent past 2x heartbeat
+    self._stale_epoch_rejected = 0  # unrolls from a dead incarnation
+    self._reattached = 0         # hellos carrying a FOREIGN prior epoch
+    self._reconnected = 0        # hellos carrying OUR epoch (same run)
+    self._reattach_latency = 0.0  # last reattach: secs since start
+    self._unjoined_threads = 0   # close()-time join-deadline misses
     self._ack_reservoir = LatencyReservoir()
     self._closed = threading.Event()
     # Threads/conns are appended by the accept loop, pruned as peers
@@ -903,16 +1290,29 @@ class TrajectoryIngestServer:
       ingest_workers = max(1, min(4, os.cpu_count() or 1))
     self._workers = [
         threading.Thread(target=self._ingest_worker,
+                         args=(f'ingest-worker-{i}',),
                          name=f'ingest-worker-{i}', daemon=True)
         for i in range(ingest_workers)]
     for w in self._workers:
       w.start()
-    self._param_lane = _ParamLane(self._snapshot_frame)
+    self._param_lane = _ParamLane(self._snapshot_frame,
+                                  idle_timeout_secs=self._idle_timeout,
+                                  watchdog=self._watchdog)
     self._listener = socket.create_server((host, port))
     self.port = self._listener.getsockname()[1]
     self._accept_thread = threading.Thread(
         target=self._accept_loop, name='ingest-accept', daemon=True)
     self._accept_thread.start()
+    # Idle/half-open reaper (round 11): the one thread that owns the
+    # between-frames idle budget — it closes a silent peer's socket,
+    # which wakes the blocked reader with an OSError and runs the
+    # normal disconnect cleanup. Mid-frame stalls abort faster on the
+    # reader itself (_ConnLiveness).
+    self._reaper_thread = None
+    if self._idle_timeout > 0:
+      self._reaper_thread = threading.Thread(
+          target=self._reap_loop, name='ingest-reaper', daemon=True)
+      self._reaper_thread.start()
 
   def _make_blob(self, version, params) -> List[bytes]:
     """One published version as its COMPLETE wire frame, in segments
@@ -928,15 +1328,24 @@ class TrajectoryIngestServer:
     the core with the unroll pump's acks."""
     with self._params_lock:
       self._serializations += 1  # test hook: must be once per version
+    # v6: server info rides every params reply as a 4th element (old
+    # clients index [0..2] and never see it). The hello reply IS a
+    # params reply, so this is also how a client learns the session
+    # epoch and the negotiated heartbeat cadence — no extra frame, no
+    # extra version field on the wire.
+    info = {'protocol': PROTOCOL_VERSION,
+            'session_epoch': self.session_epoch,
+            'heartbeat_secs': self._heartbeat_secs,
+            'idle_timeout_secs': self._idle_timeout}
     if self._wire_bf16:
       import jax
       import ml_dtypes
       params = jax.tree_util.tree_map(
           lambda x: x.astype(ml_dtypes.bfloat16)
           if getattr(x, 'dtype', None) == np.float32 else x, params)
-      obj = ('params_bf16', version, params)
+      obj = ('params_bf16', version, params, info)
     else:
-      obj = ('params', version, params)
+      obj = ('params', version, params, info)
     return _oob_frame_segments(obj)
 
   def publish_params(self, params) -> int:
@@ -973,6 +1382,7 @@ class TrajectoryIngestServer:
       per_conn_stale = {f'{c.addr}': c.stale_rejected
                         for c in self._conns if c.stale_rejected}
     lane = self._param_lane.stats()
+    wedged = self._wedged_threads()
     ack_p50_ms, ack_p99_ms = self._ack_reservoir.percentile_ms(
         0.5, 0.99)
     with self._stats_lock:
@@ -999,18 +1409,136 @@ class TrajectoryIngestServer:
               'ack_p99_ms': ack_p99_ms,
               'param_blobs': lane['blobs'],
               'param_bytes': lane['bytes'],
-              'param_subscribers': self._param_subscribers}
+              'param_subscribers': self._param_subscribers,
+              # Fan-out shrinkage (round 11 satellite): EVERY dropped
+              # param-lane subscriber — disconnects, protocol errors,
+              # idle reaps — so a quietly shrinking fleet is visible
+              # in the driver summaries, not just in missing hosts.
+              'param_subs_dropped': lane['subs_dropped'],
+              'param_subs_reaped': lane['subs_reaped'],
+              # Liveness/restart counters (round 11): reaped
+              # idle/half-open connections, v6 peers silent past 2x
+              # their heartbeat (the leading indicator before a
+              # reap), unrolls refused for carrying a dead
+              # incarnation's epoch (asserted ZERO by the partition
+              # storm), and the fleet re-attach ledger a restarted
+              # learner reports (count + seconds from server start to
+              # the latest cross-epoch hello).
+              'conns_reaped': self._conns_reaped,
+              'heartbeat_misses': self._heartbeat_misses,
+              'stale_epoch_rejected': self._stale_epoch_rejected,
+              'reattached': self._reattached,
+              'reconnected': self._reconnected,
+              'reattach_latency_secs': round(self._reattach_latency, 3),
+              'session_epoch': self.session_epoch,
+              # Wedged-thread watchdog: service threads (readers,
+              # workers, param lane, reaper) that made no progress
+              # past the stall deadline — the silent-leak failure the
+              # round-11 deadlines exist to prevent, surfaced instead
+              # of assumed away.
+              'ingest_threads_wedged': len(wedged),
+              'wedged_thread_names': wedged,
+              'unjoined_threads': self._unjoined_threads}
 
-  def _ingest_worker(self):
+  def _wedged_threads(self) -> List[str]:
+    """Service threads with no watchdog beat past the stall deadline.
+    Liveness mode only: without poll timeouts an idle reader
+    legitimately never beats, so the watchdog would cry wolf."""
+    if not self._liveness_on:
+      return []
+    return self._watchdog.wedged(max(3 * self._stall_secs, 15.0))
+
+  def _reap_loop(self):
+    """Close connections (either lane handles its own sockets — this
+    covers the trajectory lane) that received nothing inside the idle
+    window; count heartbeat misses on v6 conns as the leading
+    indicator. The close wakes the connection's blocked reader with an
+    OSError; its normal unwind prunes the conn list."""
+    while not self._closed.wait(max(self._poll_secs / 2, 0.05)):
+      self._watchdog.beat('ingest-reaper')
+      now = time.monotonic()
+      with self._conns_lock:
+        conns = list(self._conns)
+      for conn in conns:
+        if conn.is_waiting_on_us():
+          # An unroll is in flight on this conn: the peer is parked
+          # awaiting OUR ack (lockstep) — its silence is the protocol
+          # working, not a half-open link. Backpressure can hold the
+          # ack far past any idle window; reaping here would kill a
+          # protocol-obedient peer and duplicate its unroll on
+          # reconnect (the 'slow learner != dead learner' contract).
+          continue
+        # v5 peers CANNOT ping (no heartbeat machinery), so a
+        # live-but-slow v5 actor (long episodes, mixed-version fleet
+        # mid-upgrade) would be indistinguishable from half-open at
+        # the v6 window — give them a generous multiple: half-open v5
+        # conns still reap (bounded leak, not forever), slow live
+        # ones survive any sane unroll cadence.
+        idle_window = (self._idle_timeout if conn.heartbeat
+                       else 5 * self._idle_timeout)
+        silent = now - conn.last_recv
+        if (conn.heartbeat and not conn.hb_missed
+            and silent > 2 * self._heartbeat_secs):
+          conn.hb_missed = True
+          with self._stats_lock:
+            self._heartbeat_misses += 1
+          log.warning('remote actor %s missed its heartbeat window '
+                      '(silent %.1fs, cadence %.1fs)', conn.addr,
+                      silent, self._heartbeat_secs)
+        if silent > idle_window and not conn.reaped:
+          conn.reaped = True
+          with self._stats_lock:
+            self._conns_reaped += 1
+          log.warning('reaping idle/half-open connection %s (silent '
+                      '%.1fs > %.1fs window)', conn.addr, silent,
+                      self._idle_timeout)
+          try:
+            conn.sock.shutdown(socket.SHUT_RDWR)
+          except OSError:
+            pass
+          try:
+            conn.sock.close()
+          except OSError:
+            pass
+    self._watchdog.unregister('ingest-reaper')
+
+  def _ingest_worker(self, name: str = 'ingest-worker'):
     """Validate/commit/ack loop — the trajectory lane's half that must
     not run on the reader thread (r5: recv + validate + put + ack
     serialized per connection made 4 connections slower than 1)."""
+    try:
+      self._ingest_worker_loop(name)
+    finally:
+      # EVERY exit path (sentinel, closed flag, Closed mid-put) must
+      # retire the watchdog entry, or a cleanly-exited worker reads
+      # as wedged forever in post-close stats.
+      self._watchdog.unregister(name)
+
+  def _ingest_worker_loop(self, name: str):
     while True:
-      job = self._ingest_q.get()
+      self._watchdog.beat(name)
+      try:
+        job = self._ingest_q.get(timeout=1.0)
+      except queue.Empty:
+        if self._closed.is_set():
+          return
+        continue
       if job is None:
         return
-      conn, unroll, t_recv, client_version = job
+      conn, unroll, t_recv, client_version, client_epoch = job
       try:
+        if (client_epoch is not None
+            and client_epoch != self.session_epoch):
+          # A dead incarnation's unroll (v6 epoch stamp): refuse it
+          # WITHOUT touching the buffer. Structurally unreachable over
+          # plain TCP — the counter is the partition storm's proof
+          # that zero stale-epoch unrolls crossed a restart, and the
+          # guard that keeps that true if a proxy/load-balancer ever
+          # sits in front of the port.
+          with self._stats_lock:
+            self._stale_epoch_rejected += 1
+          conn.send(('stale_epoch', self.session_epoch))
+          continue
         if self._max_staleness and client_version is not None:
           with self._params_lock:
             current = self._version
@@ -1039,6 +1567,11 @@ class TrajectoryIngestServer:
         # Blocking put IS the backpressure: the delayed ack holds the
         # remote pump exactly like the reference's remote enqueue
         # into the capacity-1 queue. Poll so close() can interrupt.
+        # The ('busy',) keepalive that tells a v6 peer "slow, not
+        # dead" meanwhile is the READER's job (_ConnLiveness.idle) —
+        # it covers this wait AND a job still parked in the handoff
+        # queue behind other connections (workers < connections under
+        # load), which no worker-side emission could.
         while True:
           try:
             self._buffer.put(unroll, timeout=1.0)
@@ -1046,6 +1579,7 @@ class TrajectoryIngestServer:
           except TimeoutError:
             if self._closed.is_set():
               return
+            self._watchdog.beat(name)
         with self._stats_lock:
           self._unrolls += 1
         conn.unrolls += 1
@@ -1059,6 +1593,11 @@ class TrajectoryIngestServer:
         pass  # peer gone mid-ack; its reader notices and cleans up
       except Exception:
         log.exception('ingest worker failed on an unroll')
+      finally:
+        # The reply (ack/stale/reject/busy-abandon) is out, or the
+        # conn is dead either way: this unroll is no longer in flight,
+        # so the conn's silence becomes a liveness signal again.
+        conn.job_finished()
 
   def _accept_loop(self):
     while not self._closed.is_set():
@@ -1067,7 +1606,15 @@ class TrajectoryIngestServer:
       except OSError:
         return  # listener closed
       conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-      wrapped = _Conn(conn, addr=addr)
+      if self._liveness_on:
+        # Timeout mode: the reader polls (so stalls are detectable and
+        # the watchdog sees beats) and every send is progress-bounded.
+        conn.settimeout(self._poll_secs)
+        wrapped = _Conn(conn, addr=addr,
+                        send_stall_secs=self._stall_secs,
+                        base_timeout=self._poll_secs)
+      else:
+        wrapped = _Conn(conn, addr=addr)
       t = threading.Thread(target=self._serve, args=(wrapped, addr),
                            name=f'ingest-{addr}', daemon=True)
       with self._conns_lock:
@@ -1098,22 +1645,69 @@ class TrajectoryIngestServer:
     # restarts that may have changed the config).
     handshaken = self._contract is None
     adopted = False
+    leave_to_close = False  # close() owns the socket/list teardown
+    liveness = None
+    thread_name = f'ingest-reader-{addr}'
+    if self._liveness_on:
+      liveness = _ConnLiveness(conn, self._closed, self._stall_secs,
+                               watchdog=self._watchdog,
+                               name=thread_name,
+                               heartbeat_secs=self._heartbeat_secs)
+      liveness.beat()
     try:
       while not self._closed.is_set():
-        msg = _recv_msg(conn.sock)
+        msg = _recv_msg(conn.sock, liveness)
         if msg is None:
           return  # client went away
         kind = msg[0]
         if kind == 'hello':
+          offered = msg[1] if len(msg) > 1 else None
           if self._contract is not None:
-            offered = msg[1] if len(msg) > 1 else None
             problem = contract_mismatch_message(self._contract, offered)
             if problem is not None:
               log.warning('rejecting actor %s: %s', addr, problem)
               conn.send(('reject', problem))
               return
             handshaken = True
+          # v6 negotiation (contract or not — protocol tests handshake
+          # against contract-less servers too): the offered protocol
+          # decides whether this conn gets busy keepalives and
+          # heartbeat-miss accounting; the client-info dict's prior
+          # epoch tells a reattaching client (cross-epoch — a learner
+          # RESTART behind it) from a same-run reconnect.
+          if isinstance(offered, dict):
+            conn.protocol = int(offered.get('protocol') or 5)
+          conn.heartbeat = (conn.protocol >= 6
+                            and self._heartbeat_secs > 0)
+          client_info = msg[2] if len(msg) > 2 else None
+          prior_epoch = (client_info or {}).get('epoch') \
+              if isinstance(client_info, dict) else None
+          try:
+            prior_epoch = (None if prior_epoch is None
+                           else int(prior_epoch))
+          except (TypeError, ValueError):
+            prior_epoch = None  # garbage epoch: treat as a fresh hello
+          if prior_epoch is not None:
+            with self._stats_lock:
+              if prior_epoch != self.session_epoch:
+                self._reattached += 1
+                self._reattach_latency = (time.monotonic()
+                                          - self._t_start)
+                log.info(
+                    'remote actor %s REATTACHED across a learner '
+                    'restart (prior epoch %d -> %d) %.2fs after '
+                    'server start', addr, prior_epoch,
+                    self.session_epoch, self._reattach_latency)
+              else:
+                self._reconnected += 1
           conn.send_segments(self._snapshot_frame())
+        elif kind == 'ping':
+          # Application-level heartbeat (v6): refreshes last_recv by
+          # arriving; the pong carries the current params version so
+          # an idle fleet still notices publishes without traffic.
+          with self._params_lock:
+            version = self._version
+          conn.send(('pong', version))
         elif kind == 'hello_params':
           # Re-route this whole connection to the param lane: the
           # reader thread hands the raw socket over and exits — blob
@@ -1144,13 +1738,39 @@ class TrajectoryIngestServer:
           # the backpressure put and the ack all happen on the worker
           # pool, so this thread is back inside recv for the next
           # frame immediately. msg[2] (when present) is the client's
-          # params version for the staleness window (v5 extension).
+          # params version for the staleness window (v5 extension);
+          # msg[3] (v6) is the session epoch the client handshook
+          # under — the stale-incarnation guard.
+          # Mark the unroll in flight BEFORE the enqueue: from here
+          # until the worker's reply, this conn's silence is lockstep
+          # protocol (reaper-exempt), not a liveness signal.
+          conn.job_started()
           self._ingest_q.put((conn, msg[1], time.monotonic(),
-                              msg[2] if len(msg) > 2 else None))
+                              msg[2] if len(msg) > 2 else None,
+                              msg[3] if len(msg) > 3 else None))
         else:
           conn.send(('error', f'unknown message kind {kind!r}'))
+      # Loop-condition exit on a closing server: same contract as
+      # _ServerClosing below — close() owns the bye/teardown.
+      leave_to_close = True
     except ring_buffer.Closed:
       pass  # learner shut down; dropping the conn tells the actor
+    except _ServerClosing:
+      # close() owns this connection's shutdown from here: leave the
+      # socket open and the conn listed so the 'bye' sequence finds
+      # it (closing here would race the bye into an RST).
+      leave_to_close = True
+    except _FrameStall as e:
+      # Half-open peer caught MID-frame by the reader's own stall
+      # deadline (faster than the reaper's idle window): reap it here
+      # — the partial frame never reached the handoff queue, so the
+      # buffer cannot be corrupted by it; it is simply discarded with
+      # the connection.
+      conn.reaped = True
+      with self._stats_lock:
+        self._conns_reaped += 1
+      log.warning('reaping half-open connection %s: %s (partial '
+                  'frame discarded)', addr, e)
     except (ValueError, struct.error, pickle.UnpicklingError,
             EOFError) as e:
       # Unparseable frame — a version-skewed peer (a pre-v4 client's
@@ -1166,15 +1786,20 @@ class TrajectoryIngestServer:
           '(version-skewed peer? this learner speaks v%d): %s', addr,
           PROTOCOL_VERSION, e)
     except (ConnectionError, OSError) as e:
-      if not self._closed.is_set():
+      if conn.reaped:
+        log.info('remote actor %s reader unwound after reap', addr)
+      elif not self._closed.is_set():
         log.warning('remote actor %s dropped: %s', addr, e)
     finally:
-      if not adopted:
+      if liveness is not None:
+        self._watchdog.unregister(thread_name)
+      if not adopted and not leave_to_close:
         conn.sock.close()
-      with self._conns_lock:
-        if conn in self._conns:
-          self._conns.remove(conn)
-      if not adopted:
+      if not leave_to_close:
+        with self._conns_lock:
+          if conn in self._conns:
+            self._conns.remove(conn)
+      if not adopted and not leave_to_close:
         log.info('remote actor %s disconnected', addr)
 
   def close(self, graceful: bool = True):
@@ -1218,7 +1843,9 @@ class TrajectoryIngestServer:
         log.warning('ingest close: handoff queue full; worker will '
                     'exit via the closed flag or leak as a daemon')
         break
-    self._param_lane.close()
+    unjoined: List[str] = []
+    if self._param_lane.close(graceful=graceful):
+      unjoined.append('param-lane')
     with self._conns_lock:
       conns = list(self._conns)
       threads = list(self._threads)
@@ -1240,12 +1867,33 @@ class TrajectoryIngestServer:
         conn.sock.close()
     for t in threads:
       t.join(timeout=2.0)
+      if t.is_alive():
+        unjoined.append(t.name)
     if graceful:
       for conn in conns:
         conn.sock.close()
     for w in self._workers:
       w.join(timeout=2.0)
+      if w.is_alive():
+        unjoined.append(w.name)
     self._accept_thread.join(timeout=2.0)
+    if self._accept_thread.is_alive():
+      unjoined.append('ingest-accept')
+    if self._reaper_thread is not None:
+      self._reaper_thread.join(timeout=2.0)
+      if self._reaper_thread.is_alive():
+        unjoined.append('ingest-reaper')
+    # Join-deadline misses used to vanish silently (the InferenceServer
+    # close parity, round 11 satellite): a leaked reader/worker pins
+    # its buffers and a socket for the rest of the process lifetime —
+    # count it and NAME it.
+    with self._stats_lock:
+      self._unjoined_threads = len(unjoined)
+    if unjoined:
+      log.warning(
+          'TrajectoryIngestServer.close(): %d thread(s) missed the '
+          'join deadline and leak as daemons: %s', len(unjoined),
+          ', '.join(unjoined))
 
 
 class RemoteActorClient:
@@ -1258,15 +1906,36 @@ class RemoteActorClient:
 
   Strict request→reply per socket; NOT thread-safe — one pump thread
   owns it.
+
+  Liveness (round 11): `io_timeout_secs` > 0 arms a recv/send deadline
+  on both sockets — a silent learner (partition, hard crash behind a
+  live NAT entry) surfaces as a ConnectionError within the window
+  instead of pinning the pump forever. The deadline composes with the
+  server's ('busy',) keepalives: a slow-but-alive learner emits busy
+  frames at the heartbeat cadence while backpressure holds an ack, so
+  `_rpc` keeps waiting (each frame is progress); only true silence
+  trips the deadline. `session_epoch`/`server_info` are learned at
+  handshake; the epoch stamps every unroll so a restarted learner can
+  prove zero stale-incarnation unrolls crossed its restart.
   """
 
-  def __init__(self, address: str, connect_timeout_secs: float = 60.0):
+  def __init__(self, address: str, connect_timeout_secs: float = 60.0,
+               io_timeout_secs: float = 0.0):
     host, port = address.rsplit(':', 1)
     self._addr = (host, int(port))
+    self._io_timeout = (float(io_timeout_secs)
+                        if io_timeout_secs and io_timeout_secs > 0
+                        else None)
     self._param_sock: Optional[socket.socket] = None
     # Unrolls the learner's staleness window refused (benign: dropped
     # + refetch; the pump reads this for its logs).
     self.stale_rejections = 0
+    # v6 liveness/restart state: the server-info dict from the last
+    # params reply, the session epoch this connection handshook under,
+    # and how many ('busy',) backpressure keepalives were absorbed.
+    self.server_info: Dict = {}
+    self.session_epoch: Optional[int] = None
+    self.busy_frames = 0
     deadline = time.monotonic() + connect_timeout_secs
     last_err = None
     # Capped exponential backoff + full jitter: after a learner
@@ -1294,46 +1963,82 @@ class RemoteActorClient:
               f'could not reach learner at {address}: {e}') from e
         backoff.sleep()
     self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    self._sock.settimeout(None)
+    self._sock.settimeout(self._io_timeout)
     log.info('connected to learner at %s (after %s)', address, last_err)
 
   def _rpc(self, msg, oob: bool = False):
+    # Scripted partition/latency (runtime/faults.py round 11): delay
+    # sleeps before the send; blackhole goes COMPLETELY silent for its
+    # window without closing — the learner-side idle reaper must see
+    # half-open silence, and this client then discovers the reaped
+    # socket when the partition "heals".
+    plan = faults_lib.active()
+    delay = faults_lib.fire('conn_delay')
+    if delay is not None:
+      faults_lib.apply_conn_delay(delay, seed=plan.seed if plan else 0)
+    partition = faults_lib.fire('conn_partition')
+    if partition is not None:
+      faults_lib.apply_conn_partition(partition)
     fault = faults_lib.fire('transport_send')
     if fault is not None:
       # Scripted transport damage (runtime/faults.py): ship garbage/
       # truncated bytes the learner must survive, then surface the
       # OSError this client's reconnect path expects.
-      plan = faults_lib.active()
       faults_lib.apply_transport_fault(
           fault, self._sock, seed=plan.seed if plan else 0)
     if oob:
       _send_oob(self._sock, msg)
     else:
       _send_msg(self._sock, msg)
-    try:
-      reply = _recv_msg(self._sock)
-    except (ValueError, struct.error, pickle.UnpicklingError,
-            EOFError) as e:
-      raise ProtocolError(
-          f'unparseable reply from the learner ({e!r}) — likely a '
-          f'protocol-version skew (this client speaks '
-          f'v{PROTOCOL_VERSION}); upgrade both roles together') from e
-    if reply is None:
-      raise ConnectionError('learner closed the connection')
+    while True:
+      try:
+        reply = _recv_msg(self._sock)
+      except socket.timeout as e:
+        raise ConnectionError(
+            f'learner silent past the {self._io_timeout}s I/O '
+            'deadline (no ack, no busy keepalive) — treating the '
+            'connection as dead') from e
+      except (ValueError, struct.error, pickle.UnpicklingError,
+              EOFError) as e:
+        raise ProtocolError(
+            f'unparseable reply from the learner ({e!r}) — likely a '
+            f'protocol-version skew (this client speaks '
+            f'v{PROTOCOL_VERSION}); upgrade both roles together') from e
+      if reply is None:
+        raise ConnectionError('learner closed the connection')
+      if reply[0] == 'busy':
+        # Backpressure keepalive (v6): the ack is held back by a full
+        # learner buffer, not a dead learner — keep waiting (each
+        # frame refreshes the per-recv deadline by arriving).
+        self.busy_frames += 1
+        continue
+      break
     if reply[0] == 'bye':
       raise LearnerShutdown('learner finished training')
     if reply[0] == 'reject':
       raise ContractMismatch(reply[1])
+    if reply[0] == 'stale_epoch':
+      raise SessionEpochMismatch(
+          f'learner refused this client\'s session epoch '
+          f'{self.session_epoch} (its current epoch: {reply[1]}) — '
+          'the learner restarted; re-handshake required')
     if reply[0] == 'error':
       raise RuntimeError(f'learner rejected request: {reply[1]}')
     return reply
 
-  @staticmethod
-  def _decode_params(reply) -> Tuple[int, object]:
+  def _decode_params(self, reply) -> Tuple[int, object]:
     """(version, tree) from a params reply; 'params_bf16' blobs
     (learner running remote_params_dtype=bfloat16) upcast back to
-    float32 here — the actor's agent/contract only ever sees f32."""
+    float32 here — the actor's agent/contract only ever sees f32.
+    v6 replies carry a 4th element, the server-info dict (protocol,
+    session epoch, heartbeat cadence) — recorded here; absent from v5
+    servers, in which case the liveness state stays empty."""
     version, tree = reply[1], reply[2]
+    if len(reply) > 3 and isinstance(reply[3], dict):
+      self.server_info = reply[3]
+      epoch = reply[3].get('session_epoch')
+      if epoch is not None:
+        self.session_epoch = epoch
     if reply[0] == 'params_bf16':
       import jax
       import ml_dtypes
@@ -1343,19 +2048,53 @@ class RemoteActorClient:
           tree)
     return version, tree
 
-  def handshake(self, contract) -> Tuple[int, object]:
+  def handshake(self, contract,
+                prior_epoch: Optional[int] = None) -> Tuple[int, object]:
     """Offer this host's trajectory contract; returns (version,
     params) on agreement, raises ContractMismatch (naming the
     offending fields) when the learner refuses. The handshake blob
     rides the trajectory connection (once per connect — before any
-    unroll is in flight, so there is no ack to starve)."""
-    return self._decode_params(self._rpc(('hello', contract)))
+    unroll is in flight, so there is no ack to starve).
+
+    `prior_epoch` (v6): the session epoch of the learner this host was
+    attached to before the drop, if any — a RESTARTED learner sees a
+    foreign epoch and counts/times the fleet re-attach; old servers
+    ignore the extra hello element."""
+    msg = (('hello', contract) if prior_epoch is None
+           else ('hello', contract, {'epoch': int(prior_epoch)}))
+    return self._decode_params(self._rpc(msg))
+
+  def ping(self) -> int:
+    """Application-level heartbeat on the trajectory lane (v6): keeps
+    an idle connection inside the learner's reaping window and returns
+    the learner's CURRENT params version from the pong — so an idle
+    fleet still notices publishes. Raises like any rpc on a dead
+    learner (the pump's reconnect path runs)."""
+    reply = self._rpc(('ping',))
+    if reply[0] != 'pong':
+      raise ProtocolError(f'expected pong, got {reply[0]!r}')
+    return reply[1]
 
   def fetch_params(self) -> Tuple[int, object]:
     """(version, host param pytree) — the current learner snapshot,
     fetched over the dedicated param lane. A lane failure closes just
     the param socket and surfaces as ConnectionError/OSError; the
-    caller's reconnect path rebuilds both lanes."""
+    caller's reconnect path rebuilds both lanes. A CACHED lane that
+    died between fetches (the learner's idle reaper legitimately reaps
+    a long-quiet subscriber) retries ONCE on a fresh param socket
+    before surfacing — a reaped sub must not cost the whole
+    trajectory connection a reconnect cycle."""
+    had_cached_lane = self._param_sock is not None
+    try:
+      return self._fetch_params_once()
+    except (ConnectionError, OSError) as e:
+      if not had_cached_lane:
+        raise
+      log.info('param lane died between fetches (%s); retrying once '
+               'on a fresh subscriber connection', e)
+      return self._fetch_params_once()
+
+  def _fetch_params_once(self) -> Tuple[int, object]:
     if self._param_sock is None:
       try:
         sock = socket.create_connection(self._addr, timeout=10.0)
@@ -1363,12 +2102,17 @@ class RemoteActorClient:
         raise ConnectionError(
             f'could not open the param lane to {self._addr}')
       sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-      sock.settimeout(None)
+      sock.settimeout(self._io_timeout)
       _send_msg(sock, ('hello_params',))
       self._param_sock = sock
     try:
       _send_msg(self._param_sock, ('get_params',))
       reply = _recv_msg(self._param_sock)
+    except socket.timeout as e:
+      self._close_param_sock()
+      raise ConnectionError(
+          f'param lane silent past the {self._io_timeout}s I/O '
+          'deadline') from e
     except (ValueError, struct.error, pickle.UnpicklingError,
             EOFError) as e:
       self._close_param_sock()
@@ -1382,6 +2126,11 @@ class RemoteActorClient:
     if reply is None:
       self._close_param_sock()
       raise ConnectionError('learner closed the param lane')
+    if reply[0] == 'bye':
+      # Graceful lane shutdown (round 11): a clean end-of-training
+      # answer instead of a raw EOF the client must diagnose.
+      self._close_param_sock()
+      raise LearnerShutdown('learner finished training (param lane)')
     if reply[0] == 'error':
       raise RuntimeError(f'learner rejected param fetch: {reply[1]}')
     return self._decode_params(reply)
@@ -1405,9 +2154,21 @@ class RemoteActorClient:
     ('stale', current) reply means the unroll was REFUSED benignly:
     counted on `stale_rejections`, and the returned (newer) version
     makes the caller's refetch-on-newer path fire — the same contract
-    as an ack, minus the landed unroll."""
-    msg = (('unroll', unroll) if params_version is None
-           else ('unroll', unroll, int(params_version)))
+    as an ack, minus the landed unroll.
+
+    When this client handshook with a v6 learner, the SESSION EPOCH
+    stamps the frame too (4th element, ignored by old servers): a
+    learner incarnation this unroll does not belong to refuses it
+    with 'stale_epoch' → SessionEpochMismatch (ConnectionError — the
+    reconnect/re-handshake path is the response)."""
+    if self.session_epoch is not None:
+      msg = ('unroll', unroll,
+             None if params_version is None else int(params_version),
+             int(self.session_epoch))
+    elif params_version is None:
+      msg = ('unroll', unroll)
+    else:
+      msg = ('unroll', unroll, int(params_version))
     reply = self._rpc(msg, oob=True)
     if reply[0] == 'stale':
       self.stale_rejections += 1
@@ -1459,12 +2220,20 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
     import jax
     jax.config.update('jax_platforms', platform)
 
+  from scalable_agent_tpu import config as config_lib
   from scalable_agent_tpu import driver as driver_lib
   from scalable_agent_tpu.envs import factory
   from scalable_agent_tpu.runtime.inference import InferenceServer
 
   if reconnect_secs is None:
     reconnect_secs = getattr(config, 'actor_reconnect_secs', 0.0)
+  for warning in config_lib.validate_transport(config):
+    log.warning('%s', warning)
+  # Client-side I/O deadline: the idle window doubles as "how long do
+  # I wait on a silent learner" — symmetric with the server's reaping
+  # of silent clients. Busy keepalives keep a backpressured-but-alive
+  # learner inside it.
+  io_timeout = getattr(config, 'remote_conn_idle_timeout_secs', 0.0)
   levels = factory.level_names(config)
   spec0 = factory.make_env_spec(config, levels[0], seed=1)
   agent = driver_lib.build_agent(config, spec0.num_actions,
@@ -1472,7 +2241,8 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
 
   contract = trajectory_contract(config, agent, spec0.num_actions)
   client = RemoteActorClient(learner_address,
-                             connect_timeout_secs=connect_timeout_secs)
+                             connect_timeout_secs=connect_timeout_secs,
+                             io_timeout_secs=io_timeout)
   unrolls_sent = 0
   try:
     try:
@@ -1482,7 +2252,14 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
       log.info('learner already finished training; remote actor '
                'exiting')
       return 0
-    log.info('remote actor task=%d got params v%d', task, version)
+    known_epoch = client.session_epoch  # None against a v5 learner
+    # Heartbeat cadence is the SERVER's call (negotiated via its
+    # hello-reply info dict): 0 / absent (v5 learner) = no pings.
+    heartbeat_secs = float(
+        client.server_info.get('heartbeat_secs') or 0.0)
+    log.info('remote actor task=%d got params v%d (epoch=%s, '
+             'heartbeat=%.1fs)', task, version, known_epoch,
+             heartbeat_secs)
 
     # Seed space DISJOINT from the learner hosts' (driver.train uses
     # process_index * max(num_actors, 1000) for env streams and
@@ -1508,7 +2285,7 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
       Retries the WHOLE connect+fetch cycle until the deadline: a
       connection that resets right after connecting (learner mid-
       restart, listener backlog races) must not end the actor."""
-      nonlocal client, version
+      nonlocal client, version, known_epoch, heartbeat_secs
       client.close()
       deadline = time.monotonic() + reconnect_secs
       # Jittered backoff between whole connect+handshake cycles: the
@@ -1523,11 +2300,15 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
           return False
         try:
           new_client = RemoteActorClient(learner_address,
-                                         connect_timeout_secs=remaining)
+                                         connect_timeout_secs=remaining,
+                                         io_timeout_secs=io_timeout)
         except ConnectionError:
           continue  # connect window exhausted → loop exits above
         try:
-          v, new_params = new_client.handshake(contract)
+          # The prior epoch rides the hello: a RESTARTED learner (new
+          # epoch) counts this as a fleet re-attach and times it.
+          v, new_params = new_client.handshake(contract,
+                                               prior_epoch=known_epoch)
         except ContractMismatch:
           # The restarted learner runs an INCOMPATIBLE config: retrying
           # cannot succeed — surface it instead of burning the window.
@@ -1539,6 +2320,15 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
           continue
         client = new_client
         version = v
+        if (known_epoch is not None
+            and new_client.session_epoch != known_epoch):
+          log.warning(
+              'remote actor task=%d RE-ATTACHED to a restarted '
+              'learner (epoch %s -> %s); params refreshed to v%d',
+              task, known_epoch, new_client.session_epoch, version)
+        known_epoch = new_client.session_epoch
+        heartbeat_secs = float(
+            new_client.server_info.get('heartbeat_secs') or 0.0)
         server.update_params(new_params)
         log.info('remote actor task=%d reconnected, params v%d',
                  task, version)
@@ -1554,18 +2344,46 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
       log.info('learner connection closed; remote actor exiting')
       return False
 
+    def refresh_params():
+      """Fetch + install the current snapshot (version-gated on the
+      server side against redundant copies)."""
+      nonlocal version, params
+      version, params = client.fetch_params()
+      server.update_params(params, version=version)
+      log.info('remote actor task=%d refreshed params to v%d',
+               task, version)
+
     try:
       unroll = None  # a drop mid-send must not lose the unroll
+      last_io = time.monotonic()
       while (stop_after_unrolls is None or
              unrolls_sent < stop_after_unrolls):
         if unroll is None:
           try:
-            unroll = buffer.get(timeout=10.0)
+            # With heartbeats negotiated, wake often enough to ping an
+            # idle trajectory lane inside the learner's reaping window.
+            get_timeout = (min(10.0, heartbeat_secs)
+                           if heartbeat_secs > 0 else 10.0)
+            unroll = buffer.get(timeout=get_timeout)
           except TimeoutError:
             fleet.check_health(stall_timeout_secs=300.0)
             errors = fleet.errors()
             if errors:
               raise errors[0]
+            if (heartbeat_secs > 0 and
+                time.monotonic() - last_io >= heartbeat_secs):
+              # Idle heartbeat: keeps the conn out of the reaper's
+              # window AND learns about publishes while quiet (the
+              # pong carries the current version).
+              try:
+                pong_version = client.ping()
+                last_io = time.monotonic()
+                if pong_version > version:
+                  refresh_params()
+              except OSError:
+                if not resume_after_drop():
+                  break
+                last_io = time.monotonic()
             continue
         try:
           # The current params version rides along so a staleness-
@@ -1576,27 +2394,31 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
                                            params_version=version)
         except OSError:
           # OSError, not just ConnectionError: a blackholed learner
-          # host surfaces as ETIMEDOUT, which must also trigger the
-          # reconnect window.
+          # host surfaces as ETIMEDOUT — or the round-11 client-side
+          # I/O deadline fired on pure silence — and both must
+          # trigger the reconnect window. SessionEpochMismatch (the
+          # learner restarted under us) rides the same path: the
+          # reconnect IS the re-handshake.
           if resume_after_drop():
+            last_io = time.monotonic()
             continue  # resend the SAME unroll on the new connection
           break
+        last_io = time.monotonic()
         unroll = None
         unrolls_sent += 1
         if ack_version > version:
           try:
-            version, params = client.fetch_params()
-            # Version-gated: a refetch racing the publish cadence can
-            # hand back the version already being served — the server
-            # skips the whole-tree copy for it (stats:
+            # Version-gated on the server side: a refetch racing the
+            # publish cadence can hand back the version already being
+            # served — the whole-tree copy is skipped for it (stats:
             # publishes_skipped).
-            server.update_params(params, version=version)
-            log.info('remote actor task=%d refreshed params to v%d',
-                     task, version)
+            refresh_params()
+            last_io = time.monotonic()
           except OSError:
             # Dropped between ack and refresh; reconnect() refetches.
             if not resume_after_drop():
               break
+            last_io = time.monotonic()
     except LearnerShutdown:
       # Clean end of training ('bye'): no reconnect window to burn.
       log.info('learner finished training; remote actor exiting')

@@ -47,12 +47,35 @@ the actor-plane SLOs:
     green (contiguous, monotone learner step sequence across the
     preemption).
 
+Round 11 adds the PARTITION storm (`run_partition_storm`): the
+learner runs as a CHILD process (so it can be hard-killed) with a
+remote-actor child feeding it over TCP under partition
+(`conn_partition` — blackhole silence the idle reaper must catch) and
+latency (`conn_delay`) faults, then the `learner_crash` fault SIGKILLs
+the learner mid-storm — no drain, no 'bye'. The harness restarts the
+learner on the same logdir/port and asserts the transport/restart
+SLOs:
+
+  * learner #1 died by SIGKILL exactly as scheduled (no unwind),
+  * learner #2 restores from the PR 2 LAST_GOOD ladder and trains its
+    full step budget (frames monotone within each incarnation; at
+    most the one crash-replay dip at the boundary),
+  * the actor child RE-ATTACHES (cross-epoch hello counted + timed,
+    reattach TTR bounded) and keeps feeding — then exits cleanly on
+    the final 'bye',
+  * ZERO stale-epoch unrolls accepted across the restart,
+  * a half-open peer (the harness's own silent partial-frame socket)
+    is reaped within the idle budget,
+  * zero wedged ingest threads and zero unjoined threads at exit,
+  * the liveness counters present in summaries.jsonl.
+
 Writes CHAOS_OUT (default CHAOS.json at the repo root). Invocation:
 
-    python scripts/chaos.py               # both storms, ~3-5 min CPU
-    CHAOS_SMOKE=1 python scripts/chaos.py # CI smoke (both), < 120 s
-    CHAOS_STORM=fault    python scripts/chaos.py  # just the r7 storm
-    CHAOS_STORM=overload python scripts/chaos.py  # just the overload
+    python scripts/chaos.py               # all storms, ~4-6 min CPU
+    CHAOS_SMOKE=1 python scripts/chaos.py # CI smoke (all), < 180 s
+    CHAOS_STORM=fault     python scripts/chaos.py  # just the r7 storm
+    CHAOS_STORM=overload  python scripts/chaos.py  # just the overload
+    CHAOS_STORM=partition python scripts/chaos.py  # just the partition
     CHAOS_SEED=7 python scripts/chaos.py  # different garbage bytes
 
 The fault schedule is a pure function of the arguments (the seed only
@@ -489,6 +512,286 @@ def run_overload_storm(logdir: str, smoke: bool = SMOKE,
   return results, errors
 
 
+def _spawn_learner_child(overrides, max_steps, plan_json):
+  """driver.train as a child process — the only way a hard-kill
+  (learner_crash -> SIGKILL) can be both injected and survived. On a
+  clean finish the child prints 'LEARNER_OK <json>' with the final
+  step count and the ingest liveness/restart counters (read
+  post-close: the counters outlive the sockets)."""
+  env = dict(os.environ)
+  env['JAX_PLATFORMS'] = 'cpu'
+  existing = env.get('PYTHONPATH', '')
+  env['PYTHONPATH'] = (REPO + os.pathsep + existing if existing
+                       else REPO)
+  if plan_json:
+    from scalable_agent_tpu.runtime import faults as faults_lib
+    env[faults_lib.PLAN_ENV_VAR] = plan_json
+  body = (
+      'import json, sys\n'
+      'from scalable_agent_tpu.config import Config\n'
+      'from scalable_agent_tpu.runtime import faults\n'
+      'faults.install_from_env()\n'
+      'from scalable_agent_tpu import driver\n'
+      'cfg = Config(**json.loads(sys.argv[1]))\n'
+      'run = driver.train(cfg, max_steps=int(sys.argv[2]),\n'
+      '                   stall_timeout_secs=10.0)\n'
+      'import jax\n'
+      'ing = run.ingest.stats()\n'
+      'keys = ("unrolls", "conns_reaped", "heartbeat_misses",\n'
+      '        "stale_epoch_rejected", "reattached", "reconnected",\n'
+      '        "reattach_latency_secs", "ingest_threads_wedged",\n'
+      '        "unjoined_threads", "param_subs_dropped",\n'
+      '        "quarantined")\n'
+      'out = {"final_steps":\n'
+      '           int(jax.device_get(run.state.update_steps)),\n'
+      '       "last_good": run.checkpointer.last_good_step(),\n'
+      '       "ingest": {k: ing[k] for k in keys}}\n'
+      'print("LEARNER_OK " + json.dumps(out), flush=True)\n')
+  return subprocess.Popen(
+      [sys.executable, '-c', body, json.dumps(overrides),
+       str(max_steps)],
+      cwd=REPO, env=env, stdout=subprocess.PIPE,
+      stderr=subprocess.STDOUT, text=True)
+
+
+def run_partition_storm(logdir: str, smoke: bool = SMOKE,
+                        seed: int = SEED):
+  """The transport-plane partition + hard-crash drill; returns
+  (results, hard-assert errors). Learner as a hard-killable child,
+  remote-actor child under partition/delay faults, a harness-owned
+  half-open socket, learner kill -9 mid-storm, restart, re-attach."""
+  from scalable_agent_tpu.runtime import faults as faults_lib
+  from scalable_agent_tpu.runtime import remote as remote_lib
+
+  port = _free_port()
+  crash_at = 6 if smoke else 10        # consumed batches before kill -9
+  resume_steps = 5 if smoke else 10    # learner #2's budget
+  idle_timeout = 2.0
+  reattach_slo = 45.0
+  reap_slo = idle_timeout + 6.0        # idle window + poll/sched grace
+  cfg_kwargs = dict(
+      logdir=logdir,
+      env_backend='bandit',
+      num_actors=0,                    # remote-fed only: the wire IS
+                                       # the feed under test
+      batch_size=2,
+      unroll_length=5,
+      num_action_repeats=1,
+      episode_length=4,
+      height=24, width=32,
+      torso='shallow',
+      use_py_process=False,
+      use_instruction=False,
+      total_environment_frames=10 ** 9,
+      inference_timeout_ms=5,
+      checkpoint_secs=0,               # a save every window: LAST_GOOD
+                                       # always trails the crash point
+      summary_secs=0,
+      remote_actor_port=port,
+      remote_heartbeat_secs=0.5,
+      remote_conn_idle_timeout_secs=idle_timeout,
+      actor_reconnect_secs=240.0,      # must cover the restart gap
+      seed=seed)
+
+  learner_plan = faults_lib.FaultPlan.storm(
+      seed, learner_crash_at=crash_at)
+  # Actor-side transport chaos: latency early, then a blackhole longer
+  # than the idle window (the learner must reap the silent conn; the
+  # client discovers the reaped socket when the partition heals and
+  # reconnects). Indices are _rpc events (handshake + unrolls + pings).
+  actor_plan = faults_lib.FaultPlan.storm(
+      seed, conn_delay=[3, 5], conn_delay_secs=0.15,
+      conn_partition_at=8,
+      conn_partition_secs=idle_timeout + 2.0)
+
+  child_overrides = {k: v for k, v in cfg_kwargs.items()
+                     if k not in ('logdir', 'remote_actor_port')}
+  child_overrides.update(logdir=logdir + '/actor_child', num_actors=2)
+  learner_overrides = dict(cfg_kwargs)
+
+  actor = _spawn_actor_child(f'127.0.0.1:{port}', child_overrides,
+                             actor_plan.to_json())
+  t0 = time.monotonic()
+  errors = []
+  results = {
+      'smoke': smoke,
+      'seed': seed,
+      'crash_at': crash_at,
+      'resume_steps': resume_steps,
+      'fault_plan': learner_plan.stats(),
+  }
+  learner2_out = ''
+  actor_out = ''
+  try:
+    # --- Phase 1: learner #1 trains on the remote feed under the
+    # delay/partition faults until the scheduled kill -9. ---
+    learner1 = _spawn_learner_child(learner_overrides, max_steps=200,
+                                    plan_json=learner_plan.to_json())
+    try:
+      out1, _ = learner1.communicate(timeout=60 if smoke else 120)
+    except subprocess.TimeoutExpired:
+      learner1.kill()
+      out1 = learner1.communicate()[0]
+      errors.append('learner #1 never hit its scheduled kill -9')
+    results['learner1_returncode'] = learner1.returncode
+    results['learner1_tail'] = (out1 or '')[-600:]
+    if learner1.returncode != -9:
+      errors.append(
+          f'learner #1 exited {learner1.returncode}, expected SIGKILL '
+          '(-9) from the learner_crash fault')
+    if 'LEARNER_OK' in (out1 or ''):
+      errors.append('learner #1 finished cleanly — the hard-kill '
+                    'never fired')
+
+    # --- Phase 2: restart the learner on the SAME logdir/port. The
+    # actor child is mid-reconnect-window; it must re-attach. ---
+    learner2 = _spawn_learner_child(learner_overrides,
+                                    max_steps=resume_steps,
+                                    plan_json='')
+    # While learner #2 runs: a harness-owned HALF-OPEN peer (partial
+    # frame, then silence) — the reap-within-budget SLO, measured
+    # end to end: the reaper closes the socket, so our recv returns.
+    half_open_reaped_secs = None
+    try:
+      deadline = time.monotonic() + (90 if smoke else 150)
+      probe = None
+      probe_t0 = None
+      while learner2.poll() is None and time.monotonic() < deadline:
+        if probe is None:
+          try:
+            probe = socket.create_connection(('127.0.0.1', port),
+                                             timeout=2.0)
+            probe.sendall(remote_lib._LEN.pack(1000) + b'\x00'
+                          + b'half-open partial frame')
+            probe.settimeout(max(reap_slo, 5.0))
+            probe_t0 = time.monotonic()
+          except OSError:
+            probe = None
+            time.sleep(0.5)
+            continue
+        if half_open_reaped_secs is None:
+          try:
+            if probe.recv(1) == b'':
+              half_open_reaped_secs = time.monotonic() - probe_t0
+          except socket.timeout:
+            pass
+          except OSError:
+            half_open_reaped_secs = time.monotonic() - probe_t0
+        else:
+          time.sleep(0.2)
+      try:
+        learner2_out, _ = learner2.communicate(timeout=60)
+      except subprocess.TimeoutExpired:
+        learner2.kill()
+        learner2_out = learner2.communicate()[0]
+        errors.append('learner #2 (restart) hung')
+      if probe is not None:
+        probe.close()
+    finally:
+      if learner2.poll() is None:
+        learner2.kill()
+        learner2.communicate()
+    results['learner2_tail'] = (learner2_out or '')[-600:]
+    results['half_open_reaped_secs'] = (
+        round(half_open_reaped_secs, 2)
+        if half_open_reaped_secs is not None else None)
+
+    # --- Actor child: the final graceful close 'bye's it out. ---
+    try:
+      actor_out, _ = actor.communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+      actor.terminate()
+      try:
+        actor_out, _ = actor.communicate(timeout=10)
+      except subprocess.TimeoutExpired:
+        actor.kill()
+        actor_out = actor.communicate()[0]
+      errors.append('actor child did not exit on the learner\'s '
+                    'final bye (possible deadlocked pump)')
+    results['actor_tail'] = (actor_out or '')[-600:]
+    if 'CHILD_OK' not in (actor_out or ''):
+      errors.append('actor child did not report CHILD_OK')
+  finally:
+    if actor.poll() is None:
+      actor.kill()
+      actor.communicate()
+
+  # --- SLOs from learner #2's report. ---
+  report = None
+  for line in (learner2_out or '').splitlines():
+    if line.startswith('LEARNER_OK '):
+      report = json.loads(line[len('LEARNER_OK '):])
+  if report is None:
+    errors.append('learner #2 produced no LEARNER_OK report')
+    results['wall_secs'] = round(time.monotonic() - t0, 2)
+    return results, errors
+  ing = report['ingest']
+  restored = report['final_steps'] - resume_steps
+  results.update({
+      'learner2': report,
+      'restored_step': restored,
+  })
+  # Restore came from the LAST_GOOD ladder: a real step short of the
+  # crash point, and the resumed run trained its FULL budget on top.
+  if not 1 <= restored <= crash_at:
+    errors.append(f'restored step {restored} outside [1, {crash_at}] '
+                  '— restore-from-LAST_GOOD broken')
+  if report['last_good'] != report['final_steps']:
+    errors.append(
+        f"learner #2's final save not LAST_GOOD: {report['last_good']}"
+        f" != {report['final_steps']}")
+  # Fleet re-attach: counted, timed, bounded.
+  if ing['reattached'] < 1:
+    errors.append('actor child never counted as reattached (no '
+                  'cross-epoch hello at learner #2)')
+  elif ing['reattach_latency_secs'] > reattach_slo:
+    errors.append(f"fleet re-attach took {ing['reattach_latency_secs']}"
+                  f's > SLO {reattach_slo}s')
+  if ing['unrolls'] < resume_steps * cfg_kwargs['batch_size']:
+    errors.append(f"learner #2 ingested only {ing['unrolls']} unrolls "
+                  f'for {resume_steps} steps — the re-attached fleet '
+                  'did not feed it')
+  # Zero stale-incarnation unrolls crossed the restart.
+  if ing['stale_epoch_rejected'] != 0:
+    errors.append(f"stale_epoch_rejected={ing['stale_epoch_rejected']}"
+                  ' != 0 across the restart')
+  # The half-open peer was reaped within budget.
+  if half_open_reaped_secs is None:
+    errors.append('harness half-open connection never reaped')
+  elif half_open_reaped_secs > reap_slo:
+    errors.append(f'half-open reap took {half_open_reaped_secs:.1f}s '
+                  f'> budget {reap_slo}s')
+  if ing['conns_reaped'] < 1:
+    errors.append('learner #2 counted no reaped connections')
+  # Zero deadlocked/leaked threads at exit.
+  if ing['ingest_threads_wedged'] != 0:
+    errors.append(f"ingest_threads_wedged="
+                  f"{ing['ingest_threads_wedged']} != 0 at exit")
+  if ing['unjoined_threads'] != 0:
+    errors.append(f"unjoined_threads={ing['unjoined_threads']} != 0 "
+                  'at close')
+
+  # Frames monotone: each incarnation's summary step sequence is
+  # non-decreasing; the only allowed dip is the single crash-replay
+  # boundary (restore < crash point).
+  summaries = _read_jsonl(os.path.join(logdir, 'summaries.jsonl'))
+  steps_seq = [e['step'] for e in summaries if 'step' in e]
+  dips = sum(1 for a, b in zip(steps_seq, steps_seq[1:]) if b < a)
+  if dips > 1:
+    errors.append(f'summary step sequence dipped {dips} times — only '
+                  'the crash-replay boundary may dip once')
+  tags = {e['tag'] for e in summaries if 'tag' in e}
+  for tag in ('remote_conns_reaped', 'remote_heartbeat_misses',
+              'param_subs_dropped', 'ingest_threads_wedged',
+              'remote_reattached', 'remote_reattach_latency_secs',
+              'remote_stale_epoch_rejected'):
+    if tag not in tags:
+      errors.append(f'summary tag {tag!r} missing')
+
+  results['wall_secs'] = round(time.monotonic() - t0, 2)
+  return results, errors
+
+
 def main():
   which = os.environ.get('CHAOS_STORM', 'all')
   results = {}
@@ -502,6 +805,11 @@ def main():
     with tempfile.TemporaryDirectory(prefix='chaos_ovl_') as logdir:
       results['overload'], overload_errors = run_overload_storm(logdir)
     errors += [f'overload: {e}' for e in overload_errors]
+  if which in ('all', 'partition'):
+    with tempfile.TemporaryDirectory(prefix='chaos_part_') as logdir:
+      results['partition'], partition_errors = \
+          run_partition_storm(logdir)
+    errors += [f'partition: {e}' for e in partition_errors]
   results['slo_violations'] = errors
   results['ok'] = not errors
   with open(OUT_PATH, 'w') as f:
@@ -511,6 +819,8 @@ def main():
                     'wall_secs': results.get('wall_secs'),
                     'overload_wall_secs':
                         results.get('overload', {}).get('wall_secs'),
+                    'partition_wall_secs':
+                        results.get('partition', {}).get('wall_secs'),
                     'violations': errors,
                     'out': OUT_PATH}))
   if errors:

@@ -44,6 +44,19 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py -q \
   -p no:cacheprovider
 BENCH_SMOKE=1 BENCH_ONLY=overload python bench.py
 
+echo '== partition-chaos smoke (remote feed under conn partition +'
+echo '   delay faults, learner hard-killed (-9) mid-storm, restarted'
+echo '   learner restores LAST_GOOD, fleet re-attaches within SLO,'
+echo '   half-open peer reaped in budget, zero stale-epoch unrolls,'
+echo '   zero wedged threads; plus the liveness/reattach selector'
+echo '   — <90 s CPU) =='
+CHAOS_SMOKE=1 CHAOS_STORM=partition python scripts/chaos.py
+JAX_PLATFORMS=cpu python -m pytest tests/test_remote.py \
+  tests/test_faults.py -q \
+  -k 'reaped or heartbeat or busy or epoch or ping or partition or '\
+'crash or unjoined or validate_transport' \
+  -p no:cacheprovider
+
 echo '== inference-plane smoke (state-cache golden parity + slot'
 echo '   lifecycle selector, then the tiny cache×depth bench rows'
 echo '   via BENCH_ONLY=inference_plane — <60 s CPU) =='

@@ -228,3 +228,112 @@ class TestBackoff:
     delays = {round(remote.Backoff(base=1.0, cap=1.0).next_delay(), 6)
               for _ in range(16)}
     assert len(delays) > 1  # a fixed sleep would be a single value
+
+
+class TestPartitionLayer:
+  """Round-11 sites: conn_partition (blackhole), conn_delay (injected
+  latency), learner_crash (hard abort) — the partition storm composes
+  them (scripts/chaos.py run_partition_storm)."""
+
+  def test_storm_builder_schedules_new_sites(self):
+    plan = faults_lib.FaultPlan.storm(
+        1, conn_partition_at=4, conn_partition_secs=2.5,
+        conn_delay=[1, 3], conn_delay_secs=0.1, learner_crash_at=7)
+    sites = {f.site for f in plan.faults()}
+    assert sites == {'conn_partition', 'conn_delay', 'learner_crash'}
+    roundtrip = faults_lib.FaultPlan.from_json(plan.to_json())
+    assert roundtrip.faults() == plan.faults()
+    part = [f for f in plan.faults() if f.site == 'conn_partition'][0]
+    assert part.kind == 'blackhole' and part.param == 2.5
+
+  def test_conn_delay_through_real_rpc(self):
+    """A scheduled delay slows the rpc WITHOUT breaking it — latency
+    the liveness machinery must tolerate, not a drop."""
+    buffer = ring_buffer.TrajectoryBuffer(4)
+    server = remote.TrajectoryIngestServer(
+        buffer, {'w': np.zeros(1)}, host='127.0.0.1')
+    client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                      connect_timeout_secs=10)
+    try:
+      faults_lib.install(faults_lib.FaultPlan(
+          [faults_lib.Fault('conn_delay', 0, 'delay', param=0.4)]))
+      from tests.test_remote import _tiny_unroll
+      t0 = time.monotonic()
+      assert client.send_unroll(_tiny_unroll(1)) == 1
+      assert time.monotonic() - t0 >= 0.35
+      assert len(buffer) == 1
+    finally:
+      faults_lib.clear()
+      client.close()
+      server.close()
+      buffer.close()
+
+  def test_conn_partition_blackhole_heals_or_gets_reaped(self):
+    """A blackhole SHORTER than the idle window heals transparently;
+    one LONGER than it gets the connection reaped mid-silence, and
+    the client's next send finds the dead socket (reconnect-path
+    material — here surfaced as the OSError the pump expects)."""
+    from tests.test_remote import _tiny_unroll
+    # Short partition, generous window: heals.
+    buffer = ring_buffer.TrajectoryBuffer(4)
+    server = remote.TrajectoryIngestServer(
+        buffer, {'w': np.zeros(1)}, host='127.0.0.1',
+        heartbeat_secs=0.2, idle_timeout_secs=5.0)
+    client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                      connect_timeout_secs=10)
+    try:
+      faults_lib.install(faults_lib.FaultPlan(
+          [faults_lib.Fault('conn_partition', 0, 'blackhole',
+                            param=0.3)]))
+      assert client.send_unroll(_tiny_unroll(1)) == 1
+      assert server.stats()['conns_reaped'] == 0
+    finally:
+      faults_lib.clear()
+      client.close()
+      server.close()
+      buffer.close()
+
+    # Long partition, tight window: reaped while silent.
+    buffer2 = ring_buffer.TrajectoryBuffer(4)
+    server2 = remote.TrajectoryIngestServer(
+        buffer2, {'w': np.zeros(1)}, host='127.0.0.1',
+        heartbeat_secs=0.2, idle_timeout_secs=0.5)
+    client2 = remote.RemoteActorClient(f'127.0.0.1:{server2.port}',
+                                       connect_timeout_secs=10)
+    try:
+      client2.handshake({'protocol': remote.PROTOCOL_VERSION})
+      faults_lib.install(faults_lib.FaultPlan(
+          [faults_lib.Fault('conn_partition', 0, 'blackhole',
+                            param=1.5)]))
+      with pytest.raises(OSError):
+        client2.send_unroll(_tiny_unroll(2))
+      assert server2.stats()['conns_reaped'] >= 1
+    finally:
+      faults_lib.clear()
+      client2.close()
+      server2.close()
+      buffer2.close()
+
+  def test_learner_crash_hard_kills_subprocess(self):
+    """hard_crash is a SIGKILL: no unwind, no output after the kill
+    line — asserted in a child so the test process survives."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    body = (
+        'from scalable_agent_tpu.runtime import faults\n'
+        'plan = faults.FaultPlan(\n'
+        '    [faults.Fault("learner_crash", 1, "kill")])\n'
+        'faults.install(plan)\n'
+        'assert faults.fire("learner_crash") is None\n'
+        'print("BEFORE", flush=True)\n'
+        'f = faults.fire("learner_crash")\n'
+        'faults.hard_crash(f)\n'
+        'print("AFTER", flush=True)\n')
+    proc = subprocess.run(
+        [sys.executable, '-c', body], cwd=repo, timeout=60,
+        capture_output=True, text=True)
+    assert proc.returncode == -9, (proc.returncode, proc.stdout)
+    assert 'BEFORE' in proc.stdout
+    assert 'AFTER' not in proc.stdout

@@ -291,3 +291,28 @@ def test_stop_reports_unjoined_and_buffer_refuses_writes():
   with pytest.raises(ring_buffer.Closed):
     buffer.put('stale-unroll')
   stall.clear()
+
+
+def test_stats_wedged_counts_silent_alive_threads():
+  """Round 11: an alive thread with a stale heartbeat and NO recorded
+  error is 'wedged' — the fleet-side zero-deadlocked-threads ledger
+  (blocked in env.step / parked on backpressure)."""
+  import time as time_lib
+  buffer = ring_buffer.TrajectoryBuffer(64)
+  fleet = ActorFleet(
+      _make_actor_factory(lambda i: FakeEnv(height=H, width=W,
+                                            num_actions=A, seed=i)),
+      buffer, 2)
+  try:
+    fleet.start()
+    deadline = time_lib.time() + 10
+    while fleet.stats()['unrolls'] < 2 and time_lib.time() < deadline:
+      time_lib.sleep(0.05)
+    stats = fleet.stats(healthy_horizon_secs=60.0)
+    assert stats['wedged'] == 0
+    # With a zero horizon every producing-but-not-this-instant thread
+    # reads as wedged — the stat is horizon-relative by design.
+    stats_tight = fleet.stats(healthy_horizon_secs=0.0)
+    assert stats_tight['wedged'] == stats_tight['alive']
+  finally:
+    fleet.stop()

@@ -132,3 +132,21 @@ def test_fps_meter_decays_to_zero_on_stall():
   meter.update(1000)
   _time.sleep(0.12)
   assert meter.fps() == 0.0  # stalled: window empty, not last-rate
+
+
+def test_thread_watchdog_names_wedged_threads():
+  """Round 11: service threads beat once per loop; wedged() names the
+  ones past the stall deadline; unregister removes retired threads."""
+  import time as time_lib
+  from scalable_agent_tpu.observability import ThreadWatchdog
+  dog = ThreadWatchdog()
+  dog.beat('reader-a')
+  dog.beat('worker-0')
+  assert dog.wedged(10.0) == []
+  time_lib.sleep(0.08)
+  assert dog.wedged(0.05) == ['reader-a', 'worker-0']
+  dog.beat('reader-a')  # progress clears the wedge
+  assert dog.wedged(0.05) == ['worker-0']
+  dog.unregister('worker-0')
+  assert dog.wedged(0.05) == []
+  assert dog.names() == ['reader-a']

@@ -747,6 +747,22 @@ def _run_learner_with_remote_child(tmp_path, base, child_actors,
     assert run.ingest.stats()['unrolls'] >= \
         max_steps * learner_cfg.batch_size
     assert run.fleet.stats()['unrolls'] == 0
+    # Round-11 liveness counters reach the driver summaries, and a
+    # healthy run reaps/wedges nothing.
+    import json as json_lib
+    import os as os_lib
+    summaries_path = os_lib.path.join(str(tmp_path), 'summaries.jsonl')
+    with open(summaries_path) as f:
+      tags = {json_lib.loads(line)['tag'] for line in f
+              if line.strip() and 'tag' in line}
+    for tag in ('remote_conns_reaped', 'remote_heartbeat_misses',
+                'param_subs_dropped', 'ingest_threads_wedged',
+                'remote_reattached', 'remote_stale_epoch_rejected',
+                'actors_wedged'):
+      assert tag in tags, tag
+    stats = run.ingest.stats()
+    assert stats['stale_epoch_rejected'] == 0
+    assert stats['ingest_threads_wedged'] == 0
     out, _ = child.communicate(timeout=120)
     assert child.returncode == 0, out[-2000:]
     assert 'CHILD_OK' in out, out[-2000:]
@@ -880,3 +896,439 @@ def test_remote_actor_reconnects_after_learner_restart():
       buffer_b.close()
   finally:
     t.join(timeout=10)
+
+
+# --- Round 11: transport liveness, partition tolerance, session
+# epochs (protocol v6). ---
+
+
+def _poll_until(predicate, timeout=8.0, interval=0.05):
+  deadline = time.monotonic() + timeout
+  while time.monotonic() < deadline:
+    if predicate():
+      return True
+    time.sleep(interval)
+  return predicate()
+
+
+def test_half_open_peer_reaped_within_deadline():
+  """The regression the round-11 deadlines exist for: a half-open peer
+  (partial frame, then silence) used to pin its ingest reader in
+  recv FOREVER. Now the reader/reaper pair closes it within the idle
+  budget, counts the reap, and the server keeps serving."""
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1',
+      idle_timeout_secs=0.5)
+  try:
+    raw = socket.create_connection(('127.0.0.1', server.port))
+    t0 = time.monotonic()
+    # A frame header promising 1000 bytes, then 20, then silence.
+    raw.sendall(remote._LEN.pack(1000) + b'\x00' + b'x' * 20)
+    assert _poll_until(lambda: server.stats()['conns_reaped'] >= 1)
+    reap_secs = time.monotonic() - t0
+    assert reap_secs < 5.0, reap_secs
+    # The reaped socket is actually closed (recv sees EOF/RST).
+    raw.settimeout(5.0)
+    try:
+      assert raw.recv(1) == b''
+    except ConnectionResetError:
+      pass
+    raw.close()
+    assert _poll_until(lambda: server.stats()['live'] == 0)
+    # No wedged threads: the reader unwound instead of leaking.
+    assert server.stats()['ingest_threads_wedged'] == 0
+    # The server survived: a healthy client still round-trips.
+    healthy = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                       connect_timeout_secs=10)
+    try:
+      assert healthy.fetch_params()[0] == 1
+    finally:
+      healthy.close()
+  finally:
+    server.close()
+    buffer.close()
+  assert server.stats()['unjoined_threads'] == 0
+
+
+def test_reaped_partial_unroll_discarded_without_buffer_corruption():
+  """A peer reaped mid-unroll: the partial OOB frame never reached the
+  handoff queue, so it is discarded WITH the connection — the buffer
+  holds exactly the healthy client's unrolls afterwards, bit-exact."""
+  cfg, agent, contract = _contract_setup()
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1', contract=contract,
+      idle_timeout_secs=0.5)
+  try:
+    # Handshake a raw socket, then ship HALF an unroll and go silent.
+    raw = socket.create_connection(('127.0.0.1', server.port))
+    remote._send_msg(raw, ('hello', contract))
+    reply = remote._recv_msg(raw)
+    assert reply[0] in ('params', 'params_bf16')
+    partial = _conforming_unroll(cfg, agent, 3, seed=5)
+    segments = remote._oob_frame_segments(('unroll', partial))
+    raw.sendall(bytes(segments[0]))          # head only: frame is
+    raw.sendall(bytes(segments[1][:10]))     # forever incomplete
+    assert _poll_until(lambda: server.stats()['conns_reaped'] >= 1)
+    raw.close()
+
+    # The buffer is untouched and a healthy unroll lands bit-exact.
+    assert len(buffer) == 0
+    client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                      connect_timeout_secs=10)
+    try:
+      client.handshake(contract)
+      good = _conforming_unroll(cfg, agent, 3, seed=6)
+      assert client.send_unroll(good) == 1
+      landed = buffer.get(timeout=5)
+      _assert_trees_equal(landed, good)
+      assert len(buffer) == 0
+      assert server.stats()['unrolls'] == 1
+      assert server.stats()['rejected'] == 0
+    finally:
+      client.close()
+  finally:
+    server.close()
+    buffer.close()
+
+
+def test_heartbeat_v6_interop_with_v5_client():
+  """A v5 client against a v6 heartbeat-enabled learner: the hello is
+  ACCEPTED (compatible protocols), heartbeats negotiate OFF for that
+  connection — no busy keepalives reach it mid-backpressure, and its
+  silence never counts heartbeat misses — while a v6 connection on
+  the same server does accrue misses when it goes silent."""
+  cfg, agent, contract = _contract_setup()
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1', contract=contract,
+      heartbeat_secs=0.15, idle_timeout_secs=5.0)
+  v5_contract = dict(contract, protocol=5)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    version, params = client.handshake(v5_contract)
+    assert version == 1
+    # The v6 server-info rode the reply (harmless to a real v5 client,
+    # which never reads element 3), so the epoch is visible here —
+    # but the SERVER treats the conn as v5.
+    unroll = _conforming_unroll(cfg, agent, 3, seed=7)
+    # v5 wire shape: no epoch stamp (clear what the client learned).
+    client.session_epoch = None
+    assert client.send_unroll(unroll, params_version=1) == 1
+    buffer.get(timeout=5)
+    # Silence well past 2x the heartbeat cadence: a v5 conn must not
+    # count misses (it never promised to ping).
+    time.sleep(0.6)
+    assert server.stats()['heartbeat_misses'] == 0
+
+    # A v6 handshake on a second connection DOES accrue misses.
+    v6 = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                  connect_timeout_secs=10)
+    try:
+      v6.handshake(contract)
+      assert v6.session_epoch == server.session_epoch
+      assert _poll_until(
+          lambda: server.stats()['heartbeat_misses'] >= 1, timeout=5)
+    finally:
+      v6.close()
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
+
+
+def test_idle_client_pings_survive_reaping_window():
+  """A v6 client pinging at the negotiated cadence stays connected
+  through many idle windows (the pong also reports publishes), while
+  the ping itself round-trips the current params version."""
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1',
+      heartbeat_secs=0.1, idle_timeout_secs=0.5)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10,
+                                    io_timeout_secs=5.0)
+  try:
+    client.handshake({'protocol': remote.PROTOCOL_VERSION})
+    assert client.ping() == 1
+    server.publish_params({'w': np.ones(2)})
+    deadline = time.monotonic() + 1.5  # 3x the idle window
+    while time.monotonic() < deadline:
+      assert client.ping() == 2
+      time.sleep(0.1)
+    stats = server.stats()
+    assert stats['conns_reaped'] == 0
+    assert stats['live'] == 1
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
+
+
+def test_busy_keepalive_distinguishes_slow_from_dead():
+  """While buffer backpressure holds an ack, a v6 client sees
+  ('busy',) keepalives at the heartbeat cadence — so its I/O deadline
+  can be TIGHTER than the worst-case ack delay without false drops."""
+  buffer = ring_buffer.TrajectoryBuffer(1)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1',
+      heartbeat_secs=0.1, idle_timeout_secs=5.0)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10,
+                                    io_timeout_secs=0.6)
+  try:
+    client.handshake({'protocol': remote.PROTOCOL_VERSION})
+    buffer.put(_tiny_unroll(0))  # full: the next ack is held back
+    acked = threading.Event()
+
+    def pump():
+      client.send_unroll(_tiny_unroll(1))
+      acked.set()
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    # Longer than the client's 0.6s I/O deadline: only the busy
+    # keepalives keep the connection alive through the wait.
+    time.sleep(1.0)
+    assert not acked.is_set()
+    buffer.get(timeout=5)
+    assert acked.wait(10)
+    t.join(timeout=5)
+    assert client.busy_frames >= 2, client.busy_frames
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
+
+
+def test_session_epoch_reattach_and_stale_epoch_refusal():
+  """The hard-crash restart contract: a restarted learner's epoch
+  differs; a hello carrying the PRIOR epoch counts as a fleet
+  re-attach (timed), and an unroll stamped with the dead incarnation's
+  epoch is refused with 'stale_epoch' — counted, never buffered."""
+  import pytest
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server_a = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1',
+      heartbeat_secs=0.2, idle_timeout_secs=5.0)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server_a.port}',
+                                    connect_timeout_secs=10)
+  try:
+    client.handshake({'protocol': remote.PROTOCOL_VERSION})
+    epoch_a = client.session_epoch
+    assert epoch_a == server_a.session_epoch
+  finally:
+    client.close()
+    server_a.close(graceful=False)  # crash semantics
+
+  server_b = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1',
+      heartbeat_secs=0.2, idle_timeout_secs=5.0)
+  assert server_b.session_epoch != epoch_a
+  client_b = remote.RemoteActorClient(f'127.0.0.1:{server_b.port}',
+                                      connect_timeout_secs=10)
+  try:
+    # Reattaching hello: prior epoch rides along -> counted + timed.
+    client_b.handshake({'protocol': remote.PROTOCOL_VERSION},
+                       prior_epoch=epoch_a)
+    stats = server_b.stats()
+    assert stats['reattached'] == 1
+    assert stats['reconnected'] == 0
+    assert stats['reattach_latency_secs'] >= 0.0
+
+    # An unroll stamped with the DEAD incarnation's epoch is refused.
+    client_b.session_epoch = epoch_a
+    with pytest.raises(remote.SessionEpochMismatch):
+      client_b.send_unroll(_tiny_unroll(1))
+    assert len(buffer) == 0
+    assert server_b.stats()['stale_epoch_rejected'] == 1
+
+    # Re-stamped with the live epoch it lands fine.
+    client_b.session_epoch = server_b.session_epoch
+    assert client_b.send_unroll(_tiny_unroll(2)) == 1
+    assert len(buffer) == 1
+    # A same-epoch re-hello counts as reconnect, not reattach.
+    client_c = remote.RemoteActorClient(f'127.0.0.1:{server_b.port}',
+                                        connect_timeout_secs=10)
+    try:
+      client_c.handshake({'protocol': remote.PROTOCOL_VERSION},
+                         prior_epoch=server_b.session_epoch)
+      assert server_b.stats()['reconnected'] == 1
+      assert server_b.stats()['reattached'] == 1
+    finally:
+      client_c.close()
+  finally:
+    client_b.close()
+    server_b.close()
+    buffer.close()
+
+
+def test_param_lane_drop_counter_and_graceful_bye():
+  """Round-11 satellites: every dropped param-lane subscriber is
+  counted (param_subs_dropped — silent fan-out shrinkage made
+  visible), an idle subscriber is reaped by the lane itself, and a
+  graceful close answers live subscribers with a clean 'bye' that the
+  client surfaces as LearnerShutdown."""
+  import pytest
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1')
+  try:
+    # A garbage subscriber is dropped AND counted.
+    bad = socket.create_connection(('127.0.0.1', server.port))
+    remote._send_msg(bad, ('hello_params',))
+    bad.sendall(remote._LEN.pack(8) + b'garbage!')
+    assert _poll_until(
+        lambda: server.stats()['param_subs_dropped'] >= 1)
+    bad.close()
+  finally:
+    server.close()
+    buffer.close()
+
+  # Idle-reaping on the lane: a quiet subscriber past the window.
+  buffer2 = ring_buffer.TrajectoryBuffer(4)
+  server2 = remote.TrajectoryIngestServer(
+      buffer2, {'w': np.zeros(1)}, host='127.0.0.1',
+      heartbeat_secs=0.1, idle_timeout_secs=0.4)
+  try:
+    quiet = socket.create_connection(('127.0.0.1', server2.port))
+    remote._send_msg(quiet, ('hello_params',))
+    assert _poll_until(
+        lambda: server2.stats()['param_subs_reaped'] >= 1, timeout=5)
+    quiet.close()
+  finally:
+    server2.close()
+    buffer2.close()
+
+  # Graceful close answers a live subscriber with 'bye' ->
+  # LearnerShutdown at the client.
+  buffer3 = ring_buffer.TrajectoryBuffer(4)
+  server3 = remote.TrajectoryIngestServer(
+      buffer3, {'w': np.zeros(1)}, host='127.0.0.1')
+  client = remote.RemoteActorClient(f'127.0.0.1:{server3.port}',
+                                    connect_timeout_secs=10)
+  try:
+    assert client.fetch_params()[0] == 1  # opens + caches the lane
+    server3.close(graceful=True)
+    with pytest.raises(remote.LearnerShutdown):
+      client.fetch_params()
+  finally:
+    client.close()
+    buffer3.close()
+
+
+def test_fetch_params_retries_once_on_reaped_lane():
+  """A cached param-lane subscriber reaped between fetches must cost
+  ONE transparent retry, not a whole trajectory-lane reconnect."""
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.arange(8.0)}, host='127.0.0.1',
+      heartbeat_secs=0.1, idle_timeout_secs=0.4)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    assert client.fetch_params()[0] == 1
+    # Wait out the idle window: the lane reaps the quiet subscriber.
+    assert _poll_until(
+        lambda: server.stats()['param_subs_reaped'] >= 1, timeout=5)
+    # The next fetch silently reopens and succeeds.
+    version, params = client.fetch_params()
+    assert version == 1
+    np.testing.assert_array_equal(params['w'], np.arange(8.0))
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
+
+
+def test_validate_transport_cross_links():
+  """validate_transport: hard range errors raise; the
+  reconnect-vs-restart-budget and heartbeat-vs-window cross-links
+  warn (round 11 satellite)."""
+  import pytest
+  from scalable_agent_tpu import config as config_lib
+
+  assert config_lib.validate_transport(config_lib.Config()) == []
+  with pytest.raises(ValueError, match='remote_heartbeat_secs'):
+    config_lib.validate_transport(
+        config_lib.Config(remote_heartbeat_secs=-1.0))
+  with pytest.raises(ValueError, match='actor_reconnect_secs'):
+    config_lib.validate_transport(
+        config_lib.Config(actor_reconnect_secs=-5.0))
+
+  short = config_lib.validate_transport(
+      config_lib.Config(actor_reconnect_secs=10.0))
+  assert any('restart budget' in w for w in short)
+  inverted = config_lib.validate_transport(
+      config_lib.Config(remote_heartbeat_secs=30.0,
+                        remote_conn_idle_timeout_secs=5.0))
+  assert any('reaping window' in w for w in inverted)
+  no_hb = config_lib.validate_transport(
+      config_lib.Config(remote_heartbeat_secs=0.0))
+  assert any('heartbeats disabled' in w for w in no_hb)
+  # The flipped default itself clears the budget cross-link.
+  assert config_lib.Config().actor_reconnect_secs >= \
+      config_lib.LEARNER_RESTART_BUDGET_SECS
+
+
+def test_close_counts_unjoined_threads_clean_case():
+  """Parity with InferenceServer.close(): join results are counted,
+  and a clean shutdown reports zero leaked threads."""
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1',
+      heartbeat_secs=0.2, idle_timeout_secs=1.0)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    client.handshake({'protocol': remote.PROTOCOL_VERSION})
+    assert client.send_unroll(_tiny_unroll(0)) == 1
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
+  assert server.stats()['unjoined_threads'] == 0
+  assert server.stats()['ingest_threads_wedged'] == 0
+
+
+def test_backpressured_conn_not_reaped_past_idle_window():
+  """Review fix (round 11): a lockstep client parked awaiting its ack
+  behind buffer backpressure sends NOTHING — by protocol. The reaper
+  must exempt conns with an in-flight unroll even when the silence
+  exceeds the idle window (reaping there would kill an obedient peer
+  and duplicate its unroll on reconnect)."""
+  buffer = ring_buffer.TrajectoryBuffer(1)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1',
+      heartbeat_secs=0.1, idle_timeout_secs=0.4)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10,
+                                    io_timeout_secs=2.0)
+  try:
+    client.handshake({'protocol': remote.PROTOCOL_VERSION})
+    buffer.put(_tiny_unroll(0))  # full: the ack will be held back
+    acked = threading.Event()
+
+    def pump():
+      client.send_unroll(_tiny_unroll(1))
+      acked.set()
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    # 3x the idle window of client-side silence while parked.
+    time.sleep(1.2)
+    assert server.stats()['conns_reaped'] == 0
+    assert server.stats()['heartbeat_misses'] == 0
+    buffer.get(timeout=5)
+    assert acked.wait(10)
+    t.join(timeout=5)
+    # Ack delivered on the ORIGINAL connection; exactly one copy of
+    # the unroll landed.
+    assert server.stats()['unrolls'] == 1
+    assert len(buffer) == 1
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
