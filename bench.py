@@ -1470,13 +1470,14 @@ def bench_transport(smoke):
   ingest_agent = ImpalaAgent(num_actions=9, use_instruction=False)
   contract = remote.trajectory_contract(ingest_cfg, ingest_agent, 9)
 
-  def run_ingest(nclients, validate):
+  def run_ingest(nclients, validate, wire_crc=True):
     import multiprocessing
     ctx = multiprocessing.get_context('spawn')
     buf = ring_buffer.TrajectoryBuffer(16)
     server = remote.TrajectoryIngestServer(
         buf, {'w': np.zeros(1)}, host='127.0.0.1',
-        contract=contract if validate else None)
+        contract=contract if validate else None,
+        wire_crc=wire_crc)
     stop_c = threading.Event()
 
     def drain():
@@ -1537,6 +1538,18 @@ def bench_transport(smoke):
   # validates, so the headline ingest numbers above include it; this
   # pair quantifies what the precompiled fast path left on the table.
   results['ingest_1conn_novalidate'] = run_ingest(1, False)
+  # The v7 CRC-cost delta (round 12): the headline rows run the
+  # production default (CRC negotiated ON — the clients handshake, so
+  # every unroll pays sender CRC + receiver verify); this row
+  # negotiates it OFF server-side, making the trailer overhead a
+  # measured fact (docs/PERF.md r10 records the accept call — the
+  # gate is <5% frames/s).
+  results['ingest_1conn_crc_off'] = run_ingest(1, True,
+                                               wire_crc=False)
+  on = results['ingest_1conn']['unrolls_per_sec']
+  off = results['ingest_1conn_crc_off']['unrolls_per_sec']
+  results['crc_overhead_fraction'] = (round(1.0 - on / off, 4)
+                                      if off else None)
   return results
 
 

@@ -348,6 +348,26 @@ flags.DEFINE_enum('publish_codec', _DEFAULTS.publish_codec,
 flags.DEFINE_integer('ingest_workers', _DEFAULTS.ingest_workers,
                      'Validate/commit workers behind the remote-'
                      'ingest reader threads (0 = auto).')
+flags.DEFINE_bool('wire_crc', _DEFAULTS.wire_crc,
+                  'Protocol v7 per-frame CRC32C trailers on the '
+                  'remote lanes (negotiated off for v5/v6 peers): a '
+                  'corrupt unroll is refused before the buffer put, '
+                  'a corrupt param blob before install '
+                  '(docs/TRANSPORT.md v7).')
+flags.DEFINE_bool('ckpt_digests', _DEFAULTS.ckpt_digests,
+                  'Record per-file content digests on verified '
+                  'checkpoint saves and re-verify them in the '
+                  'restore ladder — bit rot on a committed step '
+                  'falls back instead of restoring garbage.')
+flags.DEFINE_bool('sdc_check', _DEFAULTS.sdc_check,
+                  'Cross-replica param-fingerprint SDC sentinel '
+                  '(pure-DP meshes with >= 2 data replicas): replica '
+                  'disagreement escalates through the health ladder '
+                  '(docs/ROBUSTNESS.md, docs/RUNBOOK.md §9).')
+flags.DEFINE_bool('replay_crc', _DEFAULTS.replay_crc,
+                  'Verify replay-tier entries against their '
+                  'insert-time CRC at every serve; rot evicts '
+                  'instead of re-serving.')
 flags.DEFINE_bool('health_watchdog', _DEFAULTS.health_watchdog,
                   'Learner failure domain (health.py): skip '
                   'non-finite updates on device, roll back to the '

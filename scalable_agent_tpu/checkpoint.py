@@ -20,12 +20,13 @@ import json
 import logging
 import os
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 
 import orbax.checkpoint as ocp
 
+from scalable_agent_tpu import integrity
 from scalable_agent_tpu.learner import TrainState
 from scalable_agent_tpu.runtime import faults as faults_lib
 
@@ -35,6 +36,18 @@ log = logging.getLogger('scalable_agent_tpu')
 class CheckpointStructureError(ValueError):
   """The latest checkpoint's tree structure does not match the state
   built from the current config (see the message for likely flags)."""
+
+
+class CheckpointCorruption(RuntimeError):
+  """A retained step's on-disk CONTENT does not match the digests its
+  verified save recorded (round 12): bit rot after commit. Orbax's own
+  restore only catches partial/structural damage — a flipped byte
+  inside an array file restores 'successfully' as garbage params. The
+  restore ladder classifies this as per-step corruption (falls back
+  to the previous retained step), never as a config mismatch.
+
+  The message deliberately avoids every _STRUCTURE_MARKERS phrase so
+  `_looks_structural` routes it down the corruption arm."""
 
 
 # Markers Orbax puts in tree-STRUCTURE mismatch messages (vs corrupt/
@@ -112,7 +125,8 @@ class Checkpointer:
   """
 
   def __init__(self, directory: str, max_to_keep: int = 3,
-               save_interval_secs: float = 600.0):
+               save_interval_secs: float = 600.0,
+               verify_digests: bool = True):
     self._directory = os.path.abspath(directory)
     os.makedirs(self._directory, exist_ok=True)
     self._manager = ocp.CheckpointManager(
@@ -122,10 +136,21 @@ class Checkpointer:
     self._save_interval_secs = save_interval_secs
     self._last_save_time: Optional[float] = None
     self._last_good_path = os.path.join(self._directory, 'LAST_GOOD')
+    # Content-digest ledger (round 12; config.ckpt_digests): verified
+    # saves record a per-file CRC of the committed step; the restore
+    # ladder re-verifies before trusting a step, extending the PR 2
+    # fallback ladder from partial/structural damage to BIT ROT —
+    # orbax restores a flipped byte inside an array file
+    # 'successfully', as garbage params.
+    self._verify_digests = bool(verify_digests)
     # Integrity-ladder observability (driver summaries + tests).
     self.save_errors = 0
     self.last_save_error: Optional[BaseException] = None
     self.restore_fallbacks = 0
+    # Steps the ladder refused specifically for digest (bit-rot)
+    # mismatches — counted separately from structural/partial
+    # fallbacks so summaries can alarm on silent disk corruption.
+    self.digest_fallbacks = 0
 
   def save(self, state: TrainState, step: Optional[int] = None,
            force: bool = False) -> bool:
@@ -181,21 +206,150 @@ class Checkpointer:
                   '(%d files damaged, LAST_GOOD not advanced)', step,
                   len(damaged))
       return True
-    self._mark_last_good(step)
+    digests = self._record_digests(step)
+    self._mark_last_good(step, digests)
+    # Fault site 'ckpt_bitrot' (round 12): flip one byte in a file of
+    # the step JUST committed — AFTER its digests were recorded and
+    # LAST_GOOD advanced. Every marker now calls this step good; only
+    # the restore ladder's digest verification can catch it.
+    rot = faults_lib.fire('ckpt_bitrot')
+    if rot is not None:
+      plan = faults_lib.active()
+      faults_lib.bitrot_checkpoint_step(
+          self._directory, step, seed=plan.seed if plan else 0)
     return True
 
-  def _mark_last_good(self, step: int) -> None:
+  # --- content-digest ledger (round 12) ---
+
+  def _digest_path(self, step: int) -> str:
+    return os.path.join(self._directory, f'DIGEST_{int(step)}.json')
+
+  def _step_dir(self, step: int) -> Optional[str]:
+    """The on-disk directory of a retained step (orbax lays steps out
+    as '<step>' or '<prefix>.<step>' depending on version)."""
+    for name in os.listdir(self._directory):
+      path = os.path.join(self._directory, name)
+      if os.path.isdir(path) and (name == str(step)
+                                  or name.split('.')[-1] == str(step)):
+        return path
+    return None
+
+  def _record_digests(self, step: int) -> Optional[Dict]:
+    """Digest every file of a just-verified step and persist the
+    ledger (atomic, process 0). Returns the digest dict (also embedded
+    in the LAST_GOOD manifest). Best-effort: a digest failure must
+    not fail the save — it only costs bit-rot coverage for this
+    step."""
+    if not self._verify_digests:
+      return None
+    if jax.process_index() != 0:
+      # Only process 0 writes the ledger (and the LAST_GOOD manifest
+      # that embeds it) — the other hosts must not re-read and
+      # checksum the whole multi-GB step from shared storage for a
+      # result nothing consumes.
+      return None
+    try:
+      step_dir = self._step_dir(step)
+      if step_dir is None:
+        return None
+      digests = {}
+      for root, _, files in os.walk(step_dir):
+        for fname in files:
+          fpath = os.path.join(root, fname)
+          rel = os.path.relpath(fpath, step_dir)
+          digests[rel] = integrity.digest_record(
+              integrity.file_digest(fpath))
+      if jax.process_index() == 0:
+        tmp = self._digest_path(step) + '.tmp'
+        with open(tmp, 'w') as f:
+          json.dump({'step': int(step), 'algo': integrity.CRC_ALGO,
+                     'files': digests}, f)
+        os.replace(tmp, self._digest_path(step))
+        self._prune_digests()
+      return digests
+    except OSError:
+      log.exception('could not record content digests for step %d '
+                    '(bit-rot coverage lost for this step)', step)
+      return None
+
+  def _prune_digests(self) -> None:
+    """Drop digest ledgers of steps the manager no longer retains."""
+    retained = {str(int(s)) for s in self._manager.all_steps()}
+    for name in os.listdir(self._directory):
+      if not (name.startswith('DIGEST_') and name.endswith('.json')):
+        continue
+      if name[len('DIGEST_'):-len('.json')] not in retained:
+        try:
+          os.remove(os.path.join(self._directory, name))
+        except OSError:
+          pass
+
+  def verify_step_digests(self, step: int) -> Optional[bool]:
+    """Re-digest a retained step against its recorded ledger.
+
+    Returns True (verified), None (no ledger / foreign algorithm —
+    verification SKIPPED, logged), or raises CheckpointCorruption
+    naming the first rotted file. A recorded file that has gone
+    MISSING is corruption too (partial eviction under the marker)."""
+    if not self._verify_digests:
+      return None
+    try:
+      with open(self._digest_path(step)) as f:
+        ledger = json.load(f)
+    except (OSError, ValueError):
+      return None  # pre-round-12 step (or foreign writer): no ledger
+    files = ledger.get('files')
+    if not isinstance(files, dict):
+      return None
+    step_dir = self._step_dir(step)
+    if step_dir is None:
+      raise CheckpointCorruption(
+          f'checkpoint step {step} has a digest ledger but no step '
+          'directory on disk')
+    for rel, record in sorted(files.items()):
+      fpath = os.path.join(step_dir, rel)
+      try:
+        value = integrity.file_digest(fpath)
+      except OSError as e:
+        raise CheckpointCorruption(
+            f'checkpoint step {step}: recorded file {rel!r} is '
+            f'unreadable ({e}) — content verification failed')
+      verdict = integrity.verify_record(record, value)
+      if verdict is None:
+        log.warning(
+            'checkpoint step %d: digest for %r recorded with a '
+            'different algorithm (%r vs local %s) — content '
+            'verification skipped', step, rel, record,
+            integrity.CRC_ALGO)
+        return None
+      if not verdict:
+        raise CheckpointCorruption(
+            f'checkpoint step {step}: content digest verification '
+            f'failed for {rel!r} (crc {value:08x} differs from the '
+            f'recorded {int(record["crc"]):08x}) — bit rot after '
+            'commit; this step cannot be trusted')
+    return True
+
+  def _mark_last_good(self, step: int,
+                      digests: Optional[Dict] = None) -> None:
     """Atomically advance the LAST_GOOD marker (tmp + rename): only a
     save that verifiably finished earns it. Multi-host: process 0
     writes (shared checkpoint dirs must have one writer — same
-    convention as the driver's config.json)."""
+    convention as the driver's config.json). The verified save's
+    content digests ride the manifest (round 12), so the marker names
+    not just WHICH step is good but what its bytes looked like when
+    it earned the name."""
     if jax.process_index() != 0:
       return
     tmp = self._last_good_path + '.tmp'
     try:
+      manifest = {'step': int(step),
+                  'wall_time': round(time.time(), 3)}
+      if digests is not None:
+        manifest['digest_algo'] = integrity.CRC_ALGO
+        manifest['digests'] = digests
       with open(tmp, 'w') as f:
-        json.dump({'step': int(step),
-                   'wall_time': round(time.time(), 3)}, f)
+        json.dump(manifest, f)
       os.replace(tmp, self._last_good_path)
     except OSError:
       log.exception('could not write LAST_GOOD marker for step %d',
@@ -249,13 +403,22 @@ class Checkpointer:
     raises immediately with the config-flag guidance — older steps
     were written by the same config, so falling back cannot help and
     would only bury the real cause. Exhausting every step raises with
-    the corruption guidance for the newest failure."""
+    the corruption guidance for the newest failure.
+
+    Round 12: each rung first re-verifies the step's recorded content
+    digests (`verify_step_digests`) — BIT ROT on a committed step
+    restores 'successfully' through orbax as garbage params, so the
+    ladder must refuse it before orbax ever reads it. Digest refusals
+    are counted separately (`digest_fallbacks`)."""
     last_err: Optional[Tuple[int, BaseException]] = None
     for tried, step in enumerate(steps):
       try:
+        self.verify_step_digests(step)
         restored = restore_fn(step)
       except Exception as e:
-        if _looks_structural(e):
+        if isinstance(e, CheckpointCorruption):
+          self.digest_fallbacks += 1
+        elif _looks_structural(e):
           _wrap_structure_error(e, self._directory, step)
         log.warning(
             'checkpoint step %d failed to restore (%s: %s); falling '
@@ -325,8 +488,11 @@ class Checkpointer:
   def restore_step(self, step: int, target: TrainState) -> TrainState:
     """Single-step restore, NO ladder (the multi-host rollback path:
     every host must attempt the SAME step; a failure raises on all
-    hosts together — the same exposure as the startup restore)."""
+    hosts together — the same exposure as the startup restore).
+    Content digests still verify first: a bit-rotted rollback target
+    must fail loudly on every host, not restore as garbage."""
     try:
+      self.verify_step_digests(step)
       return self._make_full_restore_fn(target)(step)
     except Exception as e:
       _wrap_structure_error(e, self._directory, step)

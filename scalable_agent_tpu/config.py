@@ -344,6 +344,38 @@ class Config:
   # the ack run here, off the per-connection reader threads).
   # 0 = auto (min(4, cpu count)).
   ingest_workers: int = 0
+  # --- Data-plane integrity (round 12; docs/TRANSPORT.md v7,
+  # docs/ROBUSTNESS.md integrity rows). PRs 2/6/8 hardened against
+  # components that FAIL; these knobs defend against data that is
+  # WRONG — a bit-flipped unroll that still parses, a corrupted
+  # publish, disk rot under LAST_GOOD, a chip whose replica copy
+  # silently diverged. ---
+  # Protocol v7 per-frame CRC32C trailers on both remote lanes,
+  # negotiated per connection at hello (v5/v6 peers: off). A corrupt
+  # unroll is refused BEFORE the buffer put ('corrupt' reply — the
+  # client re-sends once, then quarantines itself); param blobs are
+  # trailer-checked by the fetching client. Overhead is measured by
+  # bench.py's transport stage (CRC on/off rows; <5% frames/s on the
+  # build host, docs/PERF.md r10).
+  wire_crc: bool = True
+  # Verified checkpoint saves record a per-file content digest
+  # (DIGEST_<step>.json + the LAST_GOOD manifest); the restore ladder
+  # re-verifies before trusting a step, classifying mismatch as
+  # corruption (fallback to the previous retained step) — extends the
+  # PR 2 ladder from partial/structural damage to BIT ROT.
+  ckpt_digests: bool = True
+  # In-graph SDC sentinel: per-data-replica param fingerprints
+  # (segmented uint32 sum of bit-cast leaves) cross-checked by the
+  # one-step-delayed health readback; replica disagreement =
+  # deterministic compute violated -> incident + the PR 2 rollback
+  # ladder (counted as sdc_replica_mismatches, separate from
+  # non-finite skips). Pure-DP meshes with >= 2 data replicas only;
+  # a no-op elsewhere.
+  sdc_check: bool = True
+  # Replay-tier entries keep their insert-time content CRC and are
+  # re-verified at every serve (reuse must not multiply host-memory
+  # rot into K batches); mismatches evict (replay_evictions_crc).
+  replay_crc: bool = True
   # --- Learner failure domain (health.py, round 7). ---
   # Training-health watchdog: the train step skips non-finite updates
   # on device (params carry over unchanged) and the driver escalates
@@ -545,6 +577,38 @@ def validate_transport(config: Config) -> List[str]:
         'abort, but a BETWEEN-frames half-open connection is never '
         'reaped and heartbeat misses are not counted — set a nonzero '
         'idle window to get the full liveness story' % hb)
+  return warnings
+
+
+def validate_integrity(config: Config) -> List[str]:
+  """Validate the data-plane-integrity knob group (round 12); returns
+  human-readable warnings (same contract as validate_replay /
+  validate_transport — driver.train and run_remote_actor call it
+  before spin-up). All knobs are booleans, so there are no hard range
+  errors — only cross-links where a half-enabled integrity plane is
+  probably a mistake."""
+  warnings = []
+  if config.sdc_check and not config.health_watchdog:
+    warnings.append(
+        'sdc_check=True with health_watchdog=False: replica '
+        'fingerprint mismatches would be computed but never escalated '
+        '(no monitor, no rollback ladder) — enable the watchdog or '
+        'disable the SDC sentinel')
+  if not config.wire_crc and config.remote_actor_port:
+    warnings.append(
+        'wire_crc=False with remote ingest enabled: a bit-flipped '
+        'unroll frame that still parses will train the learner on '
+        'garbage with no detection (the round-12 integrity plane is '
+        'off on the wire); param publishes keep their content digest '
+        'either way')
+  if (config.replay_crc and not config.wire_crc
+      and config.replay_ratio > 0):
+    warnings.append(
+        'replay_crc=True with wire_crc=False: replayed unrolls are '
+        'verified against their INSERT-time CRC, but a remote unroll '
+        'corrupted on the wire is inserted already-rotten and will '
+        're-serve cleanly — the replay check only covers rot AFTER '
+        'retention')
   return warnings
 
 

@@ -43,7 +43,8 @@ from scalable_agent_tpu import checkpoint as checkpoint_lib
 from scalable_agent_tpu import health as health_lib
 from scalable_agent_tpu import learner as learner_lib
 from scalable_agent_tpu import observability
-from scalable_agent_tpu.config import (Config, validate_replay,
+from scalable_agent_tpu.config import (Config, validate_integrity,
+                                       validate_replay,
                                        validate_transport)
 from scalable_agent_tpu.envs import factory, suites
 from scalable_agent_tpu.models import ImpalaAgent, init_params
@@ -335,6 +336,11 @@ def train(config: Config, max_steps: Optional[int] = None,
   # learner restart budget, heartbeat outside the reaping window) log.
   for warning in validate_transport(config):
     log.warning('%s', warning)
+  # Data-plane integrity knob group (round 12): cross-link warnings
+  # for a half-enabled integrity plane (SDC without the ladder, remote
+  # ingest without wire CRC).
+  for warning in validate_integrity(config):
+    log.warning('%s', warning)
   # NOTE round 8: the fused Pallas V-trace is no longer rejected under
   # a mesh — the sharded step runs it shard_map'ped over the data axis
   # (vtrace.py / ops/vtrace_pallas.sharded_from_importance_weights;
@@ -367,7 +373,8 @@ def train(config: Config, max_steps: Optional[int] = None,
   # restore from --logdir, ≈L570). ---
   checkpointer = checkpoint_lib.Checkpointer(
       config.logdir + '/checkpoints',
-      save_interval_secs=config.checkpoint_secs)
+      save_interval_secs=config.checkpoint_secs,
+      verify_digests=config.ckpt_digests)
   try:
     restored = checkpointer.restore_latest(state)
   except BaseException:
@@ -415,6 +422,19 @@ def train(config: Config, max_steps: Optional[int] = None,
                                 RESUME_MANIFEST + '.consumed'))
       except OSError:
         log.exception('could not consume the resume manifest')
+
+  # --- SDC sentinel (round 12): per-replica param fingerprints,
+  # cross-checked host-side one step delayed. Pure-DP meshes with
+  # >= 2 data replicas only — single device has nothing to compare,
+  # TP-sharded params legitimately differ per device. ---
+  sdc_fp_fn = None
+  sdc_replicas = 0
+  if (config.sdc_check and config.health_watchdog
+      and train_parallel.supports_sdc_check(config, mesh)):
+    sdc_fp_fn, sdc_replicas = train_parallel.make_sdc_fingerprint_fn(
+        mesh)
+    log.info('SDC sentinel armed: param fingerprints cross-checked '
+             'across %d data replicas', sdc_replicas)
 
   # Multi-host TP: state.params are sharded ACROSS processes, so a
   # jit over them (the inference step) is a collective SPMD program —
@@ -468,7 +488,8 @@ def train(config: Config, max_steps: Optional[int] = None,
     if config.replay_ratio > 0:
       replay_tier = ring_buffer.ReplayTier(
           config.resolved_replay_capacity,
-          max_staleness=config.resolved_replay_max_staleness)
+          max_staleness=config.resolved_replay_max_staleness,
+          verify_crc=config.replay_crc)
     buffer = ring_buffer.TrajectoryBuffer(
         capacity, replay=replay_tier, replay_ratio=config.replay_ratio)
     buffer.note_param_version(_initial_steps)
@@ -497,7 +518,8 @@ def train(config: Config, max_steps: Optional[int] = None,
           ingest_workers=config.ingest_workers,
           max_unroll_staleness=config.max_unroll_staleness,
           heartbeat_secs=config.remote_heartbeat_secs,
-          idle_timeout_secs=config.remote_conn_idle_timeout_secs)
+          idle_timeout_secs=config.remote_conn_idle_timeout_secs,
+          wire_crc=config.wire_crc)
       log.info('remote-actor ingest listening on port %d '
                '(session epoch %d)', ingest.port, ingest.session_epoch)
     # --- Inference server (weights served host-side to actor
@@ -910,12 +932,42 @@ def train(config: Config, max_steps: Optional[int] = None,
         prev_sentinel = pending_sentinel
         pending_sentinel = None
         if steps_done % config.health_check_every_steps == 0:
+          # SDC fingerprints ride the same delayed-read cadence: the
+          # [replicas] uint32 array is dispatched NOW (before the
+          # next step donates the state) and read one check later.
+          # The 'replica_divergence' fault site fires here — one
+          # event per health check — perturbing one replica's probe
+          # lane so the real detection→rollback path executes.
+          fp_handle = None
+          if sdc_fp_fn is not None:
+            probe = np.zeros((sdc_replicas,), np.uint32)
+            div = faults_lib.fire('replica_divergence')
+            if div is not None:
+              victim = div.index % sdc_replicas
+              probe[victim] = np.uint32(1 + (div.index % 1000))
+              incidents.event('fault_replica_divergence',
+                              step=step_now, replica=victim)
+            fp_handle = sdc_fp_fn(state.params, probe)
           pending_sentinel = (step_now,
-                              health_lib.stack_sentinels(metrics))
+                              health_lib.stack_sentinels(metrics),
+                              fp_handle)
       if health is not None and prev_sentinel is not None:
-        obs_step, handle = prev_sentinel
-        verdict = health.observe_values(
-            obs_step, health_lib.read_handle(handle))
+        obs_step, handle, fp_handle_prev = prev_sentinel
+        values = health_lib.read_handle(handle)
+        if fp_handle_prev is not None:
+          fps = np.asarray(jax.device_get(fp_handle_prev))
+          sdc_mismatch = bool((fps != fps[0]).any())
+          values['sdc_replica_mismatch'] = (1.0 if sdc_mismatch
+                                            else 0.0)
+          if sdc_mismatch:
+            incidents.event('sdc_replica_mismatch', step=obs_step,
+                            fingerprints=[int(x) for x in fps])
+            log.error(
+                'SDC sentinel: per-replica param fingerprints '
+                'DISAGREE at step %d: %s — deterministic compute '
+                'violated (suspect chip/HBM; docs/RUNBOOK.md §9)',
+                obs_step, [f'{int(x):08x}' for x in fps])
+        verdict = health.observe_values(obs_step, values)
         # Burst bracketing is driver-side state: the monitor resets
         # its consecutive count on a ROLLBACK verdict, so 'burst
         # ended' must be judged by verdicts, not that counter (a
@@ -1050,10 +1102,20 @@ def train(config: Config, max_steps: Optional[int] = None,
           writer.scalar('skipped_steps', hs['skipped_steps'], step_now)
           writer.scalar('flagged_steps', hs['flagged_steps'], step_now)
           writer.scalar('rollbacks', hs['rollbacks'], step_now)
+          # SDC sentinel (round 12): replica fingerprint mismatches,
+          # counted separately from non-finite skips — hardware lying
+          # vs math diverging are different operator responses.
+          writer.scalar('sdc_replica_mismatches',
+                        hs.get('sdc_mismatches', 0), step_now)
         writer.scalar('checkpoint_save_errors',
                       checkpointer.save_errors, step_now)
         writer.scalar('checkpoint_restore_fallbacks',
                       checkpointer.restore_fallbacks, step_now)
+        # Restore rungs refused for CONTENT-digest mismatch (bit rot
+        # on a committed step) — a strict subset of the fallbacks
+        # above, split out so disk rot alarms on its own curve.
+        writer.scalar('ckpt_digest_fallbacks',
+                      checkpointer.digest_fallbacks, step_now)
         # Buffer occupancy: ~0 means the learner is starved (env/
         # inference bound); ~capacity means actors are throttled by
         # backpressure (learner bound).
@@ -1228,6 +1290,34 @@ def train(config: Config, max_steps: Optional[int] = None,
           # wire-level quarantine (a corrupting peer must not be able
           # to take the learner down, only itself).
           writer.scalar('quarantined', ing['quarantined'], step_now)
+          # v7 payload integrity (round 12): unrolls refused before
+          # the put for a mismatched CRC trailer; param publishes the
+          # fleet refused to install (digest mismatch, reported back
+          # on the retry fetch); bytes/frames the discard paths threw
+          # away. Expected flat at zero — any slope is an incident.
+          writer.scalar('wire_crc_rejected',
+                        ing.get('wire_crc_rejected', 0), step_now)
+          writer.scalar('publish_digest_rejected',
+                        ing.get('publish_digest_rejected', 0),
+                        step_now)
+          writer.scalar('ingest_discarded_frames',
+                        ing.get('discarded_frames', 0), step_now)
+          writer.scalar('ingest_discarded_bytes',
+                        ing.get('discarded_bytes', 0), step_now)
+          if (ing.get('wire_crc_rejected', 0) >
+              last_ingest_snap.get('wire_crc_rejected', 0)):
+            incidents.event(
+                'wire_crc_rejected', step=step_now,
+                total=ing['wire_crc_rejected'],
+                delta=(ing['wire_crc_rejected'] -
+                       last_ingest_snap.get('wire_crc_rejected', 0)))
+          if (ing.get('publish_digest_rejected', 0) >
+              last_ingest_snap.get('publish_digest_rejected', 0)):
+            incidents.event(
+                'publish_digest_rejected', step=step_now,
+                total=ing['publish_digest_rejected'])
+            if health is not None:
+              health.note_external('publish_digest_rejected')
           # Per-lane transport counters (round 6). Ack latency is the
           # end-to-end backpressure signal remote pumps feel; the
           # per-connection rate spread separates one starved host

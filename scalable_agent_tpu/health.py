@@ -51,6 +51,9 @@ HALT = 'halt'
 
 # Sentinel keys read from the step metrics, in wire order. Missing
 # keys (no PopArt) read as NaN and their detectors stay off.
+# 'sdc_replica_mismatch' is NOT in this list: it is merged host-side
+# by the driver from the per-replica fingerprint readback (a [D]
+# uint32 array — it cannot ride the f32 sentinel stack exactly).
 _SENTINEL_KEYS = ('step_ok', 'total_loss', 'grad_norm',
                   'popart_sigma_min', 'popart_sigma_max')
 
@@ -150,6 +153,13 @@ class HealthMonitor:
     self.flagged_steps = 0    # all bad verdicts (incl. host-detected)
     self.rollbacks = 0
     self.halts = 0
+    # SDC sentinel (round 12): steps whose per-replica param
+    # fingerprints DISAGREED — deterministic compute violated on some
+    # chip. Counted separately from non-finite skips: a NaN burst is
+    # (usually) the math diverging; a fingerprint mismatch is the
+    # HARDWARE lying, and the operator response differs
+    # (docs/RUNBOOK.md §9 — drain the suspect host vs tune the run).
+    self.sdc_mismatches = 0
     self.last_reason = ''     # why the most recent bad step was bad
     # External (non-learner-step) incidents other planes report into
     # the health surface (round 11: the transport watchdog's wedged
@@ -164,6 +174,16 @@ class HealthMonitor:
     """(is_bad, reason) for one step's sentinel values. A value of
     None means 'not produced by this config' (detector stays off);
     NaN/inf means 'produced and non-finite' (bad)."""
+    sdc = values.get('sdc_replica_mismatch')
+    if sdc is not None and sdc > 0.5:
+      # Checked FIRST: a replica whose params copy silently diverged
+      # invalidates every other sentinel this step produced (they
+      # were computed against corrupt state on that replica). The
+      # rollback restore re-replicates params from the checkpoint —
+      # exactly the repair SDC needs.
+      return True, ('SDC: per-replica param fingerprints disagree — '
+                    'deterministic compute violated (suspect chip/'
+                    'HBM; see docs/RUNBOOK.md §9)')
     step_ok = values.get('step_ok')
     if step_ok is not None and step_ok < 0.5:
       return True, 'non-finite loss/grad (update skipped on device)'
@@ -220,6 +240,8 @@ class HealthMonitor:
     if bad:
       self.last_reason = reason
       self.flagged_steps += 1
+      if reason.startswith('SDC:'):
+        self.sdc_mismatches += 1
       step_ok = values.get('step_ok')
       if step_ok is not None and step_ok < 0.5:
         self.skipped_steps += 1
@@ -275,6 +297,7 @@ class HealthMonitor:
             'flagged_steps': self.flagged_steps,
             'rollbacks': self.rollbacks,
             'halts': self.halts,
+            'sdc_mismatches': self.sdc_mismatches,
             'consecutive_bad': self._consecutive_bad}
 
   def drain_report(self) -> Dict:

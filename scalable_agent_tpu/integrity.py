@@ -1,0 +1,136 @@
+"""Data-plane integrity primitives: CRC32C + pytree content digests.
+
+PRs 2, 6, and 8 hardened the pipeline against components that FAIL;
+nothing defended against data that is WRONG: a bit-flipped unroll
+frame that still parses trains the learner on garbage, a corrupted
+bf16 param publish silently poisons the whole inference fleet, and
+disk bit-rot inside a committed orbax step defeats the LAST_GOOD
+ladder (restore verifies structure, not content). This module is the
+one place that knows how to checksum bytes and trees; the consumers
+are:
+
+  runtime/remote.py    protocol v7 per-frame CRC32C trailers + the
+                       per-publish params content digest
+  checkpoint.py        per-array-file digests recorded by verified
+                       saves, re-verified by the restore ladder
+  runtime/ring_buffer  replay-tier entries keep their insert-time CRC
+                       so sample reuse can't serve host-memory rot
+
+CRC32C (Castagnoli) via the `google_crc32c` C extension when present
+(~GB/s — the jax stack already ships it as a dependency); zlib.crc32
+(IEEE polynomial, also C speed) as the fallback so the module never
+fails to import. The ALGORITHM NAME is part of every negotiation/
+record (`CRC_ALGO`): two hosts — or a checkpoint written on another
+host — only compare checksums produced by the same algorithm; a
+mismatch in algorithm negotiates the check off (wire) or skips the
+verification (disk) instead of reporting phantom corruption.
+
+The device-side counterpart (the in-graph SDC param fingerprint) lives
+in learner.param_fingerprint / parallel/train_parallel.py — it must
+run inside the compiled step, not on host bytes.
+"""
+
+import logging
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger('scalable_agent_tpu')
+
+try:  # pragma: no cover - exercised implicitly by every consumer
+  import google_crc32c as _crc32c_lib
+
+  def _crc_update(crc: int, data) -> int:
+    # The C extension accepts ONLY `bytes` (bytearray/memoryview are
+    # refused) — the copy costs ~0.1 ms/MB against the extension's
+    # ~20 GB/s CRC, still ~6x faster end to end than zlib.crc32's
+    # copy-free ~1 GB/s on the 2 MB flagship unroll.
+    if not isinstance(data, bytes):
+      data = bytes(data)
+    return _crc32c_lib.extend(crc, data)
+
+  CRC_ALGO = 'crc32c'
+except ImportError:  # pragma: no cover - container always has it
+  import zlib as _zlib
+
+  def _crc_update(crc: int, data) -> int:
+    return _zlib.crc32(data, crc) & 0xFFFFFFFF
+
+  CRC_ALGO = 'zlib-crc32'
+
+
+def crc_bytes(data, crc: int = 0) -> int:
+  """CRC of one bytes-like object (optionally extending `crc`)."""
+  return _crc_update(crc, data)
+
+
+class Crc:
+  """Incremental CRC accumulator (the wire receivers feed each frame
+  piece as it lands; the senders feed each segment as it ships)."""
+
+  __slots__ = ('value',)
+
+  def __init__(self, value: int = 0):
+    self.value = int(value)
+
+  def update(self, data) -> 'Crc':
+    self.value = _crc_update(self.value, data)
+    return self
+
+
+def tree_digest(tree) -> int:
+  """Content CRC of a pytree of host arrays, in deterministic
+  flatten order. Dtype/shape changes ARE content changes: each leaf
+  contributes its dtype name and shape to the stream, so a reshaped
+  or recast tree never collides with the original."""
+  import jax
+  crc = Crc()
+  for leaf in jax.tree_util.tree_leaves(tree):
+    arr = np.asarray(leaf)
+    crc.update(f'{arr.dtype.name}:{arr.shape};'.encode())
+    if not arr.flags['C_CONTIGUOUS']:
+      arr = np.ascontiguousarray(arr)
+    crc.update(arr.reshape(-1).view(np.uint8))
+  return crc.value
+
+
+def file_digest(path: str, chunk_bytes: int = 1 << 20) -> int:
+  """Content CRC of one file (checkpoint bit-rot ledger)."""
+  crc = Crc()
+  with open(path, 'rb') as f:
+    while True:
+      chunk = f.read(chunk_bytes)
+      if not chunk:
+        return crc.value
+      crc.update(chunk)
+
+
+def digest_record(value: int) -> Dict:
+  """The on-disk/wire spelling of a digest: value + algorithm, so a
+  reader produced by a different build refuses to compare instead of
+  reporting phantom corruption."""
+  return {'crc': int(value), 'algo': CRC_ALGO}
+
+
+def verify_record(record, value: int) -> Optional[bool]:
+  """Compare `value` against a `digest_record`. None = not comparable
+  (missing/malformed record or foreign algorithm — the caller should
+  SKIP verification, loudly); True/False = verified/corrupt."""
+  if not isinstance(record, dict):
+    return None
+  if record.get('algo') != CRC_ALGO:
+    return None
+  try:
+    return int(record['crc']) == int(value)
+  except (KeyError, TypeError, ValueError):
+    return None
+
+
+def flip_bit(buf: bytearray, bit_index: int) -> Tuple[int, int]:
+  """Flip one bit in-place; returns (byte_offset, bit). The chaos
+  sites (wire_bitflip / publish_corrupt / ckpt_bitrot) share this so
+  'a single bit flip' means the same thing at every layer."""
+  byte = (bit_index // 8) % max(len(buf), 1)
+  bit = bit_index % 8
+  buf[byte] ^= 1 << bit
+  return byte, bit

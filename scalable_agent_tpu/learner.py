@@ -250,6 +250,40 @@ def loss_fn(params, agent, batch: ActorOutput, config: Config,
   return total_loss, (metrics, aux)
 
 
+def param_fingerprint(params):
+  """Cheap in-graph content fingerprint of a param tree: every leaf
+  bit-cast to its same-width unsigned integer view and summed with
+  uint32 wraparound (round 12 — the device half of the SDC sentinel).
+
+  Properties the cross-replica check rests on:
+  - EXACT: integer addition mod 2^32 is associative/commutative, so
+    the value is independent of reduction order — two replicas holding
+    bit-identical params ALWAYS produce equal fingerprints (a float
+    reduction could not promise that).
+  - SENSITIVE: any single flipped bit in any leaf changes the sum
+    (one term changes by a power of two; collisions need a second
+    compensating corruption).
+  - CHEAP: one pass over the params, no host sync — it rides the
+    step's dispatch stream and is read one step later with the other
+    sentinels.
+
+  8-byte leaves bitcast to uint32 PAIRS (trailing dim 2) so the graph
+  never needs x64; bool leaves go through uint8."""
+  total = jnp.zeros((), jnp.uint32)
+  for leaf in jax.tree_util.tree_leaves(params):
+    a = jnp.asarray(leaf)
+    if a.size == 0:
+      continue
+    if a.dtype == jnp.bool_:
+      bits = a.astype(jnp.uint8)
+    else:
+      itemsize = a.dtype.itemsize
+      target = {1: jnp.uint8, 2: jnp.uint16}.get(itemsize, jnp.uint32)
+      bits = jax.lax.bitcast_convert_type(a, target)
+    total = total + jnp.sum(bits.astype(jnp.uint32))
+  return total
+
+
 def frames_per_step(config: Config):
   """Env frames consumed per SGD step (reference ≈L390)."""
   return config.frames_per_step

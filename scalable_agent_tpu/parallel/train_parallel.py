@@ -95,6 +95,81 @@ def make_sharded_train_step(agent, config: Config, mesh: Mesh,
   return jitted, place_batch
 
 
+def supports_sdc_check(config, mesh) -> bool:
+  """Whether the cross-replica SDC fingerprint check can run here:
+  it compares PER-REPLICA fingerprints of the (logically replicated)
+  params, which needs a pure-DP mesh (TP-sharded params give each
+  device a different — legitimately different — shard) with at least
+  two data replicas to compare. Single device has nothing to
+  cross-check; the driver then leaves the sentinel off."""
+  if mesh is None:
+    return False
+  if config.model_parallelism != 1:
+    return False
+  if mesh_lib.shard_batch_over_model(config):
+    return False
+  # Single-controller only (for now): the readback device_gets a
+  # P('data')-sharded array, which jax refuses when shards live on
+  # non-addressable devices — a multi-host SDC check needs an
+  # in-graph all-gather of the fingerprints before the host read
+  # (ROADMAP multi-host item). Gating here keeps the default-on knob
+  # from crashing the first multi-host pure-DP run.
+  if any(d.process_index != jax.process_index()
+         for d in mesh.devices.flat):
+    return False
+  return mesh.shape[mesh_lib.DATA_AXIS] >= 2
+
+
+def make_sdc_fingerprint_fn(mesh: Mesh):
+  """Per-replica param fingerprints for the SDC sentinel (round 12).
+
+  Returns (fingerprint_fn, num_replicas): `fingerprint_fn(params,
+  probe_host)` dispatches a shard_map over the data axis in which EACH
+  replica computes `learner.param_fingerprint` from ITS OWN copy of
+  the replicated params — the computation runs on every device against
+  the local HBM buffers, so a silently corrupted replica copy yields a
+  differing entry of the returned [num_replicas] uint32 array. The
+  driver reads it one step delayed (the sentinel pattern) and any
+  disagreement is deterministic-compute-violated: incident + the PR 2
+  rollback ladder (the restore re-replicates params, which is exactly
+  the repair real SDC needs).
+
+  `probe_host` is the chaos lane (runtime/faults.py
+  'replica_divergence'): a host uint32 vector, normally zeros, added
+  per-replica to the fingerprint INSIDE the graph. A GSPMD program
+  cannot make a logically replicated array truly diverge — real SDC
+  is a hardware fault below the program — so the drill perturbs the
+  detector's per-replica view instead, driving the identical
+  detection → incident → rollback path.
+
+  check_rep=False: params enter replicated but the output is
+  deliberately per-shard — the whole point is that 'replicated' is an
+  assumption the hardware can break, which is not a claim shard_map's
+  replication checker can express."""
+  from jax.experimental.shard_map import shard_map
+
+  num_replicas = int(mesh.shape[mesh_lib.DATA_AXIS])
+  probe_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+
+  def per_replica(params, probe):
+    fp = learner_lib.param_fingerprint(params)
+    return (fp + probe.reshape(())).reshape(1)
+
+  sharded = jax.jit(shard_map(
+      per_replica, mesh=mesh,
+      in_specs=(P(), P(mesh_lib.DATA_AXIS)),
+      out_specs=P(mesh_lib.DATA_AXIS), check_rep=False))
+
+  def fingerprint_fn(params, probe_host=None):
+    if probe_host is None:
+      probe_host = np.zeros((num_replicas,), np.uint32)
+    probe = jax.device_put(
+        np.ascontiguousarray(probe_host, np.uint32), probe_sharding)
+    return sharded(params, probe)
+
+  return fingerprint_fn, num_replicas
+
+
 def supports_unroll_staging(config, mesh) -> bool:
   """Whether staging_mode='unroll' can serve this topology.
 

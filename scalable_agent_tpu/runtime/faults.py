@@ -67,6 +67,46 @@ purpose:
                       process (scripts/chaos.py run_partition_storm),
                       which then restarts it and asserts the
                       restore-from-LAST_GOOD + fleet re-attach SLOs.
+    wire_bitflip      RemoteActorClient._rpc OOB sends (round 12):
+                      'flip' flips ONE seeded bit in the largest raw
+                      buffer of the outgoing unroll frame AFTER the
+                      v7 CRC trailer was computed — a frame that still
+                      PARSES (the flip lands in the frame-stack bytes,
+                      not the pickle skeleton), which is exactly the
+                      silent corruption the CRC exists to catch.
+                      Distinct from transport_send 'garbage' (which
+                      cannot parse and trips the quarantine path).
+                      The sender's own unroll is never touched (the
+                      damaged segment is a copy), so the scripted
+                      re-send ships clean bytes.
+    publish_corrupt   TrajectoryIngestServer._make_blob (round 12):
+                      flips one seeded bit in a float leaf of the
+                      params snapshot AFTER the content digest was
+                      computed but BEFORE serialization — host-memory
+                      rot between device_get and the wire. The frame
+                      CRC is consistent with the corrupted bytes (it
+                      is computed over them), so only the client's
+                      digest check before update_params can catch it.
+    ckpt_bitrot       Checkpointer.save (round 12): flips one byte in
+                      the largest file of the JUST-COMMITTED step
+                      AFTER its digests were recorded and LAST_GOOD
+                      advanced — disk rot on a step every marker calls
+                      good. Only the restore ladder's digest
+                      verification can catch it (the save already
+                      verified; structure stays intact).
+    replica_divergence  driver.train (round 12), one event per step:
+                      perturbs ONE data-parallel replica's input to
+                      the in-graph SDC param fingerprint (the probe
+                      lane of train_parallel.make_sdc_fingerprint_fn).
+                      A GSPMD program cannot make a logically
+                      replicated array actually diverge — real SDC is
+                      a hardware fault below the program — so the
+                      injection perturbs the detector's per-replica
+                      view instead, driving the IDENTICAL detection →
+                      incident → rollback path a truly diverged
+                      replica would: fingerprints disagree, health flags the
+                      step, the ladder rolls back (re-replicating
+                      params from the checkpoint — the real-SDC fix).
 
 The plan is installed process-globally (`install`/`clear`); sites are
 consulted via `fire(site)` which is a no-op returning None when no
@@ -92,7 +132,9 @@ from typing import Dict, List, Optional
 
 SITES = ('env_step', 'transport_send', 'checkpoint_save', 'nan_burst',
          'slot_exhaustion', 'preempt_signal', 'slow_learner',
-         'conn_partition', 'conn_delay', 'learner_crash')
+         'conn_partition', 'conn_delay', 'learner_crash',
+         'wire_bitflip', 'publish_corrupt', 'ckpt_bitrot',
+         'replica_divergence')
 
 _LEN = struct.Struct('>Q')
 
@@ -198,7 +240,13 @@ class FaultPlan:
             conn_partition_secs: float = 3.0,
             conn_delay: Optional[List[int]] = None,
             conn_delay_secs: float = 0.2,
-            learner_crash_at: Optional[int] = None
+            learner_crash_at: Optional[int] = None,
+            wire_bitflip: Optional[List[int]] = None,
+            publish_corrupt_at: Optional[int] = None,
+            publish_corrupt_len: int = 1,
+            ckpt_bitrot_at: Optional[int] = None,
+            replica_divergence_at: Optional[int] = None,
+            replica_divergence_len: int = 0
             ) -> 'FaultPlan':
     """The scripted multi-fault storm chaos.py runs: one builder so
     the schedule is a pure function of its arguments (+ seed, which
@@ -233,6 +281,22 @@ class FaultPlan:
                           param=conn_delay_secs))
     if learner_crash_at is not None:
       faults.append(Fault('learner_crash', learner_crash_at, 'kill'))
+    for idx in wire_bitflip or []:
+      faults.append(Fault('wire_bitflip', idx, 'flip'))
+    if publish_corrupt_at is not None:
+      # A LENGTH, not one shot: publishes are cached per version and
+      # replaced on a cadence — a single corrupt blob can be
+      # superseded before any client fetches it, so the storm
+      # corrupts a RUN of consecutive publishes to guarantee the
+      # fleet meets one.
+      for i in range(max(publish_corrupt_len, 1)):
+        faults.append(Fault('publish_corrupt', publish_corrupt_at + i,
+                            'flip'))
+    if ckpt_bitrot_at is not None:
+      faults.append(Fault('ckpt_bitrot', ckpt_bitrot_at, 'flip'))
+    for i in range(replica_divergence_len):
+      faults.append(Fault('replica_divergence',
+                          (replica_divergence_at or 0) + i, 'perturb'))
     return cls(faults, seed=seed)
 
 
@@ -428,6 +492,112 @@ def corrupt_checkpoint_step(directory: str, step: int) -> List[str]:
           f.truncate(size // 2)
         damaged.append(fpath)
   return damaged
+
+
+# --- site: wire_bitflip ---
+
+
+def apply_wire_bitflip(fault: Fault, segments, seed: int = 0):
+  """One seeded bit flip in the LARGEST raw-buffer segment of an
+  outgoing OOB frame — after the CRC trailer was computed, so the
+  receiver's v7 check sees exactly the silent-corruption shape: a
+  frame that parses (the flip lands in array bytes, not the pickle
+  skeleton) with a stale trailer. Returns a NEW segment list; the
+  caller's unroll (aliased by the other segments) is never touched,
+  so its scripted re-send ships clean bytes."""
+  import numpy as np
+  from scalable_agent_tpu import integrity
+  if len(segments) < 2:
+    return segments  # no raw buffers to damage (tiny frame): no-op
+  idx = max(range(1, len(segments)),
+            key=lambda i: memoryview(segments[i]).nbytes)
+  damaged = bytearray(segments[idx])
+  rng = np.random.RandomState((seed + fault.index) % (2 ** 31))
+  byte, bit = integrity.flip_bit(
+      damaged, int(rng.randint(0, max(len(damaged) * 8, 1))))
+  import logging
+  logging.getLogger('scalable_agent_tpu').warning(
+      'wire_bitflip fault firing (index %d): flipped bit %d of byte '
+      '%d in a %d-byte frame segment', fault.index, bit, byte,
+      len(damaged))
+  return segments[:idx] + [memoryview(damaged)] + segments[idx + 1:]
+
+
+# --- site: publish_corrupt ---
+
+
+def corrupt_params_tree(fault: Fault, params, seed: int = 0):
+  """Return `params` with ONE seeded bit flipped in its largest leaf
+  — host-memory rot between the digest computation and the wire
+  serialization. The caller computes the content digest BEFORE this
+  runs, so the shipped blob's frame CRC is self-consistent and only
+  the receiving client's digest check can catch the damage. Leaves
+  other than the victim alias the input (no tree copy). Dtype is NOT
+  filtered on: the wire form may be ml_dtypes.bfloat16 (numpy kind
+  'V'), and rot does not care what it flips."""
+  import jax
+  import numpy as np
+  from scalable_agent_tpu import integrity
+  leaves, treedef = jax.tree_util.tree_flatten(params)
+  candidates = [i for i, leaf in enumerate(leaves)
+                if np.asarray(leaf).size > 0]
+  if not candidates:
+    return params
+  victim = max(candidates, key=lambda i: np.asarray(leaves[i]).nbytes)
+  arr = np.array(leaves[victim], copy=True)
+  raw = bytearray(arr.tobytes())
+  rng = np.random.RandomState((seed + fault.index) % (2 ** 31))
+  integrity.flip_bit(raw, int(rng.randint(0, len(raw) * 8)))
+  leaves[victim] = np.frombuffer(
+      bytes(raw), dtype=arr.dtype).reshape(arr.shape)
+  import logging
+  logging.getLogger('scalable_agent_tpu').warning(
+      'publish_corrupt fault firing (index %d): flipped one bit in a '
+      '%d-byte param leaf after digest', fault.index, len(raw))
+  return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# --- site: ckpt_bitrot ---
+
+
+def bitrot_checkpoint_step(directory: str, step: int,
+                           seed: int = 0) -> str:
+  """Flip ONE byte mid-file in the largest file of a COMMITTED step
+  directory — disk rot after the save verified and LAST_GOOD advanced
+  (distinct from corrupt_checkpoint_step's half-truncated
+  mid-write shape, which the PR 2 ladder already catches without
+  digests). Returns the damaged path."""
+  import numpy as np
+  step_dir = None
+  for name in os.listdir(directory):
+    path = os.path.join(directory, name)
+    if os.path.isdir(path) and (name == str(step)
+                                or name.split('.')[-1] == str(step)):
+      step_dir = path
+      break
+  if step_dir is None:
+    raise FileNotFoundError(
+        f'no step directory for step {step} under {directory}')
+  candidates = []
+  for root, _, files in os.walk(step_dir):
+    for fname in files:
+      fpath = os.path.join(root, fname)
+      candidates.append((os.path.getsize(fpath), fpath))
+  if not candidates:
+    raise FileNotFoundError(f'step {step} directory is empty')
+  size, target = max(candidates)
+  rng = np.random.RandomState((seed + step) % (2 ** 31))
+  offset = int(rng.randint(0, max(size, 1)))
+  with open(target, 'r+b') as f:
+    f.seek(offset)
+    byte = f.read(1) or b'\x00'
+    f.seek(offset)
+    f.write(bytes((byte[0] ^ (1 << int(rng.randint(0, 8))),)))
+  import logging
+  logging.getLogger('scalable_agent_tpu').warning(
+      'ckpt_bitrot fault: flipped one bit at offset %d of %s', offset,
+      target)
+  return target
 
 
 # --- site: nan_burst ---

@@ -74,9 +74,28 @@ and provably accepts zero stale-incarnation unrolls
 `ThreadWatchdog` surfaces any service thread that still wedges
 (stats()['ingest_threads_wedged'] → driver summaries + incidents).
 
+Data-plane integrity (round 12 — docs/TRANSPORT.md v7,
+docs/ROBUSTNESS.md integrity rows): protocol v7 adds end-to-end
+payload verification on both lanes. Every frame on a CRC-negotiated
+connection carries a CRC32C trailer (integrity.py); the ingest
+validate/commit worker verifies it BEFORE the buffer put and answers
+`('corrupt', crc)` — the client re-sends once, then quarantines
+ITSELF (persistent CRC failures mean a bad NIC/host, docs/RUNBOOK.md
+§9). Param publishes additionally carry a CONTENT digest computed
+from the snapshot at publish time: the client verifies it before
+`update_params` installs anything into the inference arena, so a
+publish corrupted between device_get and the wire (where the frame
+CRC is self-consistent) is rejected fleet-wide without a version bump
+and refetched on backoff — and the rejection is reported back on the
+next `get_params`, so the learner's summaries see
+`publish_digest_rejected` without a client-side side channel. All of
+it negotiates OFF for v5/v6 peers at hello, the same extension
+pattern as every protocol bump since round 9.
+
 Trust model: pickle over cluster-internal sockets — identical trust to
 the reference's unauthenticated TF gRPC runtime. Never expose the
-ingest port outside the job's network.
+ingest port outside the job's network. The CRC is an INTEGRITY check
+against accidental corruption, not authentication.
 """
 
 import logging
@@ -96,6 +115,7 @@ from scalable_agent_tpu.observability import (LatencyReservoir,
 
 import numpy as np
 
+from scalable_agent_tpu import integrity
 from scalable_agent_tpu.runtime import faults as faults_lib
 from scalable_agent_tpu.runtime import ring_buffer
 
@@ -103,6 +123,11 @@ log = logging.getLogger('scalable_agent_tpu')
 
 _LEN = struct.Struct('>Q')
 _MAX_MSG = 1 << 32  # 4 GiB sanity bound
+# v7 per-frame CRC32C trailer: 4 big-endian bytes AFTER the payload on
+# connections that negotiated CRC at hello. The length prefix keeps
+# counting tag+payload only, so the framing stays v4-compatible — a
+# receiver that negotiated CRC simply reads 4 more bytes per frame.
+_CRC = struct.Struct('>I')
 # Frame kinds (one tag byte after the length prefix). PLAIN frames
 # carry one pickled object. OOB frames carry a pickle-protocol-5
 # skeleton plus the arrays' raw buffers out of band — pickling a
@@ -121,10 +146,20 @@ _OOB_BUFLEN = struct.Struct('>Q')
 _REMOTE_SEED_SPACE = 1 << 24
 
 
-def _send_msg(sock: socket.socket, obj) -> None:
-  payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-  sock.sendall(_LEN.pack(len(payload) + 1) + bytes((_FRAME_PLAIN,))
-               + payload)
+def _plain_frame(payload: bytes, crc: bool = False) -> bytes:
+  """One complete PLAIN wire frame for pre-pickled payload bytes,
+  with the v7 CRC trailer when `crc` (the trailer covers tag+payload
+  — everything the length prefix counts)."""
+  body = bytes((_FRAME_PLAIN,)) + payload
+  frame = _LEN.pack(len(body)) + body
+  if crc:
+    frame += _CRC.pack(integrity.crc_bytes(body))
+  return frame
+
+
+def _send_msg(sock: socket.socket, obj, crc: bool = False) -> None:
+  sock.sendall(_plain_frame(
+      pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL), crc=crc))
 
 
 # Buffers at or below this coalesce into one sendall with their
@@ -154,14 +189,23 @@ def _oob_frame_segments(obj) -> List:
   return [head] + raws
 
 
-def _send_oob(sock: socket.socket, obj) -> None:
-  """Ship `obj` with its array buffers OUT of the pickle stream: the
-  skeleton + per-buffer lengths go in the frame head, then each raw
-  buffer is sent directly (no pickler copy). Small adjacent buffers
-  coalesce into one sendall (`_OOB_COALESCE`); big ones go as bare
-  sendalls on their memoryview — no 2 MB join. The receiver
-  reconstructs with zero-copy views."""
-  segments = _oob_frame_segments(obj)
+def _segments_crc(segments) -> int:
+  """CRC32C over a complete OOB frame's CONTENT (everything the
+  length prefix counts: tag + meta + skeleton + table + raw buffers —
+  i.e. segment 0 minus its 8-byte length prefix, then every raw)."""
+  acc = integrity.Crc()
+  acc.update(memoryview(segments[0])[_LEN.size:])
+  for raw in segments[1:]:
+    acc.update(raw)
+  return acc.value
+
+
+def _send_segments(sock: socket.socket, segments,
+                   trailer: Optional[bytes] = None) -> None:
+  """Stream a pre-built frame's segments with small-buffer coalescing
+  (`_OOB_COALESCE`); big ones go as bare sendalls on their memoryview
+  — no 2 MB join. `trailer` (the v7 CRC bytes) rides the final
+  flush."""
   pending = [segments[0]]
 
   def flush():
@@ -172,14 +216,61 @@ def _send_oob(sock: socket.socket, obj) -> None:
     pending.clear()
 
   for raw in segments[1:]:
-    if raw.nbytes <= _OOB_COALESCE:
+    if memoryview(raw).nbytes <= _OOB_COALESCE:
       pending.append(raw)
       if sum(len(p) for p in pending) > _OOB_COALESCE:
         flush()
     else:
       flush()
       sock.sendall(raw)
+  if trailer is not None:
+    pending.append(trailer)
   flush()
+
+
+def _send_oob(sock: socket.socket, obj, crc: bool = False) -> None:
+  """Ship `obj` with its array buffers OUT of the pickle stream: the
+  skeleton + per-buffer lengths go in the frame head, then each raw
+  buffer is sent directly (no pickler copy). The receiver
+  reconstructs with zero-copy views. With `crc`, the v7 trailer is
+  computed over the frame content BEFORE the wire_bitflip fault site
+  runs — an injected flip ships with a stale trailer, exactly the
+  silent-corruption shape the check exists to catch."""
+  segments = _oob_frame_segments(obj)
+  trailer = _CRC.pack(_segments_crc(segments)) if crc else None
+  plan = faults_lib.active()
+  fault = faults_lib.fire('wire_bitflip')
+  if fault is not None:
+    segments = faults_lib.apply_wire_bitflip(
+        fault, segments, seed=plan.seed if plan else 0)
+  _send_segments(sock, segments, trailer)
+
+
+class _CrcContext:
+  """Per-frame CRC ledger for a receive on a v7 CRC connection:
+  `_recv_msg` accumulates the computed CRC over every frame piece as
+  it lands and records the wire trailer; the CALLER compares (the
+  ingest worker does it just before the buffer put, so a corrupt
+  unroll is refused with the benign ('corrupt', crc) reply instead of
+  a connection drop — the reader only hard-fails frames whose very
+  parse is untrustworthy)."""
+
+  __slots__ = ('computed', 'wire')
+
+  def __init__(self):
+    self.computed = 0
+    self.wire: Optional[int] = None
+
+  def reset(self):
+    self.computed = 0
+    self.wire = None
+
+  def update(self, data):
+    self.computed = integrity.crc_bytes(data, self.computed)
+
+  @property
+  def ok(self) -> bool:
+    return self.wire is not None and self.wire == self.computed
 
 
 class _FrameStall(OSError):
@@ -236,13 +327,20 @@ class _ConnLiveness:
     self._heartbeat_secs = heartbeat_secs
     self._last_busy = time.monotonic()
     self.in_frame = False  # header received, frame body outstanding
+    # Bytes of the CURRENT frame received so far (header included):
+    # the discard ledger — when a frame is thrown away (quarantine on
+    # an unparseable frame, a mid-frame stall reap), the reader
+    # reports HOW MUCH was discarded instead of dropping the partial
+    # accounting on the floor (round 12 fix).
+    self.frame_bytes = 0
 
   def beat(self):
     if self._watchdog is not None:
       self._watchdog.beat(self._name)
 
   def progress(self, nbytes):
-    del nbytes
+    if self.in_frame:
+      self.frame_bytes += nbytes
     self._conn.last_recv = time.monotonic()
     self._conn.hb_missed = False
     self.beat()
@@ -328,20 +426,34 @@ def _sendall_bounded(sock: socket.socket, data, stall_secs: float,
     last_progress = time.monotonic()
 
 
-def _recv_msg(sock: socket.socket, liveness=None):
+def _recv_msg(sock: socket.socket, liveness=None, crc_ctx=None):
   """One message (either frame kind), or None on clean EOF.
 
   OOB frames recv each array buffer straight into its own
   UNINITIALIZED storage (np.empty + recv_into): one 2.11 MB unroll
   used to land in a zero-filled bytearray first — ~95 µs of memset
   holding the GIL per message, one of the two per-message costs that
-  kept multi-connection ingest from scaling (round 6)."""
+  kept multi-connection ingest from scaling (round 6).
+
+  `crc_ctx` (v7 CRC-negotiated connections): the computed CRC over
+  every frame piece and the 4-byte wire trailer land on the context;
+  the CALLER compares (a mismatched unroll earns a benign 'corrupt'
+  reply, not a drop). The trailer read happens inside the in_frame
+  window — a peer stalling mid-trailer is still a mid-frame stall."""
   header = _recv_exact(sock, _LEN.size, liveness)
   if header is None:
     return None
+  # The discard ledger resets the moment a new header lands — BEFORE
+  # the length sanity check below can raise, or an oversized-length
+  # quarantine would charge the PREVIOUS (successfully committed)
+  # frame's byte count to the discard accounting.
+  if liveness is not None:
+    liveness.frame_bytes = _LEN.size
   (length,) = _LEN.unpack(header)
   if length > _MAX_MSG:
     raise ValueError(f'message length {length} exceeds bound')
+  if crc_ctx is not None:
+    crc_ctx.reset()
   if liveness is not None:
     # The frame has begun: from here to return, peer silence past the
     # stall window is a half-open MID-frame stall — the flag spans
@@ -349,28 +461,41 @@ def _recv_msg(sock: socket.socket, liveness=None):
     # _recv_exact boundaries.
     liveness.in_frame = True
   try:
-    return _recv_msg_body(sock, length, liveness)
+    msg = _recv_msg_body(sock, length, liveness, crc_ctx)
+    if crc_ctx is not None:
+      trailer = _recv_exact(sock, _CRC.size, liveness)
+      if trailer is None:
+        raise ConnectionError('EOF mid-message (CRC trailer)')
+      crc_ctx.wire = _CRC.unpack(trailer)[0]
+    return msg
   finally:
     if liveness is not None:
       liveness.in_frame = False
 
 
-def _recv_msg_body(sock: socket.socket, length: int, liveness):
+def _recv_msg_body(sock: socket.socket, length: int, liveness,
+                   crc_ctx=None):
+  def feed(data):
+    if crc_ctx is not None:
+      crc_ctx.update(data)
+    return data
+
   tag = _recv_exact(sock, 1, liveness)
   if tag is None:
     raise ConnectionError('EOF mid-message')
+  feed(tag)
   kind = tag[0]
   if kind == _FRAME_PLAIN:
     payload = _recv_exact(sock, length - 1, liveness)
     if payload is None:
       raise ConnectionError('EOF mid-message')
-    return pickle.loads(memoryview(payload))
+    return pickle.loads(memoryview(feed(payload)))
   if kind == _FRAME_OOB:
     head_len = _OOB_META.size
     head = _recv_exact(sock, head_len, liveness)
     if head is None:
       raise ConnectionError('EOF mid-message')
-    nbufs, skel_len = _OOB_META.unpack(head)
+    nbufs, skel_len = _OOB_META.unpack(feed(head))
     # Bound the header-derived sizes by the ALREADY-validated frame
     # length BEFORE allocating or recv'ing anything sized by them: a
     # corrupt peer can put 2^32-1 in either meta field independently
@@ -384,7 +509,7 @@ def _recv_msg_body(sock: socket.socket, length: int, liveness):
                         liveness)
     if table is None:
       raise ConnectionError('EOF mid-message')
-    view = memoryview(table)
+    view = memoryview(feed(table))
     skeleton = view[:skel_len]
     sizes = [_OOB_BUFLEN.unpack_from(view,
                                      skel_len + _OOB_BUFLEN.size * i)[0]
@@ -398,7 +523,7 @@ def _recv_msg_body(sock: socket.socket, length: int, liveness):
       buf = memoryview(np.empty(int(size), np.uint8))
       if _recv_into(sock, buf, int(size), liveness) < size:
         raise ConnectionError('EOF mid-message')
-      buffers.append(buf)
+      buffers.append(feed(buf))
     return pickle.loads(skeleton, buffers=buffers)
   raise ValueError(f'unknown frame kind {kind}')
 
@@ -426,6 +551,33 @@ class SessionEpochMismatch(ConnectionError):
   learner incarnation that no longer exists. A ConnectionError on
   purpose — the reconnect path (full re-handshake, fresh epoch +
   params) is exactly the right response."""
+
+
+class UnrollCorrupt(RuntimeError):
+  """The learner's v7 CRC check refused this unroll ('corrupt' reply):
+  the bytes that arrived are not the bytes that were sent. The
+  connection is FINE (the reply proves it) — the pump re-sends the
+  same unroll once; a second refusal for the same unroll means the
+  corruption is on this host's own path (NIC/RAM) and the host
+  quarantines itself instead of feeding the learner garbage."""
+
+  def __init__(self, message: str, crc: Optional[int] = None):
+    super().__init__(message)
+    self.crc = crc
+
+
+class ParamsCorrupt(RuntimeError):
+  """A fetched param snapshot failed its content digest: the blob the
+  learner published is not the tree the learner digested at publish
+  time (host-memory rot between device_get and serialization — the
+  frame CRC is self-consistent, only the digest can see this). The
+  snapshot must NOT be installed; the caller keeps its current params
+  and refetches on backoff (a corrupt blob stays corrupt until the
+  next publish)."""
+
+  def __init__(self, message: str, version: Optional[int] = None):
+    super().__init__(message)
+    self.version = version
 
 
 class Backoff:
@@ -520,12 +672,42 @@ class Backoff:
 #     with the learner process), but it makes "zero stale-epoch
 #     unrolls accepted across a restart" an asserted invariant instead
 #     of an assumption (chaos.py run_partition_storm).
-PROTOCOL_VERSION = 6
+# v7 (round 12): end-to-end payload integrity, v5/v6-COMPATIBLE both
+# ways (the same negotiation pattern — every v7 mechanism turns OFF
+# per connection for older peers):
+#   - the client-info dict MAY carry {'crc': True, 'crc_algo': <name>}
+#     in the hello; a v7 server running wire_crc answers with
+#     {'crc': True, 'crc_algo': ...} in its server-info — from the
+#     NEXT frame on, every frame BOTH ways on that connection carries
+#     a 4-byte CRC32C trailer after the payload (the length prefix
+#     still counts tag+payload only). Algorithms must MATCH (a host
+#     without the crc32c extension falls back to zlib-crc32;
+#     cross-algorithm pairs negotiate the check off instead of
+#     reporting phantom corruption).
+#   - an unroll whose trailer does not match earns ('corrupt',
+#     computed_crc) — verified by the ingest worker BEFORE the buffer
+#     put, counted in stats()['wire_crc_rejected'], connection kept.
+#     The client re-sends the unroll ONCE; a second corrupt reply for
+#     the same unroll means the damage is on THIS host's path (NIC/
+#     RAM) and the client quarantines itself (docs/RUNBOOK.md §9).
+#   - params replies' server-info carries 'params_digest' — a content
+#     CRC of the (wire-form) snapshot computed at publish time. The
+#     client verifies it BEFORE update_params installs anything; a
+#     mismatch (corruption upstream of frame serialization, where the
+#     frame CRC is self-consistent) rejects the install without a
+#     version bump, and the client's next 'get_params' carries a
+#     {'digest_rejected': version} notice so the learner's
+#     publish_digest_rejected counter sees the fleet-side refusal.
+#   - 'hello_params' MAY carry the same client-info dict; the param
+#     lane then appends the cached trailer to its blob replies and
+#     verifies trailers on requests.
+PROTOCOL_VERSION = 7
 
 # Handshakes accepted without negotiation failure: v5 peers get the
 # round-9 wire exactly (no heartbeats, no busy keepalives, no epoch
+# checks), v6 peers the round-11 wire (no CRC trailers, no digest
 # checks); everything else about the lanes is unchanged.
-_COMPATIBLE_PROTOCOLS = (5, 6)
+_COMPATIBLE_PROTOCOLS = (5, 6, 7)
 
 # Bound on the reader→worker handoff queue. The request→reply
 # lockstep already implies at most one in-flight unroll per live
@@ -807,6 +989,12 @@ class _Conn:
     self.heartbeat = False     # negotiated: v6 peer + server heartbeat
     self.hb_missed = False     # current silence window already counted
     self.reaped = False        # reaper-initiated close in progress
+    # v7 payload integrity, negotiated at hello: when True, every
+    # frame BOTH ways on this connection carries the CRC32C trailer
+    # (the hello reply itself is pre-negotiation and ships per the
+    # conn's PRIOR state, so a re-handshake stays parseable).
+    self.crc = False
+    self.crc_rejected = 0      # unrolls refused with ('corrupt', crc)
     # Unrolls handed to the worker pool whose ack has not gone out
     # yet. A LOCKSTEP client is silent BY PROTOCOL while its unroll is
     # in flight (it may be parked for minutes behind buffer
@@ -838,23 +1026,26 @@ class _Conn:
   def send(self, obj) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     with self.send_lock:
-      self._write(_LEN.pack(len(payload) + 1) + bytes((_FRAME_PLAIN,))
-                  + payload)
+      self._write(_plain_frame(payload, crc=self.crc))
 
   def send_bytes(self, payload: bytes) -> None:
     """Ship pre-serialized bytes (a cached plain frame): handler
     threads must not re-pickle the whole tree per request."""
     with self.send_lock:
-      self._write(_LEN.pack(len(payload) + 1)
-                  + bytes((_FRAME_PLAIN,)) + payload)
+      self._write(_plain_frame(payload, crc=self.crc))
 
-  def send_segments(self, segments) -> None:
+  def send_segments(self, segments,
+                    trailer: Optional[bytes] = None) -> None:
     """Ship a pre-built wire frame as its segments (the cached param
     snapshot frame: head + raw buffers) without joining them into one
-    giant bytes object first."""
+    giant bytes object first. `trailer`: the frame's cached CRC bytes
+    — passed ONLY when this send should carry one (the caller knows
+    whether the peer expects v7 trailers on this frame)."""
     with self.send_lock:
       for seg in segments:
         self._write(seg)
+      if trailer is not None:
+        self._write(trailer)
 
   def try_send(self, obj, timeout: float = 2.0) -> bool:
     """Bounded best-effort send: never blocks shutdown behind a stuck
@@ -864,7 +1055,7 @@ class _Conn:
       return False
     try:
       self.sock.settimeout(timeout)
-      _send_msg(self.sock, obj)
+      _send_msg(self.sock, obj, crc=self.crc)
       return True
     except OSError:
       return False
@@ -897,7 +1088,7 @@ class _ParamLane:
   def __init__(self, blob_fn, chunk_bytes: int = 128 * 1024,
                idle_timeout_secs: float = 0.0,
                watchdog: Optional[ThreadWatchdog] = None):
-    self._blob_fn = blob_fn  # () -> cached COMPLETE frame segments
+    self._blob_fn = blob_fn  # () -> (cached frame segments, trailer)
     self._chunk = chunk_bytes
     self._idle_timeout = float(idle_timeout_secs)
     self._watchdog = watchdog
@@ -911,6 +1102,12 @@ class _ParamLane:
     # invisible until the fleet's params went uniformly stale.
     self._subs_dropped = 0
     self._subs_reaped = 0   # the idle/half-open subset of the drops
+    # Integrity ledger (round 12): digest-rejected notices subscribers
+    # attach to their retry fetches — the learner-side visibility of
+    # "a corrupt publish was refused fleet-wide" — and requests whose
+    # own v7 trailer failed (a corrupting subscriber loses its sub).
+    self._digest_rejected = 0
+    self._req_crc_dropped = 0
     # Self-pipe: adopt()/close() must wake a parked select().
     self._wake_r, self._wake_w = socket.socketpair()
     self._wake_r.setblocking(False)
@@ -923,19 +1120,23 @@ class _ParamLane:
   class _Sub:
     """Per-subscriber state: request parse buffer + outgoing chunks."""
 
-    def __init__(self, sock):
+    def __init__(self, sock, crc: bool = False):
       self.sock = sock
+      self.crc = crc  # v7: trailers on replies, verified on requests
       self.rbuf = bytearray()
       self.out: List[memoryview] = []  # remaining reply bytes
       self.last_recv = time.monotonic()  # idle-reaping clock
 
-  def adopt(self, sock: socket.socket) -> bool:
+  def adopt(self, sock: socket.socket, crc: bool = False) -> bool:
     """Hand a connected socket to the lane (called from the accept
-    handler once the peer said 'hello_params'). False if closing."""
+    handler once the peer said 'hello_params'). False if closing.
+    `crc`: the hello_params negotiation — this subscriber's replies
+    carry the blob's cached v7 trailer and its requests are
+    trailer-verified."""
     with self._lock:
       if self._closed:
         return False
-      self._pending_adopts.append(sock)
+      self._pending_adopts.append((sock, crc))
     try:
       self._wake_w.send(b'x')
     except OSError:
@@ -946,7 +1147,9 @@ class _ParamLane:
     with self._lock:
       return {'blobs': self._blobs_served, 'bytes': self._bytes_sent,
               'subs_dropped': self._subs_dropped,
-              'subs_reaped': self._subs_reaped}
+              'subs_reaped': self._subs_reaped,
+              'digest_rejected': self._digest_rejected,
+              'req_crc_dropped': self._req_crc_dropped}
 
   def _drop(self, sub, reaped: bool = False):
     with self._lock:
@@ -968,7 +1171,12 @@ class _ParamLane:
 
   def _queue_reply(self, sub, payload: bytes):
     header = _LEN.pack(len(payload) + 1) + bytes((_FRAME_PLAIN,))
-    self._queue_segments(sub, (header, payload))
+    if sub.crc:
+      self._queue_segments(sub, (header, payload, _CRC.pack(
+          integrity.crc_bytes(payload, integrity.crc_bytes(
+              bytes((_FRAME_PLAIN,)))))))
+    else:
+      self._queue_segments(sub, (header, payload))
 
   def _on_readable(self, sub) -> bool:
     """Drain request bytes; False = connection is gone."""
@@ -990,10 +1198,22 @@ class _ParamLane:
         log.warning('param lane: oversized request frame (%d bytes); '
                     'dropping subscriber', length)
         return False
-      if len(sub.rbuf) < _LEN.size + length:
+      # v7 subscribers append a 4-byte CRC trailer to every request.
+      want = _LEN.size + length + (_CRC.size if sub.crc else 0)
+      if len(sub.rbuf) < want:
         return True
       frame = bytes(sub.rbuf[_LEN.size:_LEN.size + length])
-      del sub.rbuf[:_LEN.size + length]
+      if sub.crc:
+        (wire_crc,) = _CRC.unpack_from(sub.rbuf, _LEN.size + length)
+        if wire_crc != integrity.crc_bytes(frame):
+          # A request this tiny failing its CRC means the subscriber's
+          # send path corrupts — nothing it asks for can be trusted.
+          with self._lock:
+            self._req_crc_dropped += 1
+          log.warning('param lane: request failed its CRC trailer; '
+                      'dropping subscriber')
+          return False
+      del sub.rbuf[:want]
       try:
         if frame[0] != _FRAME_PLAIN:
           raise ValueError(f'unexpected frame kind {frame[0]}')
@@ -1005,11 +1225,31 @@ class _ParamLane:
         return False
       if kind in ('get_params', 'hello_params', 'ping'):
         # hello_params may arrive here when the peer pipelined it with
-        # its first fetch; it needs no reply of its own.
+        # its first fetch; it needs no reply of its own (but a v7 info
+        # dict still upgrades the sub's CRC negotiation).
+        if kind == 'hello_params' and len(msg) > 1 and \
+            isinstance(msg[1], dict):
+          sub.crc = bool(msg[1].get('crc')) and \
+              msg[1].get('crc_algo') == integrity.CRC_ALGO
         if kind == 'get_params':
+          # v7 retry fetches MAY carry a digest-rejected notice: the
+          # subscriber refused to install version N because its
+          # content digest failed — the learner-side ledger of a
+          # corrupt publish being rejected fleet-wide.
+          if len(msg) > 1 and isinstance(msg[1], dict) and \
+              msg[1].get('digest_rejected') is not None:
+            with self._lock:
+              self._digest_rejected += 1
+            log.error(
+                'param lane: subscriber refused params v%s — content '
+                'digest mismatch (corrupt publish); it keeps its '
+                'prior snapshot and refetches on backoff',
+                msg[1]['digest_rejected'])
           with self._lock:
             self._blobs_served += 1
-          self._queue_segments(sub, self._blob_fn())
+          segments, trailer = self._blob_fn()
+          self._queue_segments(
+              sub, tuple(segments) + ((trailer,) if sub.crc else ()))
         elif kind == 'ping':
           # The v6 keepalive: an idle subscriber pings inside the
           # reaping window; the pong keeps the conversation protocol-
@@ -1060,11 +1300,11 @@ class _ParamLane:
         if self._closed:
           return
         adopts, self._pending_adopts = self._pending_adopts, []
-      for sock in adopts:
+      for sock, crc in adopts:
         sock.setblocking(False)
         try:
           self._selector.register(sock, selectors.EVENT_READ,
-                                  self._Sub(sock))
+                                  self._Sub(sock, crc=crc))
         except (KeyError, ValueError, OSError):
           sock.close()
       # Idle/half-open subscriber reaping (round 11): a silent sub
@@ -1130,6 +1370,8 @@ class _ParamLane:
     if graceful:
       bye = pickle.dumps(('bye',), protocol=pickle.HIGHEST_PROTOCOL)
       frame = (_LEN.pack(len(bye) + 1) + bytes((_FRAME_PLAIN,)) + bye)
+      frame_crc = frame + _CRC.pack(
+          integrity.crc_bytes(frame[_LEN.size:]))
       for key in list(self._selector.get_map().values()):
         # Only subscribers with NO partially-sent reply: appending the
         # bye where a client expects the rest of a chunked params
@@ -1138,7 +1380,8 @@ class _ParamLane:
         # its half-fetched state already is).
         if key.data is not None and not key.data.out:
           try:
-            key.fileobj.send(frame)  # non-blocking best effort
+            # v7 subs expect a trailer on every frame, the bye too.
+            key.fileobj.send(frame_crc if key.data.crc else frame)
           except OSError:
             pass
     for key in list(self._selector.get_map().values()):
@@ -1203,6 +1446,13 @@ class TrajectoryIngestServer:
       recv stall and send no-progress deadline on every blocking
       socket path. 0 disables reaping AND deadlines (pre-round-11
       behavior: a half-open peer pins its reader forever).
+    wire_crc: v7 payload integrity (round 12; config.wire_crc): offer
+      per-frame CRC32C trailers to v7 clients at hello. A mismatched
+      unroll is refused with ('corrupt', crc) BEFORE the buffer put —
+      counted in stats()['wire_crc_rejected'] — and the connection is
+      kept (the client re-sends once, then quarantines itself). False
+      negotiates every connection down to the v6 wire (the bench's
+      CRC-off row, and the escape hatch for CPU-bound ingest hosts).
   """
 
   def __init__(self, buffer, params, host: str = '127.0.0.1',
@@ -1211,10 +1461,12 @@ class TrajectoryIngestServer:
                ingest_workers: int = 0,
                max_unroll_staleness: int = 0,
                heartbeat_secs: float = 0.0,
-               idle_timeout_secs: float = 0.0):
+               idle_timeout_secs: float = 0.0,
+               wire_crc: bool = True):
     if wire_dtype not in (None, '', 'bfloat16'):
       raise ValueError(f'unsupported wire_dtype {wire_dtype!r}')
     self._wire_bf16 = wire_dtype == 'bfloat16'
+    self._wire_crc = bool(wire_crc)
     self._buffer = buffer
     self._contract = contract
     self._max_staleness = int(max_unroll_staleness)
@@ -1261,6 +1513,15 @@ class TrajectoryIngestServer:
     self._rejected = 0
     self._stale_rejected = 0  # staleness-window admission rejections
     self._quarantined = 0  # connections dropped for unparseable frames
+    # Integrity ledger (round 12): unrolls refused because their v7
+    # CRC trailer mismatched (verified before the put — the buffer
+    # never saw them), and the discard accounting of thrown-away
+    # partial/unparseable frames (the round-12 fix: the quarantine
+    # path used to count the CONN but drop how much data died with
+    # it).
+    self._wire_crc_rejected = 0
+    self._discarded_frames = 0
+    self._discarded_bytes = 0
     self._connections = 0
     self._param_subscribers = 0  # cumulative hello_params adoptions
     # Liveness/restart counters (round 11).
@@ -1314,10 +1575,12 @@ class TrajectoryIngestServer:
           target=self._reap_loop, name='ingest-reaper', daemon=True)
       self._reaper_thread.start()
 
-  def _make_blob(self, version, params) -> List[bytes]:
-    """One published version as its COMPLETE wire frame, in segments
-    ready for sendall: [head (length prefix + OOB tag + skeleton +
-    buffer table), raw buffer, raw buffer, ...].
+  def _make_blob(self, version, params) -> Tuple[List[bytes], bytes]:
+    """One published version as (wire frame segments, CRC trailer):
+    [head (length prefix + OOB tag + skeleton + buffer table), raw
+    buffer, raw buffer, ...] plus the 4 trailer bytes v7 subscribers
+    get appended (cached WITH the blob — one CRC per publish, not per
+    fetch).
 
     Out-of-band framing in the params direction too (round 6 — the
     same lesson the r4 unroll framing measured at +90%): the frame IS
@@ -1325,28 +1588,50 @@ class TrajectoryIngestServer:
     fetch) copies them through the pickler — the client's
     `_recv_msg` reconstructs zero-copy views, which matters doubly on
     the param lane where 8 polling fetchers' unpickles used to share
-    the core with the unroll pump's acks."""
+    the core with the unroll pump's acks.
+
+    Integrity (round 12): the info dict carries 'params_digest' — a
+    content CRC of the WIRE-form tree (post-bf16-cast, pre-upcast:
+    the client verifies the exact bytes it received) computed HERE,
+    at publish time, before serialization. The 'publish_corrupt'
+    fault site fires between the digest and the pickle: the shipped
+    frame is then self-consistent (its CRC trailer matches its bytes)
+    and only the client's digest check can catch the damage — the
+    host-memory-rot shape."""
     with self._params_lock:
       self._serializations += 1  # test hook: must be once per version
-    # v6: server info rides every params reply as a 4th element (old
-    # clients index [0..2] and never see it). The hello reply IS a
-    # params reply, so this is also how a client learns the session
-    # epoch and the negotiated heartbeat cadence — no extra frame, no
-    # extra version field on the wire.
-    info = {'protocol': PROTOCOL_VERSION,
-            'session_epoch': self.session_epoch,
-            'heartbeat_secs': self._heartbeat_secs,
-            'idle_timeout_secs': self._idle_timeout}
     if self._wire_bf16:
       import jax
       import ml_dtypes
       params = jax.tree_util.tree_map(
           lambda x: x.astype(ml_dtypes.bfloat16)
           if getattr(x, 'dtype', None) == np.float32 else x, params)
-      obj = ('params_bf16', version, params, info)
-    else:
-      obj = ('params', version, params, info)
-    return _oob_frame_segments(obj)
+    digest = integrity.tree_digest(params)
+    plan = faults_lib.active()
+    fault = faults_lib.fire('publish_corrupt')
+    if fault is not None:
+      params = faults_lib.corrupt_params_tree(
+          fault, params, seed=plan.seed if plan else 0)
+    # v6: server info rides every params reply as a 4th element (old
+    # clients index [0..2] and never see it). The hello reply IS a
+    # params reply, so this is also how a client learns the session
+    # epoch and the negotiated heartbeat cadence — no extra frame, no
+    # extra version field on the wire.
+    # 'wire_crc'/'crc_algo' are SERVER-WIDE facts (the blob is cached
+    # per version, not per connection): each side derives the same
+    # per-conn negotiation from (peer protocol >= 7) AND (server
+    # wire_crc) AND (client offered crc) AND (algorithms match), so
+    # no per-connection state needs to ride the cached frame.
+    info = {'protocol': PROTOCOL_VERSION,
+            'session_epoch': self.session_epoch,
+            'heartbeat_secs': self._heartbeat_secs,
+            'idle_timeout_secs': self._idle_timeout,
+            'wire_crc': self._wire_crc,
+            'crc_algo': integrity.CRC_ALGO,
+            'params_digest': integrity.digest_record(digest)}
+    kind = 'params_bf16' if self._wire_bf16 else 'params'
+    segments = _oob_frame_segments((kind, version, params, info))
+    return segments, _CRC.pack(_segments_crc(segments))
 
   def publish_params(self, params) -> int:
     """Swap in a new host param snapshot; returns the new version.
@@ -1400,6 +1685,15 @@ class TrajectoryIngestServer:
               # corrupting peer loses its connection, the server and
               # every other connection keep going.
               'quarantined': self._quarantined,
+              # v7 payload integrity (round 12): unrolls refused for a
+              # mismatched CRC trailer (verified before the put — the
+              # buffer provably never saw them), the param-lane ledger
+              # of digest-refused publishes, and the discard
+              # accounting of thrown-away partial/unparseable frames.
+              'wire_crc_rejected': self._wire_crc_rejected,
+              'publish_digest_rejected': lane['digest_rejected'],
+              'discarded_frames': self._discarded_frames,
+              'discarded_bytes': self._discarded_bytes,
               'connections': self._connections,  # cumulative
               'live': live,
               # Per-lane transport counters (round 6): the driver
@@ -1525,8 +1819,25 @@ class TrajectoryIngestServer:
         continue
       if job is None:
         return
-      conn, unroll, t_recv, client_version, client_epoch = job
+      conn, unroll, t_recv, client_version, client_epoch, crc_pair = job
       try:
+        if crc_pair is not None and crc_pair[0] != crc_pair[1]:
+          # v7 payload integrity: the frame's bytes are not the bytes
+          # the client sent — refuse BEFORE the staleness/epoch/
+          # validation checks (every field parsed from a corrupt
+          # frame is untrustworthy) and before the buffer put. The
+          # benign ('corrupt', computed) reply keeps the connection:
+          # the client re-sends once, then quarantines itself.
+          computed, wire = crc_pair
+          with self._stats_lock:
+            self._wire_crc_rejected += 1
+          conn.crc_rejected += 1
+          log.warning(
+              'unroll from %s failed its CRC trailer (computed '
+              '%08x, wire %08x) — refused before the buffer put',
+              conn.addr, computed, wire)
+          conn.send(('corrupt', computed))
+          continue
         if (client_epoch is not None
             and client_epoch != self.session_epoch):
           # A dead incarnation's unroll (v6 epoch stamp): refuse it
@@ -1628,14 +1939,16 @@ class TrajectoryIngestServer:
         self._connections += 1
       t.start()
 
-  def _snapshot_frame(self) -> List[bytes]:
+  def _snapshot_frame(self) -> Tuple[List[bytes], bytes]:
+    """(cached frame segments, cached CRC trailer) of the current
+    published version — the trailer ships only to v7 CRC peers."""
     with self._params_lock:
       return self._params_frame
 
   def snapshot_nbytes(self) -> int:
     """Wire size of the current cached snapshot frame (bench +
-    egress-arithmetic hook)."""
-    return sum(len(s) for s in self._snapshot_frame())
+    egress-arithmetic hook; the 4 trailer bytes are noise)."""
+    return sum(len(s) for s in self._snapshot_frame()[0])
 
   def _serve(self, conn: _Conn, addr):
     log.info('remote actor connected from %s', addr)
@@ -1646,17 +1959,21 @@ class TrajectoryIngestServer:
     handshaken = self._contract is None
     adopted = False
     leave_to_close = False  # close() owns the socket/list teardown
-    liveness = None
     thread_name = f'ingest-reader-{addr}'
-    if self._liveness_on:
-      liveness = _ConnLiveness(conn, self._closed, self._stall_secs,
-                               watchdog=self._watchdog,
-                               name=thread_name,
-                               heartbeat_secs=self._heartbeat_secs)
-      liveness.beat()
+    # The liveness ledger exists on EVERY connection now (round 12):
+    # besides the round-11 stall/keepalive machinery (armed only in
+    # liveness mode — on a blocking legacy socket its timeout paths
+    # simply never fire), it carries the per-frame byte count the
+    # discard accounting reports when a frame is thrown away.
+    liveness = _ConnLiveness(
+        conn, self._closed, self._stall_secs,
+        watchdog=self._watchdog if self._liveness_on else None,
+        name=thread_name, heartbeat_secs=self._heartbeat_secs)
+    liveness.beat()
+    crc_ctx = None  # armed once the hello negotiates v7 CRC
     try:
       while not self._closed.is_set():
-        msg = _recv_msg(conn.sock, liveness)
+        msg = _recv_msg(conn.sock, liveness, crc_ctx)
         if msg is None:
           return  # client went away
         kind = msg[0]
@@ -1680,6 +1997,18 @@ class TrajectoryIngestServer:
           conn.heartbeat = (conn.protocol >= 6
                             and self._heartbeat_secs > 0)
           client_info = msg[2] if len(msg) > 2 else None
+          # v7 CRC negotiation: peer protocol, server knob, client
+          # offer, and algorithm must ALL agree (a zlib-fallback host
+          # paired with a crc32c host negotiates OFF — phantom
+          # corruption would be worse than no check). Takes effect
+          # AFTER the hello reply below: the reply ships per the
+          # conn's PRIOR crc state, because the client cannot know
+          # the outcome until it has parsed this very frame.
+          crc_next = (conn.protocol >= 7 and self._wire_crc
+                      and isinstance(client_info, dict)
+                      and bool(client_info.get('crc'))
+                      and client_info.get('crc_algo') ==
+                      integrity.CRC_ALGO)
           prior_epoch = (client_info or {}).get('epoch') \
               if isinstance(client_info, dict) else None
           try:
@@ -1700,7 +2029,11 @@ class TrajectoryIngestServer:
                     self.session_epoch, self._reattach_latency)
               else:
                 self._reconnected += 1
-          conn.send_segments(self._snapshot_frame())
+          segments, trailer = self._snapshot_frame()
+          conn.send_segments(segments,
+                             trailer if conn.crc else None)
+          conn.crc = crc_next
+          crc_ctx = _CrcContext() if conn.crc else None
         elif kind == 'ping':
           # Application-level heartbeat (v6): refreshes last_recv by
           # arriving; the pong carries the current params version so
@@ -1718,12 +2051,22 @@ class TrajectoryIngestServer:
           with self._stats_lock:
             self._connections -= 1
             self._param_subscribers += 1
-          adopted = self._param_lane.adopt(conn.sock)
+          # v7: the hello_params MAY carry the client-info dict — the
+          # lane then appends the cached trailer to its replies and
+          # verifies trailers on requests from this subscriber.
+          sub_info = msg[1] if len(msg) > 1 else None
+          sub_crc = (self._wire_crc and isinstance(sub_info, dict)
+                     and bool(sub_info.get('crc'))
+                     and sub_info.get('crc_algo') ==
+                     integrity.CRC_ALGO)
+          adopted = self._param_lane.adopt(conn.sock, crc=sub_crc)
           return
         elif kind == 'get_params':
           # Legacy/in-band path (pre-v5 peers, protocol tests): served,
           # but production clients fetch over the param lane.
-          conn.send_segments(self._snapshot_frame())
+          segments, trailer = self._snapshot_frame()
+          conn.send_segments(segments,
+                             trailer if conn.crc else None)
         elif kind == 'unroll':
           if not handshaken:
             # 'error', not 'reject': legacy (protocol-1) clients only
@@ -1743,11 +2086,16 @@ class TrajectoryIngestServer:
           # under — the stale-incarnation guard.
           # Mark the unroll in flight BEFORE the enqueue: from here
           # until the worker's reply, this conn's silence is lockstep
-          # protocol (reaper-exempt), not a liveness signal.
+          # protocol (reaper-exempt), not a liveness signal. On a v7
+          # CRC conn the (computed, wire) pair rides the job: the
+          # WORKER compares just before the put, so a corrupt frame
+          # earns its benign reply without ever touching the buffer.
           conn.job_started()
           self._ingest_q.put((conn, msg[1], time.monotonic(),
                               msg[2] if len(msg) > 2 else None,
-                              msg[3] if len(msg) > 3 else None))
+                              msg[3] if len(msg) > 3 else None,
+                              (crc_ctx.computed, crc_ctx.wire)
+                              if crc_ctx is not None else None))
         else:
           conn.send(('error', f'unknown message kind {kind!r}'))
       # Loop-condition exit on a closing server: same contract as
@@ -1769,8 +2117,11 @@ class TrajectoryIngestServer:
       conn.reaped = True
       with self._stats_lock:
         self._conns_reaped += 1
+        self._discarded_frames += 1
+        self._discarded_bytes += liveness.frame_bytes
       log.warning('reaping half-open connection %s: %s (partial '
-                  'frame discarded)', addr, e)
+                  'frame discarded: %d byte(s))', addr, e,
+                  liveness.frame_bytes)
     except (ValueError, struct.error, pickle.UnpicklingError,
             EOFError) as e:
       # Unparseable frame — a version-skewed peer (a pre-v4 client's
@@ -1778,13 +2129,20 @@ class TrajectoryIngestServer:
       # garbage on the wire. Must not kill the handler thread
       # silently: log the likely cause and QUARANTINE just this
       # connection (counted — chaos.py's SLO asserts corrupt peers
-      # get dropped while the learner keeps training).
+      # get dropped while the learner keeps training). The discarded
+      # frame's size rides the ledger too (round-12 fix: the conn was
+      # counted but the thrown-away data never was — an operator
+      # could not tell a dropped 40-byte hello from a dropped 2 MB
+      # unroll burst).
       with self._stats_lock:
         self._quarantined += 1
+        self._discarded_frames += 1
+        self._discarded_bytes += liveness.frame_bytes
       log.warning(
           'protocol/frame error from %s — connection quarantined '
-          '(version-skewed peer? this learner speaks v%d): %s', addr,
-          PROTOCOL_VERSION, e)
+          '(version-skewed peer? this learner speaks v%d; %d byte(s) '
+          'discarded): %s', addr, PROTOCOL_VERSION,
+          liveness.frame_bytes, e)
     except (ConnectionError, OSError) as e:
       if conn.reaped:
         log.info('remote actor %s reader unwound after reap', addr)
@@ -1920,7 +2278,7 @@ class RemoteActorClient:
   """
 
   def __init__(self, address: str, connect_timeout_secs: float = 60.0,
-               io_timeout_secs: float = 0.0):
+               io_timeout_secs: float = 0.0, wire_crc: bool = True):
     host, port = address.rsplit(':', 1)
     self._addr = (host, int(port))
     self._io_timeout = (float(io_timeout_secs)
@@ -1936,6 +2294,18 @@ class RemoteActorClient:
     self.server_info: Dict = {}
     self.session_epoch: Optional[int] = None
     self.busy_frames = 0
+    # v7 payload integrity: offer CRC at hello (`wire_crc`); `_crc`
+    # flips on when the handshake reply's server-info confirms the
+    # negotiation — from then on every frame both ways carries the
+    # trailer. `crc_rejected` counts ('corrupt', crc) refusals of OUR
+    # unrolls (a climbing count implicates THIS host's NIC/RAM);
+    # `digest_rejected` counts param snapshots refused before install.
+    self._wire_crc = bool(wire_crc)
+    self._crc = False
+    self._param_sock_crc = False  # the cached sub's pinned CRC state
+    self.crc_rejected = 0
+    self.digest_rejected = 0
+    self._digest_nack: Optional[int] = None  # rides the retry fetch
     deadline = time.monotonic() + connect_timeout_secs
     last_err = None
     # Capped exponential backoff + full jitter: after a learner
@@ -1987,12 +2357,13 @@ class RemoteActorClient:
       faults_lib.apply_transport_fault(
           fault, self._sock, seed=plan.seed if plan else 0)
     if oob:
-      _send_oob(self._sock, msg)
+      _send_oob(self._sock, msg, crc=self._crc)
     else:
-      _send_msg(self._sock, msg)
+      _send_msg(self._sock, msg, crc=self._crc)
+    crc_ctx = _CrcContext() if self._crc else None
     while True:
       try:
-        reply = _recv_msg(self._sock)
+        reply = _recv_msg(self._sock, crc_ctx=crc_ctx)
       except socket.timeout as e:
         raise ConnectionError(
             f'learner silent past the {self._io_timeout}s I/O '
@@ -2006,6 +2377,15 @@ class RemoteActorClient:
             f'v{PROTOCOL_VERSION}); upgrade both roles together') from e
       if reply is None:
         raise ConnectionError('learner closed the connection')
+      if crc_ctx is not None and not crc_ctx.ok:
+        # A reply failing ITS trailer means the learner→actor
+        # direction corrupts: nothing parsed from it can be trusted.
+        # ConnectionError on purpose — a fresh connection (and a
+        # re-handshake) is the recovery; persistent failures land in
+        # the reconnect window where the operator can see them.
+        raise ConnectionError(
+            f'learner reply failed its CRC trailer (computed '
+            f'{crc_ctx.computed:08x}, wire {crc_ctx.wire:08x})')
       if reply[0] == 'busy':
         # Backpressure keepalive (v6): the ack is held back by a full
         # learner buffer, not a dead learner — keep waiting (each
@@ -2017,6 +2397,15 @@ class RemoteActorClient:
       raise LearnerShutdown('learner finished training')
     if reply[0] == 'reject':
       raise ContractMismatch(reply[1])
+    if reply[0] == 'corrupt':
+      # v7: the learner's CRC check refused our unroll — the frame
+      # was damaged AFTER we computed its trailer (wire, NIC, or this
+      # host's own memory). The connection itself is fine.
+      self.crc_rejected += 1
+      raise UnrollCorrupt(
+          f'learner refused the unroll: payload CRC mismatch (its '
+          f'computed crc {reply[1]:08x}) — re-send once, then treat '
+          'this host as suspect', crc=reply[1])
     if reply[0] == 'stale_epoch':
       raise SessionEpochMismatch(
           f'learner refused this client\'s session epoch '
@@ -2026,19 +2415,55 @@ class RemoteActorClient:
       raise RuntimeError(f'learner rejected request: {reply[1]}')
     return reply
 
-  def _decode_params(self, reply) -> Tuple[int, object]:
+  def _decode_params(self, reply, negotiate: bool = False
+                     ) -> Tuple[int, object]:
     """(version, tree) from a params reply; 'params_bf16' blobs
     (learner running remote_params_dtype=bfloat16) upcast back to
     float32 here — the actor's agent/contract only ever sees f32.
     v6 replies carry a 4th element, the server-info dict (protocol,
     session epoch, heartbeat cadence) — recorded here; absent from v5
-    servers, in which case the liveness state stays empty."""
+    servers, in which case the liveness state stays empty.
+
+    v7: the server-info's 'params_digest' is verified against the
+    WIRE-form tree (before the upcast — the exact bytes received)
+    BEFORE this snapshot can reach update_params. A mismatch raises
+    ParamsCorrupt: the caller must NOT install, keeps its prior
+    params, and refetches on backoff — a corrupt publish is rejected
+    fleet-wide without a version bump. The v7 CRC negotiation resolves
+    here ONLY for handshake replies (`negotiate=True`): the server
+    pins its side at the hello, so flipping on a mid-stream params
+    reply (a lane fetch without a handshake) would desynchronize the
+    framing."""
     version, tree = reply[1], reply[2]
     if len(reply) > 3 and isinstance(reply[3], dict):
       self.server_info = reply[3]
       epoch = reply[3].get('session_epoch')
       if epoch is not None:
         self.session_epoch = epoch
+      if negotiate:
+        self._crc = (self._wire_crc
+                     and int(self.server_info.get('protocol') or 0)
+                     >= 7
+                     and bool(self.server_info.get('wire_crc'))
+                     and self.server_info.get('crc_algo') ==
+                     integrity.CRC_ALGO)
+      record = self.server_info.get('params_digest')
+      if record is not None:
+        verdict = integrity.verify_record(
+            record, integrity.tree_digest(tree))
+        if verdict is False:
+          self.digest_rejected += 1
+          self._digest_nack = int(version)
+          raise ParamsCorrupt(
+              f'params v{version} failed its content digest '
+              f'(recorded {record}) — snapshot NOT installed; keep '
+              'the prior params and refetch on backoff',
+              version=int(version))
+        if verdict is None:
+          log.warning(
+              'params digest not comparable (recorded %r, local algo '
+              '%s) — content verification skipped', record,
+              integrity.CRC_ALGO)
     if reply[0] == 'params_bf16':
       import jax
       import ml_dtypes
@@ -2059,10 +2484,30 @@ class RemoteActorClient:
     `prior_epoch` (v6): the session epoch of the learner this host was
     attached to before the drop, if any — a RESTARTED learner sees a
     foreign epoch and counts/times the fleet re-attach; old servers
-    ignore the extra hello element."""
-    msg = (('hello', contract) if prior_epoch is None
-           else ('hello', contract, {'epoch': int(prior_epoch)}))
-    return self._decode_params(self._rpc(msg))
+    ignore the extra hello element. The same client-info dict carries
+    the v7 CRC offer (algorithm included — mixed-fallback pairs must
+    negotiate the check OFF, not miscompare)."""
+    # Offer CRC only when the CONTRACT itself speaks v7: tests (and
+    # mixed fleets mid-upgrade) legitimately offer an older protocol
+    # through a forged contract, and the negotiation must then land
+    # identically on both sides — the server keys on the offered
+    # protocol, so the client must too.
+    offered_protocol = (contract.get('protocol')
+                        if isinstance(contract, dict) else None)
+    # A non-dict contract reaches the server as a legacy hello (its
+    # reader keys protocol 5) — never offer CRC there.
+    offer_crc = (self._wire_crc and offered_protocol is not None
+                 and int(offered_protocol) >= 7)
+    info: Dict = {}
+    if prior_epoch is not None:
+      info['epoch'] = int(prior_epoch)
+    if offer_crc:
+      info['crc'] = True
+      info['crc_algo'] = integrity.CRC_ALGO
+    msg = ('hello', contract, info) if info else ('hello', contract)
+    if not offer_crc:
+      self._crc = False
+    return self._decode_params(self._rpc(msg), negotiate=offer_crc)
 
   def ping(self) -> int:
     """Application-level heartbeat on the trajectory lane (v6): keeps
@@ -2103,11 +2548,44 @@ class RemoteActorClient:
             f'could not open the param lane to {self._addr}')
       sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
       sock.settimeout(self._io_timeout)
-      _send_msg(sock, ('hello_params',))
+      # The hello_params itself is pre-negotiation (no trailer); with
+      # CRC already negotiated on the trajectory lane (handshake),
+      # the info dict turns the same machinery on for this subscriber
+      # — every subsequent frame on the lane carries trailers both
+      # ways. The lane's state is PINNED at open: a later handshake
+      # flipping self._crc must not desynchronize a cached sub.
+      if self._crc:
+        _send_msg(sock, ('hello_params',
+                         {'protocol': PROTOCOL_VERSION, 'crc': True,
+                          'crc_algo': integrity.CRC_ALGO}))
+      else:
+        _send_msg(sock, ('hello_params',))
       self._param_sock = sock
+      self._param_sock_crc = self._crc
+    lane_crc = self._param_sock_crc
     try:
-      _send_msg(self._param_sock, ('get_params',))
-      reply = _recv_msg(self._param_sock)
+      # A digest-rejected notice from a prior corrupt fetch rides the
+      # retry, so the learner's publish_digest_rejected ledger sees
+      # the fleet-side refusal without a dedicated side channel.
+      # Independent of lane CRC: digests ship (and verify) whenever
+      # the server is v7 — which is the only way _digest_nack gets
+      # set — and the lane's parser reads the notice regardless of
+      # its own trailer negotiation (a wire_crc=False server must not
+      # be blind to fleet-side refusals).
+      if self._digest_nack is not None:
+        req = ('get_params', {'digest_rejected': self._digest_nack})
+      else:
+        req = ('get_params',)
+      self._digest_nack = None
+      _send_msg(self._param_sock, req, crc=lane_crc)
+      crc_ctx = _CrcContext() if lane_crc else None
+      reply = _recv_msg(self._param_sock, crc_ctx=crc_ctx)
+      if reply is not None and crc_ctx is not None and not crc_ctx.ok:
+        self._close_param_sock()
+        raise ConnectionError(
+            f'param blob failed its CRC trailer (computed '
+            f'{crc_ctx.computed:08x}, wire {crc_ctx.wire:08x}) — '
+            'wire corruption; refetching on a fresh subscriber')
     except socket.timeout as e:
       self._close_param_sock()
       raise ConnectionError(
@@ -2229,11 +2707,14 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
     reconnect_secs = getattr(config, 'actor_reconnect_secs', 0.0)
   for warning in config_lib.validate_transport(config):
     log.warning('%s', warning)
+  for warning in config_lib.validate_integrity(config):
+    log.warning('%s', warning)
   # Client-side I/O deadline: the idle window doubles as "how long do
   # I wait on a silent learner" — symmetric with the server's reaping
   # of silent clients. Busy keepalives keep a backpressured-but-alive
   # learner inside it.
   io_timeout = getattr(config, 'remote_conn_idle_timeout_secs', 0.0)
+  wire_crc = bool(getattr(config, 'wire_crc', True))
   levels = factory.level_names(config)
   spec0 = factory.make_env_spec(config, levels[0], seed=1)
   agent = driver_lib.build_agent(config, spec0.num_actions,
@@ -2242,16 +2723,40 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
   contract = trajectory_contract(config, agent, spec0.num_actions)
   client = RemoteActorClient(learner_address,
                              connect_timeout_secs=connect_timeout_secs,
-                             io_timeout_secs=io_timeout)
+                             io_timeout_secs=io_timeout,
+                             wire_crc=wire_crc)
   unrolls_sent = 0
+  # Integrity ledger across reconnects (client objects are replaced):
+  # CRC refusals of our unrolls, digest-refused publishes, and
+  # whether this host took itself out of the fleet.
+  crc_resends = 0
+  digest_rejections = 0
+  self_quarantined = False
   try:
-    try:
-      version, params = client.handshake(contract)
-    except LearnerShutdown:
-      # Connected just as training ended: a clean no-op, not a crash.
-      log.info('learner already finished training; remote actor '
-               'exiting')
-      return 0
+    # The hello reply IS a cached params frame, so the STARTUP
+    # handshake can meet a corrupt publish exactly like a mid-run
+    # refetch — and must get the same bounded-backoff retries (the
+    # corrupt blob is superseded at the next publish cadence), not a
+    # fleet-shrinking crash.
+    backoff = Backoff(base=0.3, cap=3.0)
+    for attempt in range(5):
+      try:
+        version, params = client.handshake(contract)
+        break
+      except LearnerShutdown:
+        # Connected just as training ended: a clean no-op, not a
+        # crash.
+        log.info('learner already finished training; remote actor '
+                 'exiting')
+        return 0
+      except ParamsCorrupt as e:
+        digest_rejections += 1
+        log.error('remote actor task=%d: handshake params failed '
+                  'their digest (%s) — attempt %d/5', task, e,
+                  attempt + 1)
+        if attempt == 4:
+          raise
+        backoff.sleep()
     known_epoch = client.session_epoch  # None against a v5 learner
     # Heartbeat cadence is the SERVER's call (negotiated via its
     # hello-reply info dict): 0 / absent (v5 learner) = no pings.
@@ -2301,7 +2806,8 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
         try:
           new_client = RemoteActorClient(learner_address,
                                          connect_timeout_secs=remaining,
-                                         io_timeout_secs=io_timeout)
+                                         io_timeout_secs=io_timeout,
+                                         wire_crc=wire_crc)
         except ConnectionError:
           continue  # connect window exhausted → loop exits above
         try:
@@ -2346,19 +2852,47 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
 
     def refresh_params():
       """Fetch + install the current snapshot (version-gated on the
-      server side against redundant copies)."""
-      nonlocal version, params
-      version, params = client.fetch_params()
-      server.update_params(params, version=version)
-      log.info('remote actor task=%d refreshed params to v%d',
-               task, version)
+      server side against redundant copies).
+
+      v7 integrity: a snapshot failing its content digest is NOT
+      installed — the inference arena keeps the prior params. Retried
+      on backoff a bounded number of times (the corrupt blob is
+      CACHED learner-side, so it stays corrupt until the next
+      publish); giving up keeps training on the old snapshot and the
+      next ack's newer version triggers the refetch of a clean one.
+      The rejection itself is reported to the learner on the retry's
+      get_params (publish_digest_rejected)."""
+      nonlocal version, params, digest_rejections
+      backoff = Backoff(base=0.2, cap=2.0)
+      for attempt in range(3):
+        try:
+          v, p = client.fetch_params()
+        except ParamsCorrupt as e:
+          digest_rejections += 1
+          log.error('remote actor task=%d: %s (attempt %d/3)', task,
+                    e, attempt + 1)
+          if attempt == 2:
+            log.error(
+                'remote actor task=%d: giving up on params v%s — '
+                'keeping v%d; the next publish will be refetched',
+                task, e.version, version)
+            return
+          backoff.sleep()
+          continue
+        version, params = v, p
+        server.update_params(params, version=version)
+        log.info('remote actor task=%d refreshed params to v%d',
+                 task, version)
+        return
 
     try:
       unroll = None  # a drop mid-send must not lose the unroll
+      corrupt_resent = False  # current unroll already re-sent once?
       last_io = time.monotonic()
       while (stop_after_unrolls is None or
              unrolls_sent < stop_after_unrolls):
         if unroll is None:
+          corrupt_resent = False
           try:
             # With heartbeats negotiated, wake often enough to ping an
             # idle trajectory lane inside the learner's reaping window.
@@ -2392,6 +2926,26 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
           # fires and the NEXT unroll ships fresh.
           ack_version = client.send_unroll(unroll,
                                            params_version=version)
+        except UnrollCorrupt as e:
+          # The learner's CRC refused our frame. Once is wire noise:
+          # re-send the SAME unroll (at-least-once, like any lost
+          # ack). Twice for the same unroll means the corruption is
+          # on THIS host's path (NIC/RAM — the learner verified
+          # against the trailer WE computed): stop feeding garbage
+          # and take the host out of the fleet (docs/RUNBOOK.md §9).
+          last_io = time.monotonic()
+          if corrupt_resent:
+            self_quarantined = True
+            log.error(
+                'remote actor task=%d SELF-QUARANTINED: the same '
+                'unroll failed the learner CRC twice (%s) — suspect '
+                'NIC/memory on this host; exiting the fleet', task, e)
+            break
+          corrupt_resent = True
+          crc_resends += 1
+          log.warning('remote actor task=%d: unroll failed the '
+                      'learner CRC (%s); re-sending once', task, e)
+          continue
         except OSError:
           # OSError, not just ConnectionError: a blackholed learner
           # host surfaces as ETIMEDOUT — or the round-11 client-side
@@ -2431,4 +2985,12 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
     client.close()
   log.info('remote actor task=%d shipped %d unrolls', task,
            unrolls_sent)
+  if crc_resends or digest_rejections or self_quarantined:
+    # Greppable one-liner for harnesses (chaos.py) and operators: the
+    # client-side half of the integrity ledger (the learner's stats
+    # carry the server-side half).
+    log.warning(
+        'INTEGRITY_REPORT task=%d crc_resends=%d digest_rejections=%d '
+        'self_quarantined=%s', task, crc_resends, digest_rejections,
+        self_quarantined)
   return unrolls_sent

@@ -48,6 +48,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
+from scalable_agent_tpu import integrity
 from scalable_agent_tpu.runtime.actor import batch_unrolls
 from scalable_agent_tpu.structs import ActorOutput
 
@@ -75,22 +76,32 @@ class ReplayTier:
     one (`evictions_version`). The unit is the same param-version
     delta `--max_unroll_staleness` uses for ingest admission (the
     round-10 unification); 0 = no version bound.
+  - by CONTENT (round 12, `verify_crc`): each entry keeps the CRC of
+    its bytes at INSERT time and is re-verified at every serve — a
+    retained unroll sitting in host memory for thousands of serves is
+    exactly where silent RAM rot would otherwise be multiplied into
+    the batch mix K times over. A mismatch evicts instead of serving
+    (`evictions_crc`), the host-tier sibling of the wire CRC and the
+    checkpoint digest ladder.
 
   Thread-safe (own lock; never calls back into the buffer).
   """
 
-  def __init__(self, capacity_unrolls: int, max_staleness: int = 0):
+  def __init__(self, capacity_unrolls: int, max_staleness: int = 0,
+               verify_crc: bool = True):
     if capacity_unrolls < 1:
       raise ValueError('replay capacity must be >= 1')
     self._capacity = capacity_unrolls
     self._max_staleness = max_staleness
-    self._entries = collections.deque()  # (unroll, insert_version)
+    self._verify_crc = bool(verify_crc)
+    self._entries = collections.deque()  # (unroll, version, crc|None)
     self._cursor = 0
     self._lock = threading.Lock()
     self._version = 0
     # Telemetry (summary surface via TrajectoryBuffer.stats()).
     self.evictions_age = 0
     self.evictions_version = 0
+    self.evictions_crc = 0
     self.reused_unrolls = 0
     self._staleness_sum = 0
     self._staleness_samples = 0
@@ -104,40 +115,74 @@ class ReplayTier:
       self._version = max(self._version, int(version))
 
   def add(self, unroll: ActorOutput):
+    # Insert-time content CRC, computed OUTSIDE the lock (one pass
+    # over the unroll's bytes — ~0.1 ms/MB; the serve-side verify is
+    # what catches rot accumulated while retained).
+    crc = integrity.tree_digest(unroll) if self._verify_crc else None
     with self._lock:
       if len(self._entries) >= self._capacity:
         self._entries.popleft()
         self.evictions_age += 1
         if self._cursor > 0:
           self._cursor -= 1  # keep the cursor on the same entry
-      self._entries.append((unroll, self._version))
+      self._entries.append((unroll, self._version, crc))
 
   def sample(self, n: int) -> List[ActorOutput]:
-    """Up to `n` unrolls from the circular cursor (fewer when the tier
-    is short or version eviction thins it mid-scan). Each serve counts
-    toward `reused_unrolls` and the mean-staleness accumulator."""
-    out: List[ActorOutput] = []
+    """Up to `n` unrolls from the circular cursor (fewer when the
+    tier is short, or when version/CRC eviction thins the pick). Each
+    DELIVERED serve counts toward `reused_unrolls` and the
+    mean-staleness accumulator.
+
+    The serve-time CRC verification (a full pass over each multi-MB
+    unroll) runs OUTSIDE the lock — holding it would stall every
+    producer's `add()` behind milliseconds of hashing on the learner
+    feed path (the same reason the insert-side CRC sits outside).
+    Rotted entries found in the verify phase are evicted by IDENTITY
+    on re-acquire (never by ==: tuples of numpy arrays don't
+    compare), with the cursor adjusted; a rotted pick shrinks this
+    call's batch instead of rescanning — the next call refills."""
+    picked: List[Tuple] = []  # (entry, staleness), CRC pending
     with self._lock:
-      sample_staleness = 0
       budget = len(self._entries)  # at most one full lap per call
-      while len(out) < n and self._entries and budget > 0:
+      while len(picked) < n and self._entries and budget > 0:
         budget -= 1
         if self._cursor >= len(self._entries):
           self._cursor = 0
-        unroll, version = self._entries[self._cursor]
-        staleness = self._version - version
+        entry = self._entries[self._cursor]
+        staleness = self._version - entry[1]
         if self._max_staleness and staleness > self._max_staleness:
           del self._entries[self._cursor]
           self.evictions_version += 1
           continue
-        out.append(unroll)
+        picked.append((entry, staleness))
+        self._cursor += 1
+    verified: List[Tuple] = []
+    rotten: List[Tuple] = []
+    for entry, staleness in picked:
+      unroll, _, crc = entry
+      if crc is not None and integrity.tree_digest(unroll) != crc:
+        # Host-memory rot since insert: reuse must NEVER serve it
+        # (replay would multiply the corruption into K batches).
+        rotten.append(entry)
+      else:
+        verified.append((entry, staleness))
+    with self._lock:
+      for entry in rotten:
+        for idx, cand in enumerate(self._entries):
+          if cand is entry:
+            del self._entries[idx]
+            if idx < self._cursor:
+              self._cursor -= 1
+            self.evictions_crc += 1
+            break
+      sample_staleness = 0
+      for _, staleness in verified:
         self.reused_unrolls += 1
         self._staleness_sum += staleness
-        sample_staleness += staleness
         self._staleness_samples += 1
-        self._cursor += 1
-      self._last_sample = (len(out), sample_staleness)
-    return out
+        sample_staleness += staleness
+      self._last_sample = (len(verified), sample_staleness)
+    return [entry[0] for entry, _ in verified]
 
   def unsample_last(self):
     """Undo the ACCOUNTING of the most recent sample() — the caller
@@ -171,6 +216,7 @@ class ReplayTier:
           'replay_capacity': self._capacity,
           'replay_evictions_age': self.evictions_age,
           'replay_evictions_version': self.evictions_version,
+          'replay_evictions_crc': self.evictions_crc,
           'replay_reused_unrolls': self.reused_unrolls,
           'replay_mean_staleness': round(mean_staleness, 3),
       }

@@ -69,13 +69,44 @@ SLOs:
   * zero wedged ingest threads and zero unjoined threads at exit,
   * the liveness counters present in summaries.jsonl.
 
+Round 12 adds the CORRUPTION storm (`run_corruption_storm`): real
+training (2 virtual devices — the SDC sentinel needs data replicas to
+cross-check) on a remote-only feed, under all four silent-corruption
+fault sites:
+
+  wire_bitflip        one flipped bit in an unroll frame that still
+                      PARSES (the CRC-not-garbage shape) — the v7
+                      trailer check must refuse it before the buffer
+                      put ('corrupt' reply), the client re-sends
+  publish_corrupt     a param blob corrupted between digest and wire
+                      (frame CRC self-consistent) — the client's
+                      digest check must refuse the install, report it
+                      back, keep its prior params, and refetch clean
+  replica_divergence  one replica's fingerprint lane perturbed — the
+                      SDC sentinel must flag, escalate through the
+                      health ladder, and roll back within budget
+  ckpt_bitrot         one byte flipped in the NEWEST committed step
+                      (under LAST_GOOD) — the resuming run's ladder
+                      must refuse the step on digests and restore the
+                      prior verified one
+
+and asserts the integrity SLOs: zero corrupt unrolls committed
+(wire_crc_rejected == scheduled flips, every refused frame re-sent
+clean), zero corrupt publishes installed (client digest rejections
+reported server-side, no self-quarantine, fleet kept feeding), the
+divergent replica detected + rolled back within the TTR budget, the
+bit-rotted checkpoint skipped via digest fallback with training
+resuming from the prior verified step, and every integrity counter
+present in summaries.jsonl.
+
 Writes CHAOS_OUT (default CHAOS.json at the repo root). Invocation:
 
     python scripts/chaos.py               # all storms, ~4-6 min CPU
-    CHAOS_SMOKE=1 python scripts/chaos.py # CI smoke (all), < 180 s
+    CHAOS_SMOKE=1 python scripts/chaos.py # CI smoke (all), < 240 s
     CHAOS_STORM=fault     python scripts/chaos.py  # just the r7 storm
     CHAOS_STORM=overload  python scripts/chaos.py  # just the overload
     CHAOS_STORM=partition python scripts/chaos.py  # just the partition
+    CHAOS_STORM=corruption python scripts/chaos.py # just the integrity
     CHAOS_SEED=7 python scripts/chaos.py  # different garbage bytes
 
 The fault schedule is a pure function of the arguments (the seed only
@@ -101,6 +132,17 @@ OUT_PATH = os.environ.get('CHAOS_OUT',
                           os.path.join(REPO, 'CHAOS.json'))
 
 os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+# The corruption storm's SDC leg cross-checks param fingerprints
+# ACROSS data replicas, so its learner needs >= 2 devices — forced
+# BEFORE any jax import, and only for the dedicated invocation (the
+# other storms keep their single-device shapes; CHAOS_STORM=all runs
+# the corruption storm in a subprocess for the same reason).
+if os.environ.get('CHAOS_STORM') == 'corruption':
+  _flags = os.environ.get('XLA_FLAGS', '')
+  if 'xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=2').strip()
 
 
 def _free_port() -> int:
@@ -792,6 +834,256 @@ def run_partition_storm(logdir: str, smoke: bool = SMOKE,
   return results, errors
 
 
+def run_corruption_storm(logdir: str, smoke: bool = SMOKE,
+                         seed: int = SEED):
+  """The data-plane integrity drill (round 12); returns (results,
+  hard-assert errors). Requires >= 2 jax devices (module-top
+  XLA_FLAGS handles the dedicated invocation). Phase 1: in-process
+  learner on a 2-replica mesh, remote-only feed, under wire_bitflip +
+  publish_corrupt + replica_divergence. Phase 2: the newest committed
+  step is bit-rotted on disk; a resuming run must refuse it on
+  digests and restore the prior verified step."""
+  import jax
+
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.runtime import faults as faults_lib
+
+  errors = []
+  results = {'smoke': smoke, 'seed': seed}
+  if jax.device_count() < 2:
+    errors.append(f'corruption storm needs >= 2 devices for the SDC '
+                  f'leg, got {jax.device_count()} (XLA_FLAGS not '
+                  'applied before jax import?)')
+    return results, errors
+
+  port = _free_port()
+  phase1_steps = 14 if smoke else 30
+  resume_steps = 3
+  sdc_burst = 3                 # == health_rollback_after: one rollback
+  sdc_at = 6                    # after checkpoints exist
+  bitflips = [4, 9]             # 5th and 10th unroll sends
+  recover_slo = 60.0            # detection -> healthy, seconds
+  cfg_kwargs = dict(
+      logdir=logdir,
+      env_backend='bandit',
+      num_actors=0,             # remote-fed: the wire IS the feed
+      batch_size=2,             # one slot per data replica
+      unroll_length=5,
+      num_action_repeats=1,
+      episode_length=4,
+      height=24, width=32,
+      torso='shallow',
+      use_py_process=False,
+      use_instruction=False,
+      total_environment_frames=10 ** 9,
+      inference_timeout_ms=5,
+      checkpoint_secs=0,        # a save every window: LAST_GOOD fresh
+      summary_secs=0,
+      remote_actor_port=port,
+      remote_heartbeat_secs=0.5,
+      remote_conn_idle_timeout_secs=10.0,
+      remote_publish_secs=0.1,  # publishes flow: the corrupt-blob leg
+      actor_reconnect_secs=120.0,
+      health_rollback_after=sdc_burst,
+      health_min_window=4,
+      seed=seed)
+  cfg = Config(**cfg_kwargs)
+
+  # Learner-side plan: blobs 2..7 (index: init blob 0, then the
+  # cadence publishes) ship with a post-digest bit flip — a RUN, so
+  # the child's poll-on-ack refetch is guaranteed to meet a corrupt
+  # one before a clean publish supersedes it; the SDC probe perturbs
+  # replica fingerprints for `sdc_burst` consecutive health checks
+  # starting at step sdc_at+1.
+  learner_plan = faults_lib.FaultPlan.storm(
+      seed, publish_corrupt_at=2, publish_corrupt_len=6,
+      replica_divergence_at=sdc_at, replica_divergence_len=sdc_burst)
+  # Child-side plan: single-bit flips that still parse, AFTER the CRC
+  # trailer was computed — distinct from the r7 storm's 'garbage'
+  # (unparseable -> quarantine); these MUST take the benign
+  # ('corrupt', crc) -> re-send path instead.
+  child_plan = faults_lib.FaultPlan(
+      [faults_lib.Fault('wire_bitflip', i, 'flip') for i in bitflips],
+      seed=seed)
+
+  child_overrides = {k: v for k, v in cfg_kwargs.items()
+                     if k not in ('logdir', 'remote_actor_port')}
+  child_overrides.update(logdir=logdir + '/actor_child', num_actors=2)
+  child = _spawn_actor_child(f'127.0.0.1:{port}', child_overrides,
+                             child_plan.to_json())
+
+  faults_lib.install(learner_plan)
+  t0 = time.monotonic()
+  crash = None
+  run = None
+  try:
+    run = driver.train(cfg, max_steps=phase1_steps,
+                       stall_timeout_secs=10.0)
+  except BaseException as e:  # SLO: zero learner crashes
+    crash = f'{type(e).__name__}: {e}'
+  finally:
+    faults_lib.clear()
+  try:
+    child_out = child.communicate(timeout=60)[0]
+  except subprocess.TimeoutExpired:
+    child.kill()
+    child_out = child.communicate()[0]
+    errors.append('actor child did not exit on the final bye')
+  results.update({
+      'phase1_steps': phase1_steps,
+      'fault_plan': learner_plan.stats(),
+      'child_plan': child_plan.stats(),
+      'child_tail': (child_out or '')[-800:],
+  })
+  if crash is not None:
+    errors.append(f'learner crashed under corruption: {crash}')
+    return results, errors
+
+  import jax as _jax
+  ing = run.ingest.stats()
+  hs = run.health.stats()
+  device_steps = int(_jax.device_get(run.state.update_steps))
+  results.update({
+      'ingest': {k: ing.get(k) for k in
+                 ('unrolls', 'wire_crc_rejected',
+                  'publish_digest_rejected', 'quarantined',
+                  'discarded_frames', 'discarded_bytes')},
+      'health': hs,
+      'device_update_steps': device_steps,
+  })
+
+  # --- SLO: zero corrupt unrolls committed. Every scheduled flip was
+  # refused BEFORE the buffer put (the refusal-before-put ordering is
+  # structural; the counter proves each flip was actually caught) and
+  # every refused unroll was re-sent clean (training completed its
+  # full step budget on the remote feed).
+  if ing.get('wire_crc_rejected', 0) != len(bitflips):
+    errors.append(f"wire_crc_rejected={ing.get('wire_crc_rejected')}"
+                  f' != scheduled bit flips {len(bitflips)}')
+  if ing.get('quarantined', 0) != 0:
+    errors.append(f"quarantined={ing.get('quarantined')} != 0 — a "
+                  'parseable bit flip must take the benign corrupt '
+                  'path, not the quarantine')
+  if device_steps != phase1_steps:
+    errors.append(f'learner trained {device_steps} steps, expected '
+                  f'{phase1_steps} — the re-sent unrolls did not land')
+  if 'self-quarantin' in (child_out or '').lower():
+    errors.append('actor child self-quarantined — a re-sent unroll '
+                  'failed its CRC twice (injection leaked into the '
+                  'retry?)')
+  if 'CHILD_OK' not in (child_out or ''):
+    errors.append('actor child did not report CHILD_OK')
+
+  # --- SLO: zero corrupt publishes installed. The client refused the
+  # digest-mismatched blob (reported back on its retry fetch), kept
+  # feeding, and refetched a clean publish.
+  if ing.get('publish_digest_rejected', 0) < 1:
+    errors.append('no publish_digest_rejected recorded — the corrupt '
+                  'blob was never refused (or never fetched)')
+  if 'digest_rejections=0' in (child_out or ''):
+    errors.append('child INTEGRITY_REPORT shows zero digest '
+                  'rejections')
+
+  # --- SLO: the divergent replica was detected, escalated, and
+  # rolled back within budget.
+  if hs.get('sdc_mismatches', 0) < sdc_burst:
+    errors.append(f"sdc_mismatches={hs.get('sdc_mismatches')} < "
+                  f'burst {sdc_burst}')
+  if hs.get('rollbacks', 0) < 1:
+    errors.append('no rollback despite the SDC burst crossing K')
+  incidents = _read_jsonl(os.path.join(logdir, 'incidents.jsonl'))
+  kinds = {e['kind'] for e in incidents}
+  for kind in ('fault_replica_divergence', 'sdc_replica_mismatch',
+               'rollback'):
+    if kind not in kinds:
+      errors.append(f'incident kind {kind!r} missing')
+  ttr = None
+  t_bad = None
+  for ev in incidents:
+    if ev['kind'] == 'health_bad_burst_start' and t_bad is None:
+      t_bad = ev['wall_time']
+    if (ev['kind'] == 'health_recovered' and ttr is None
+        and t_bad is not None):
+      ttr = round(ev['wall_time'] - t_bad, 3)
+  if ttr is None:
+    errors.append('no health_recovered after the SDC burst')
+  elif ttr > recover_slo:
+    errors.append(f'SDC time-to-recover {ttr}s > SLO {recover_slo}s')
+  results['time_to_recover_secs'] = ttr
+
+  # --- SLO: integrity counters reach summaries.jsonl.
+  summaries = _read_jsonl(os.path.join(logdir, 'summaries.jsonl'))
+  tags = {e['tag'] for e in summaries if 'tag' in e}
+  for tag in ('wire_crc_rejected', 'publish_digest_rejected',
+              'sdc_replica_mismatches', 'ckpt_digest_fallbacks'):
+    if tag not in tags:
+      errors.append(f'summary tag {tag!r} missing')
+
+  # --- Phase 2: bit-rot the NEWEST committed step (it carries the
+  # LAST_GOOD marker — restore verifies structure fine, only the
+  # digest ladder can refuse it), then resume: training must come
+  # back from the PRIOR verified step, not the rot.
+  rotted_step = run.checkpointer.last_good_step()
+  if rotted_step is None:
+    errors.append('phase 1 left no LAST_GOOD step to rot')
+    return results, errors
+  faults_lib.bitrot_checkpoint_step(
+      os.path.join(logdir, 'checkpoints'), rotted_step, seed=seed)
+  resume_cfg = Config(**dict(
+      cfg_kwargs, num_actors=2, remote_actor_port=0))
+  resume_crash = None
+  run2 = None
+  try:
+    run2 = driver.train(resume_cfg, max_steps=resume_steps,
+                        stall_timeout_secs=10.0)
+  except BaseException as e:
+    resume_crash = f'{type(e).__name__}: {e}'
+  if resume_crash is not None:
+    errors.append(f'resume past the bit-rotted step crashed: '
+                  f'{resume_crash}')
+  else:
+    final_steps = int(_jax.device_get(run2.state.update_steps))
+    restored = final_steps - resume_steps
+    results.update({
+        'rotted_step': rotted_step,
+        'restored_step': restored,
+        'digest_fallbacks': run2.checkpointer.digest_fallbacks,
+    })
+    if run2.checkpointer.digest_fallbacks < 1:
+      errors.append('resume recorded no digest fallback — the '
+                    'bit-rotted step was restored as if clean')
+    if not 0 <= restored < rotted_step:
+      errors.append(f'resume restored step {restored}, expected a '
+                    f'verified step BELOW the rotted {rotted_step}')
+  results['wall_secs'] = round(time.monotonic() - t0, 2)
+  return results, errors
+
+
+def _run_corruption_subprocess():
+  """CHAOS_STORM=all path: the corruption storm needs its own process
+  (XLA device-count flags must precede the jax import, and the other
+  storms' shapes must stay single-device)."""
+  out_path = os.path.join(tempfile.mkdtemp(prefix='chaos_corr_'),
+                          'CHAOS_CORR.json')
+  env = dict(os.environ)
+  env['CHAOS_STORM'] = 'corruption'
+  env['CHAOS_OUT'] = out_path
+  proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                        cwd=REPO, env=env, stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT, text=True,
+                        timeout=900)
+  try:
+    with open(out_path) as f:
+      sub = json.load(f)
+    return (sub.get('corruption', {}),
+            [e for e in sub.get('slo_violations', [])])
+  except (OSError, ValueError):
+    return ({'tail': proc.stdout[-800:] if proc.stdout else ''},
+            [f'corruption subprocess produced no report '
+             f'(exit {proc.returncode})'])
+
+
 def main():
   which = os.environ.get('CHAOS_STORM', 'all')
   results = {}
@@ -810,6 +1102,18 @@ def main():
       results['partition'], partition_errors = \
           run_partition_storm(logdir)
     errors += [f'partition: {e}' for e in partition_errors]
+  if which == 'corruption':
+    with tempfile.TemporaryDirectory(prefix='chaos_corr_') as logdir:
+      results['corruption'], corruption_errors = \
+          run_corruption_storm(logdir)
+    errors += [f'corruption: {e}' for e in corruption_errors]
+  elif which == 'all':
+    # Own process: the SDC leg needs XLA's device-count flag set
+    # before jax imports, which this (already-imported) process and
+    # the other storms' single-device shapes cannot absorb.
+    results['corruption'], corruption_errors = \
+        _run_corruption_subprocess()
+    errors += [f'corruption: {e}' for e in corruption_errors]
   results['slo_violations'] = errors
   results['ok'] = not errors
   with open(OUT_PATH, 'w') as f:
@@ -821,6 +1125,8 @@ def main():
                         results.get('overload', {}).get('wall_secs'),
                     'partition_wall_secs':
                         results.get('partition', {}).get('wall_secs'),
+                    'corruption_wall_secs':
+                        results.get('corruption', {}).get('wall_secs'),
                     'violations': errors,
                     'out': OUT_PATH}))
   if errors:

@@ -57,6 +57,19 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_remote.py \
 'crash or unjoined or validate_transport' \
   -p no:cacheprovider
 
+echo '== corruption-chaos smoke (the integrity plane end to end: a'
+echo '   bit-flipped unroll refused before the buffer put + re-sent,'
+echo '   a corrupt publish refused before install + refetched clean,'
+echo '   an injected replica divergence detected + rolled back, and a'
+echo '   bit-rotted committed checkpoint skipped via the digest'
+echo '   ladder; plus the CRC/digest/SDC test selector — <90 s CPU) =='
+CHAOS_SMOKE=1 CHAOS_STORM=corruption python scripts/chaos.py
+JAX_PLATFORMS=cpu python -m pytest tests/test_remote.py \
+  tests/test_checkpoint.py tests/test_health.py tests/test_faults.py \
+  -q -k 'crc or digest or corrupt or bitflip or bitrot or sdc or '\
+'fingerprint or discard or integrity' \
+  -p no:cacheprovider
+
 echo '== inference-plane smoke (state-cache golden parity + slot'
 echo '   lifecycle selector, then the tiny cache×depth bench rows'
 echo '   via BENCH_ONLY=inference_plane — <60 s CPU) =='

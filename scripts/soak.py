@@ -390,6 +390,13 @@ def main():
   losses, sigmas_min, sigmas_max, returns = [], [], [], []
   remote_unrolls = []  # (wall_time, cumulative unrolls over the wire)
   remote_conns = 0
+  # Integrity counters over the soak window (round 12): final value
+  # of each — all asserted ZERO below, so long-run rot shows up as a
+  # red soak with a named counter instead of a mystery return dip.
+  integrity_final = {'wire_crc_rejected': 0,
+                     'publish_digest_rejected': 0,
+                     'ckpt_digest_fallbacks': 0,
+                     'sdc_replica_mismatches': 0}
   with open(os.path.join(logdir, 'summaries.jsonl')) as f:
     for line in f:
       e = json.loads(line)
@@ -405,11 +412,40 @@ def main():
         remote_unrolls.append((e['wall_time'], e['value']))
       elif e['tag'] == 'remote_connections':
         remote_conns = max(remote_conns, int(e['value']))
+      elif e['tag'] in integrity_final:
+        integrity_final[e['tag']] = int(e['value'])
       elif e['tag'].endswith('/episode_return'):
         returns.append(e['value'])
 
   steps = int(run.state.update_steps)
   problems = []
+  # --- Integrity SLO: ZERO violations over the soak window. Unlike
+  # the chaos storm (which INJECTS corruption and asserts detection),
+  # the soak runs clean hardware — any nonzero here is real rot on
+  # this host, and a long soak is exactly where it accumulates. The
+  # health counter covers local training too (no remote needed); the
+  # wire counters only move in churn mode (remote feed on). ---
+  if run.health is not None:
+    integrity_final['sdc_replica_mismatches'] = max(
+        integrity_final['sdc_replica_mismatches'],
+        run.health.stats().get('sdc_mismatches', 0))
+  integrity_final['ckpt_digest_fallbacks'] = max(
+      integrity_final['ckpt_digest_fallbacks'],
+      run.checkpointer.digest_fallbacks)
+  if run.ingest is not None:
+    ing = run.ingest.stats()
+    integrity_final['wire_crc_rejected'] = max(
+        integrity_final['wire_crc_rejected'],
+        ing.get('wire_crc_rejected', 0))
+    integrity_final['publish_digest_rejected'] = max(
+        integrity_final['publish_digest_rejected'],
+        ing.get('publish_digest_rejected', 0))
+  for name, value in sorted(integrity_final.items()):
+    if value:
+      problems.append(
+          f'integrity violation over the soak window: {name}={value} '
+          '(expected 0 on clean hardware — suspect this host\'s '
+          'NIC/RAM/disk; docs/RUNBOOK.md §9)')
   if steps < (20 if not smoke else 2):
     problems.append(f'only {steps} learner steps in {seconds:.0f}s')
   if not losses or not np.all(np.isfinite(losses)):
@@ -513,6 +549,7 @@ def main():
       'popart_sigma_range': ([round(float(min(sigmas_min)), 5),
                               round(float(max(sigmas_max)), 5)]
                              if sigmas_max else None),
+      'integrity': integrity_final,
       'churn': churn_artifact,
       'stack': {
           'torso': cfg.torso, 'compute_dtype': cfg.compute_dtype,

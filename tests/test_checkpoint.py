@@ -382,3 +382,108 @@ def test_sharded_state_roundtrip(setup, tmp_path):
   assert leaf.sharding.is_equivalent_to(orig.sharding, leaf.ndim)
   resumed, _ = step(restored, place(batch))
   assert int(resumed.update_steps) == 2
+
+
+# --- Round 12: content-digest ladder (bit rot) -----------------------
+
+
+def test_digest_ladder_refuses_bitrot_under_last_good(setup, tmp_path):
+  """The round-12 gap: a byte flipped in a COMMITTED step — digests
+  recorded, LAST_GOOD advanced — restores 'successfully' through
+  orbax as garbage. The ladder must refuse it on content digests
+  (counted separately as digest_fallbacks) and restore the previous
+  verified step; restore_last_good must make the same call."""
+  from scalable_agent_tpu.runtime import faults as faults_lib
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(
+      jax.tree_util.tree_map(jnp.copy, params), cfg)
+  ckpt = Checkpointer(str(tmp_path / 'rot'), save_interval_secs=0)
+  try:
+    _save_steps(ckpt, state, (1, 2))
+    assert ckpt.last_good_step() == 2
+    assert ckpt.verify_step_digests(2) is True
+    faults_lib.bitrot_checkpoint_step(str(tmp_path / 'rot'), 2, seed=3)
+    with pytest.raises(Exception, match='digest'):
+      ckpt.verify_step_digests(2)
+    restored = ckpt.restore_latest(state)
+    assert restored is not None
+    _tree_equal(restored.params, state.params)
+    assert ckpt.digest_fallbacks == 1
+    assert ckpt.restore_fallbacks >= 1
+    # restore_last_good: the marker NAMES the rotted step, but the
+    # digests in its own manifest refuse it — the ladder lands on 1.
+    rolled = ckpt.restore_last_good(state)
+    assert rolled is not None
+    assert ckpt.digest_fallbacks >= 2
+  finally:
+    ckpt.close()
+
+
+def test_digest_mismatch_classified_corruption_not_structural():
+  """CheckpointCorruption's message must route down the corruption
+  arm of the ladder (fallback), never the structural arm (raise with
+  config-flag guidance)."""
+  from scalable_agent_tpu import checkpoint as checkpoint_lib
+  e = checkpoint_lib.CheckpointCorruption(
+      "checkpoint step 7: content digest verification failed for "
+      "'default/d/abc' (crc 0000beef differs from the recorded "
+      '0000dead) — bit rot after commit; this step cannot be trusted')
+  assert not checkpoint_lib._looks_structural(e)
+
+
+def test_ckpt_bitrot_fault_site_fires_after_commit(setup, tmp_path):
+  """The 'ckpt_bitrot' site: save() verifies, records digests,
+  advances LAST_GOOD — and THEN the scheduled fault rots the step, so
+  every marker calls it good and only the digest ladder can tell."""
+  from scalable_agent_tpu.runtime import faults as faults_lib
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(
+      jax.tree_util.tree_map(jnp.copy, params), cfg)
+  ckpt = Checkpointer(str(tmp_path / 'site'), save_interval_secs=0)
+  faults_lib.install(faults_lib.FaultPlan(
+      [faults_lib.Fault('ckpt_bitrot', 0, 'flip')], seed=9))
+  try:
+    assert ckpt.save(state, step=1, force=True)
+    assert ckpt.last_good_step() == 1  # the marker believed the save
+    with pytest.raises(Exception, match='digest'):
+      ckpt.verify_step_digests(1)
+  finally:
+    faults_lib.clear()
+    ckpt.close()
+
+
+def test_digests_disabled_skips_verification(setup, tmp_path):
+  """--ckpt_digests=false: no ledger recorded, verification is a
+  no-op (None), and a rotted step restores exactly as pre-round-12 —
+  the knob is a real escape hatch, not a silent half-state."""
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(
+      jax.tree_util.tree_map(jnp.copy, params), cfg)
+  ckpt = Checkpointer(str(tmp_path / 'off'), save_interval_secs=0,
+                      verify_digests=False)
+  try:
+    _save_steps(ckpt, state, (1,))
+    assert ckpt.verify_step_digests(1) is None
+    import os
+    assert not any(n.startswith('DIGEST_')
+                   for n in os.listdir(str(tmp_path / 'off')))
+  finally:
+    ckpt.close()
+
+
+def test_digest_ledgers_pruned_with_steps(setup, tmp_path):
+  """DIGEST_<step>.json files of pruned steps are cleaned up (a long
+  run must not accumulate one file per evicted checkpoint)."""
+  import os
+  cfg, agent, params, _ = setup
+  state = learner_lib.make_train_state(
+      jax.tree_util.tree_map(jnp.copy, params), cfg)
+  ckpt = Checkpointer(str(tmp_path / 'prune'), max_to_keep=2,
+                      save_interval_secs=0)
+  try:
+    _save_steps(ckpt, state, (1, 2, 3))
+    names = {n for n in os.listdir(str(tmp_path / 'prune'))
+             if n.startswith('DIGEST_')}
+    assert names == {'DIGEST_2.json', 'DIGEST_3.json'}
+  finally:
+    ckpt.close()

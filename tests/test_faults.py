@@ -337,3 +337,73 @@ class TestPartitionLayer:
     assert proc.returncode == -9, (proc.returncode, proc.stdout)
     assert 'BEFORE' in proc.stdout
     assert 'AFTER' not in proc.stdout
+
+
+class TestIntegrityLayer:
+  """Round-12 fault sites: each helper damages what it claims, where
+  it claims, and nothing else."""
+
+  def test_wire_bitflip_damages_copy_not_original(self):
+    from scalable_agent_tpu.runtime import faults, remote
+    import numpy as np
+    payload = np.arange(4096, dtype=np.uint8)
+    segments = remote._oob_frame_segments(('unroll', payload))
+    before = [bytes(memoryview(s)) for s in segments]
+    fault = faults.Fault('wire_bitflip', 0, 'flip')
+    damaged = faults.apply_wire_bitflip(fault, segments, seed=1)
+    after = [bytes(memoryview(s)) for s in damaged]
+    # Exactly one segment differs, by exactly one bit.
+    diffs = [i for i, (a, b) in enumerate(zip(before, after))
+             if a != b]
+    assert len(diffs) == 1
+    a, b = before[diffs[0]], after[diffs[0]]
+    assert sum(bin(x ^ y).count('1')
+               for x, y in zip(a, b)) == 1
+    # The ORIGINAL segments (and the caller's array) are untouched.
+    assert [bytes(memoryview(s)) for s in segments] == before
+
+  def test_corrupt_params_tree_changes_digest_only(self):
+    from scalable_agent_tpu import integrity
+    from scalable_agent_tpu.runtime import faults
+    import numpy as np
+    params = {'big': np.arange(256, dtype=np.float32),
+              'small': np.ones(2, np.float32)}
+    digest = integrity.tree_digest(params)
+    fault = faults.Fault('publish_corrupt', 0, 'flip')
+    corrupt = faults.corrupt_params_tree(fault, params, seed=2)
+    assert integrity.tree_digest(corrupt) != digest
+    # Original aliased leaves untouched; structure preserved.
+    assert integrity.tree_digest(params) == digest
+    assert corrupt['small'] is params['small']
+    assert corrupt['big'].shape == params['big'].shape
+    # bf16 wire forms (numpy kind 'V') are corruptible too — the
+    # regression that made the first storm run a silent no-op.
+    import ml_dtypes
+    wire = {'w': params['big'].astype(ml_dtypes.bfloat16)}
+    assert integrity.tree_digest(
+        faults.corrupt_params_tree(fault, wire, seed=2)
+    ) != integrity.tree_digest(wire)
+
+  def test_bitrot_flips_one_byte_in_place(self, tmp_path):
+    from scalable_agent_tpu.runtime import faults
+    step_dir = tmp_path / '7'
+    step_dir.mkdir()
+    (step_dir / 'arrays.bin').write_bytes(b'\x00' * 1024)
+    (step_dir / 'meta').write_bytes(b'tiny')
+    target = faults.bitrot_checkpoint_step(str(tmp_path), 7, seed=4)
+    assert target.endswith('arrays.bin')  # the largest file
+    data = (step_dir / 'arrays.bin').read_bytes()
+    assert len(data) == 1024
+    assert sum(bin(b).count('1') for b in data) == 1  # one bit flipped
+
+  def test_storm_builder_schedules_integrity_sites(self):
+    from scalable_agent_tpu.runtime import faults
+    plan = faults.FaultPlan.storm(
+        1, wire_bitflip=[2, 5], publish_corrupt_at=3,
+        publish_corrupt_len=4, ckpt_bitrot_at=1,
+        replica_divergence_at=6, replica_divergence_len=3)
+    stats = plan.stats()
+    assert stats['wire_bitflip']['scheduled'] == 2
+    assert stats['publish_corrupt']['scheduled'] == 4
+    assert stats['ckpt_bitrot']['scheduled'] == 1
+    assert stats['replica_divergence']['scheduled'] == 3

@@ -244,3 +244,43 @@ class TestDriverIntegration:
       assert ckpt.latest_step() is None
     finally:
       ckpt.close()
+
+
+# --- Round 12: the SDC detector --------------------------------------
+
+
+def test_sdc_mismatch_flags_counts_separately_and_escalates():
+  """A replica-fingerprint mismatch is BAD with its own counter
+  (hardware lying, not math diverging — skipped_steps must NOT move),
+  names the suspect in the reason, and escalates through the same
+  ladder: K consecutive mismatches earn a ROLLBACK."""
+  from scalable_agent_tpu import health as health_lib
+
+  mon = health_lib.HealthMonitor(rollback_after=3, max_rollbacks=2)
+  base = {'step_ok': 1.0, 'total_loss': 0.5, 'grad_norm': 1.0}
+  assert mon.observe_values(1, dict(base)) == health_lib.OK
+
+  bad = dict(base, sdc_replica_mismatch=1.0)
+  assert mon.observe_values(2, dict(bad)) == health_lib.BAD
+  assert 'SDC' in mon.last_reason
+  assert mon.sdc_mismatches == 1
+  assert mon.skipped_steps == 0      # counted separately
+  assert mon.observe_values(3, dict(bad)) == health_lib.BAD
+  assert mon.observe_values(4, dict(bad)) == health_lib.ROLLBACK
+  assert mon.sdc_mismatches == 3
+  assert mon.stats()['sdc_mismatches'] == 3
+  # Recovery: agreeing fingerprints are OK again and reset the run.
+  ok = dict(base, sdc_replica_mismatch=0.0)
+  assert mon.observe_values(5, ok) == health_lib.OK
+  assert mon.consecutive_bad == 0
+
+
+def test_sdc_absent_key_keeps_detector_off():
+  """Configs without the sentinel (single device, TP) never produce
+  the key — the detector must stay silent."""
+  from scalable_agent_tpu import health as health_lib
+
+  mon = health_lib.HealthMonitor()
+  values = {'step_ok': 1.0, 'total_loss': 0.1, 'grad_norm': 0.5}
+  assert mon.observe_values(1, values) == health_lib.OK
+  assert mon.sdc_mismatches == 0

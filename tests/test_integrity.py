@@ -1,0 +1,75 @@
+"""Unit tests for the data-plane integrity primitives (round 12):
+CRC32C helpers, pytree content digests, file digests, and the
+algorithm-tagged record/verify pair every consumer (wire, checkpoint,
+replay tier) builds on."""
+
+import numpy as np
+
+from scalable_agent_tpu import integrity
+
+
+def test_crc_known_vector_and_incremental():
+  """The CRC32C check vector (RFC 3720: crc32c('123456789') =
+  0xE3069283) when the C extension backs the module; incremental
+  updates must equal the one-shot value either way."""
+  data = b'123456789'
+  one_shot = integrity.crc_bytes(data)
+  if integrity.CRC_ALGO == 'crc32c':
+    assert one_shot == 0xE3069283
+  acc = integrity.Crc()
+  acc.update(data[:3]).update(data[3:7]).update(data[7:])
+  assert acc.value == one_shot
+  # bytes-likes the C extension refuses directly must still work.
+  assert integrity.crc_bytes(bytearray(data)) == one_shot
+  assert integrity.crc_bytes(memoryview(data)) == one_shot
+
+
+def test_tree_digest_sensitivity():
+  """Any changed bit, dtype, or shape changes the digest; an
+  identical tree reproduces it exactly."""
+  tree = {'a': np.arange(64, dtype=np.float32),
+          'b': (np.ones(3, np.int32), np.zeros((2, 2), np.uint8))}
+  d = integrity.tree_digest(tree)
+  assert integrity.tree_digest(
+      {'a': tree['a'].copy(), 'b': (tree['b'][0].copy(),
+                                    tree['b'][1].copy())}) == d
+  flipped = tree['a'].copy()
+  flipped.view(np.uint32)[5] ^= 1
+  assert integrity.tree_digest(dict(tree, a=flipped)) != d
+  # Shape and dtype are content: a reshape/recast must not collide.
+  assert integrity.tree_digest(
+      dict(tree, a=tree['a'].reshape(8, 8))) != d
+  assert integrity.tree_digest(
+      dict(tree, a=tree['a'].view(np.int32))) != d
+  # Non-contiguous views digest by CONTENT, same as their copy.
+  mat = np.arange(16, dtype=np.float32).reshape(4, 4)
+  assert integrity.tree_digest(mat.T) == \
+      integrity.tree_digest(np.ascontiguousarray(mat.T))
+
+
+def test_file_digest_and_flip_bit(tmp_path):
+  path = tmp_path / 'blob.bin'
+  payload = bytes(np.arange(5000, dtype=np.uint8) % 251)
+  path.write_bytes(payload)
+  d = integrity.file_digest(str(path))
+  assert d == integrity.crc_bytes(payload)
+  buf = bytearray(payload)
+  byte, bit = integrity.flip_bit(buf, 12345)
+  assert buf[byte] == payload[byte] ^ (1 << bit)
+  path.write_bytes(bytes(buf))
+  assert integrity.file_digest(str(path)) != d
+
+
+def test_verify_record_algorithm_gate():
+  """Records carry their algorithm: a foreign-algorithm record is NOT
+  comparable (None — skip, never report phantom corruption); same-algo
+  records compare exactly; garbage records are None."""
+  rec = integrity.digest_record(0xDEAD)
+  assert rec['algo'] == integrity.CRC_ALGO
+  assert integrity.verify_record(rec, 0xDEAD) is True
+  assert integrity.verify_record(rec, 0xBEEF) is False
+  assert integrity.verify_record(
+      {'crc': 0xDEAD, 'algo': 'some-other-algo'}, 0xDEAD) is None
+  assert integrity.verify_record(None, 0xDEAD) is None
+  assert integrity.verify_record({'algo': integrity.CRC_ALGO},
+                                 0xDEAD) is None

@@ -760,9 +760,19 @@ def _run_learner_with_remote_child(tmp_path, base, child_actors,
                 'remote_reattached', 'remote_stale_epoch_rejected',
                 'actors_wedged'):
       assert tag in tags, tag
+    # Round-12 integrity telemetry reaches summaries.jsonl too, and a
+    # clean run shows ZERO violations (CRC is negotiated ON by
+    # default — every one of these unrolls was trailer-verified).
+    for tag in ('wire_crc_rejected', 'publish_digest_rejected',
+                'ckpt_digest_fallbacks', 'sdc_replica_mismatches',
+                'ingest_discarded_frames', 'ingest_discarded_bytes'):
+      assert tag in tags, tag
     stats = run.ingest.stats()
     assert stats['stale_epoch_rejected'] == 0
     assert stats['ingest_threads_wedged'] == 0
+    assert stats['wire_crc_rejected'] == 0
+    assert stats['publish_digest_rejected'] == 0
+    assert stats['discarded_frames'] == 0
     out, _ = child.communicate(timeout=120)
     assert child.returncode == 0, out[-2000:]
     assert 'CHILD_OK' in out, out[-2000:]
@@ -1332,3 +1342,279 @@ def test_backpressured_conn_not_reaped_past_idle_window():
     client.close()
     server.close()
     buffer.close()
+
+
+# --- Round 12: protocol v7 payload integrity -------------------------
+
+
+def test_v7_crc_negotiation_and_clean_roundtrip():
+  """The production default: a v7 client against a v7 wire_crc server
+  negotiates CRC at hello; every subsequent frame both ways carries a
+  verified trailer, unrolls land, params fetch over the lane, and the
+  integrity counters stay zero. The hello reply itself carries a
+  params content digest the client verifies before install."""
+  cfg, agent, contract = _contract_setup()
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.arange(64, dtype=np.float32)}, host='127.0.0.1',
+      contract=contract, wire_crc=True)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    version, params = client.handshake(contract)
+    assert version == 1
+    assert client._crc, 'CRC did not negotiate on for a v7 pair'
+    assert client.server_info.get('wire_crc') is True
+    assert 'params_digest' in client.server_info
+    unroll = _conforming_unroll(cfg, agent, 3, seed=3)
+    assert client.send_unroll(unroll, params_version=1) == 1
+    got = buffer.get(timeout=5)
+    _assert_trees_equal(got, unroll)
+    # Ping (trailer both ways) and a lane fetch (trailered blob).
+    assert client.ping() == 1
+    server.publish_params({'w': np.full(8, 2.0, np.float32)})
+    v2, tree2 = client.fetch_params()
+    assert v2 == 2
+    np.testing.assert_array_equal(tree2['w'],
+                                  np.full(8, 2.0, np.float32))
+    stats = server.stats()
+    assert stats['wire_crc_rejected'] == 0
+    assert stats['quarantined'] == 0
+    assert client.crc_rejected == 0
+    assert client.digest_rejected == 0
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
+
+
+def test_wire_bitflip_refused_before_put_then_resent_clean():
+  """The tentpole contract: a single bit flip that still PARSES is
+  refused by the worker BEFORE the buffer put with the benign
+  ('corrupt', crc) reply — the buffer provably never sees it, the
+  connection survives, and the re-send (clean bytes: the fault damages
+  a COPY) lands bit-exact. Counted as wire_crc_rejected, never as a
+  quarantine."""
+  import pytest
+  from scalable_agent_tpu.runtime import faults as faults_lib
+
+  cfg, agent, contract = _contract_setup()
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1', contract=contract)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  plan = faults_lib.FaultPlan(
+      [faults_lib.Fault('wire_bitflip', 0, 'flip')], seed=3)
+  try:
+    client.handshake(contract)
+    unroll = _conforming_unroll(cfg, agent, 3, seed=5)
+    faults_lib.install(plan)
+    try:
+      with pytest.raises(remote.UnrollCorrupt):
+        client.send_unroll(unroll, params_version=1)
+    finally:
+      faults_lib.clear()
+    assert client.crc_rejected == 1
+    assert len(buffer) == 0, 'corrupt unroll reached the buffer'
+    stats = server.stats()
+    assert stats['wire_crc_rejected'] == 1
+    assert stats['quarantined'] == 0
+    assert stats['unrolls'] == 0
+    # The re-send (no fault armed) ships clean bytes on the SAME
+    # connection and lands bit-exact.
+    assert client.send_unroll(unroll, params_version=1) == 1
+    _assert_trees_equal(buffer.get(timeout=5), unroll)
+    assert server.stats()['connections'] == 1
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
+
+
+def test_v7_v6_interop_crc_negotiated_off_both_directions():
+  """Interop both ways (the acceptance gate): a v6 client against a
+  v7 server, and a v7 client against a CRC-disabled server, both
+  negotiate the trailers OFF and move unrolls exactly like the v6
+  wire — no stray trailer bytes, no phantom corruption."""
+  cfg, agent, contract = _contract_setup()
+
+  # (a) v6 peer against a v7 wire_crc server.
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1', contract=contract,
+      wire_crc=True)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    client.handshake(dict(contract, protocol=6))
+    assert not client._crc
+    client.session_epoch = None  # v6 wire shape
+    unroll = _conforming_unroll(cfg, agent, 3, seed=7)
+    assert client.send_unroll(unroll, params_version=1) == 1
+    _assert_trees_equal(buffer.get(timeout=5), unroll)
+    assert server.stats()['wire_crc_rejected'] == 0
+    assert server.stats()['quarantined'] == 0
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
+
+  # (b) v7 client against a server running --wire_crc=false.
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1', contract=contract,
+      wire_crc=False)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    client.handshake(contract)
+    assert not client._crc
+    unroll = _conforming_unroll(cfg, agent, 3, seed=9)
+    assert client.send_unroll(unroll, params_version=1) == 1
+    _assert_trees_equal(buffer.get(timeout=5), unroll)
+    # The lane fetch works trailer-free too.
+    server.publish_params({'w': np.ones(2)})
+    assert client.fetch_params()[0] == 2
+    # Digest verification runs INDEPENDENT of lane CRC (digests ship
+    # whenever the server is v7), and the rejection notice must reach
+    # the wire_crc=False server too — the review-round regression.
+    import pytest
+    from scalable_agent_tpu.runtime import faults as faults_lib
+    faults_lib.install(faults_lib.FaultPlan(
+        [faults_lib.Fault('publish_corrupt', 0, 'flip')], seed=11))
+    try:
+      server.publish_params({'w': np.arange(64, dtype=np.float32)})
+    finally:
+      faults_lib.clear()
+    with pytest.raises(remote.ParamsCorrupt):
+      client.fetch_params()
+    with pytest.raises(remote.ParamsCorrupt):
+      client.fetch_params()  # the retry carries the nack
+    assert server.stats()['publish_digest_rejected'] >= 1
+    assert server.stats()['quarantined'] == 0
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
+
+
+def test_publish_digest_rejected_before_install_with_nack():
+  """A publish corrupted AFTER its digest (host-memory rot — the
+  frame CRC is self-consistent) must be refused BEFORE install:
+  fetch_params raises ParamsCorrupt, the retry fetch carries the
+  digest-rejected notice (the learner's publish_digest_rejected
+  ledger), and the next CLEAN publish fetches fine."""
+  import pytest
+  from scalable_agent_tpu.runtime import faults as faults_lib
+
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.arange(128, dtype=np.float32)},
+      host='127.0.0.1')
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    client.handshake({'protocol': remote.PROTOCOL_VERSION})
+    assert client._crc
+    # Corrupt the NEXT blob build (the plan is installed after the
+    # constructor's blob, so the coming publish is site event 0).
+    faults_lib.install(faults_lib.FaultPlan(
+        [faults_lib.Fault('publish_corrupt', 0, 'flip')], seed=5))
+    try:
+      server.publish_params({'w': np.arange(128, dtype=np.float32)})
+    finally:
+      faults_lib.clear()
+    with pytest.raises(remote.ParamsCorrupt):
+      client.fetch_params()
+    assert client.digest_rejected == 1
+    # The retry carries the nack; the blob is STILL corrupt (cached),
+    # so it is refused again — but the server now knows.
+    with pytest.raises(remote.ParamsCorrupt):
+      client.fetch_params()
+    assert server.stats()['publish_digest_rejected'] >= 1
+    # A clean publish supersedes the rot; the fetch installs.
+    server.publish_params({'w': np.full(4, 3.0, np.float32)})
+    v, tree = client.fetch_params()
+    assert v == 3
+    np.testing.assert_array_equal(tree['w'],
+                                  np.full(4, 3.0, np.float32))
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
+
+
+def test_quarantine_reports_discarded_bytes_and_frames():
+  """Round-12 regression (the satellite fix): the unparseable-frame
+  quarantine used to count the CONNECTION but drop the partial batch
+  accounting — the discard path must now report how many bytes/frames
+  died with it."""
+  buffer = ring_buffer.TrajectoryBuffer(2)
+  server = remote.TrajectoryIngestServer(buffer, {'w': np.zeros(1)},
+                                         host='127.0.0.1')
+  try:
+    rogue = socket.create_connection(('127.0.0.1', server.port))
+    rogue.settimeout(10)
+    # A well-framed message whose tag byte is garbage: parses the
+    # header, fails the frame kind — the quarantine path.
+    payload = b'\xee' + b'x' * 499
+    rogue.sendall(remote._LEN.pack(len(payload)) + payload)
+    try:
+      assert rogue.recv(1) == b''
+    except ConnectionResetError:
+      pass
+    rogue.close()
+    deadline = time.monotonic() + 5
+    while (server.stats()['quarantined'] < 1
+           and time.monotonic() < deadline):
+      time.sleep(0.05)
+    stats = server.stats()
+    assert stats['quarantined'] == 1
+    assert stats['discarded_frames'] == 1
+    # Header (8) + however much of the body was consumed before the
+    # parse failed — at least the header plus the tag byte.
+    assert stats['discarded_bytes'] >= remote._LEN.size + 1
+
+    # Review-round regression: a GOOD frame followed by an oversized
+    # length header must charge ~8 discarded bytes, not the good
+    # frame's size (the ledger resets before the bound check raises).
+    rogue2 = socket.create_connection(('127.0.0.1', server.port))
+    rogue2.settimeout(10)
+    remote._send_msg(rogue2, ('ping',))
+    assert remote._recv_msg(rogue2)[0] == 'pong'
+    rogue2.sendall(remote._LEN.pack(remote._MAX_MSG + 1))
+    try:
+      while rogue2.recv(4096):
+        pass
+    except ConnectionResetError:
+      pass
+    rogue2.close()
+    deadline = time.monotonic() + 5
+    while (server.stats()['quarantined'] < 2
+           and time.monotonic() < deadline):
+      time.sleep(0.05)
+    stats2 = server.stats()
+    assert stats2['quarantined'] == 2
+    delta = stats2['discarded_bytes'] - stats['discarded_bytes']
+    assert delta == remote._LEN.size, delta
+  finally:
+    server.close()
+    buffer.close()
+
+
+def test_validate_integrity_cross_links():
+  """The round-12 knob-group validation: half-enabled integrity
+  planes warn, the default config is silent."""
+  from scalable_agent_tpu.config import Config, validate_integrity
+
+  assert validate_integrity(Config()) == []
+  warnings = validate_integrity(Config(sdc_check=True,
+                                       health_watchdog=False))
+  assert any('never escalated' in w for w in warnings)
+  warnings = validate_integrity(Config(wire_crc=False,
+                                       remote_actor_port=1234))
+  assert any('no detection' in w for w in warnings)
+  warnings = validate_integrity(Config(wire_crc=False,
+                                       replay_ratio=0.5))
+  assert any('already-rotten' in w for w in warnings)

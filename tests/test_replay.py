@@ -640,3 +640,33 @@ class TestBenchStage:
     assert replay['k2_r0']['reuse_factor'] >= 1.8
     assert (replay['k2_r0']['h2d_unrolls_per_update'] <=
             replay['k1_r0']['h2d_unrolls_per_update'] / 1.8)
+
+
+def test_replay_tier_crc_evicts_rotted_entry():
+  """Round 12: a retained unroll mutated in host memory AFTER insert
+  (the tier holds by reference — rot is exactly this shape) must be
+  EVICTED at sample time, never served; counted as
+  replay_evictions_crc. With verify_crc=False the tier serves the
+  aliased object untouched (the pre-round-12 semantics)."""
+  import numpy as np
+  from scalable_agent_tpu.runtime import ring_buffer
+  from tests.test_remote import _tiny_unroll
+
+  tier = ring_buffer.ReplayTier(4)
+  clean = _tiny_unroll(0)
+  rotten = _tiny_unroll(1)
+  tier.add(clean)
+  tier.add(rotten)
+  # Rot: flip one byte of the retained frame stack, in place.
+  np.asarray(rotten.env_outputs.observation[0]).flat[7] ^= 0x10
+  out = tier.sample(4)
+  assert len(out) == 1
+  assert out[0] is clean
+  assert tier.evictions_crc == 1
+  assert len(tier) == 1
+  assert tier.stats()['replay_evictions_crc'] == 1
+
+  off = ring_buffer.ReplayTier(4, verify_crc=False)
+  off.add(rotten)
+  assert off.sample(1) == [rotten]
+  assert off.evictions_crc == 0
