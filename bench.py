@@ -1796,6 +1796,126 @@ def bench_anakin(smoke):
   }
 
 
+def bench_telemetry(smoke):
+  """Tracing/registry overhead (round 13; docs/PERF.md r11): the cost
+  of the always-on telemetry plane, measured so the default is an
+  accept/reject call with numbers. Three rows:
+
+  a) registry micro: Counter.inc + Histogram.observe, ns/op — the
+     per-event cost every converted module counter now pays;
+  b) span micro: the full per-unroll trace lifecycle (make + 4 hop
+     stamps + sidecar tag + pop), ns/span;
+  c) feed pipeline head-to-head: synthetic producer threads →
+     TrajectoryBuffer → BatchPrefetcher at flagship unroll sizes,
+     tracer ON (spans stamped + tagged by producers, batch records
+     written to a real traces.jsonl) vs OFF — unrolls/s both ways and
+     the headline overhead fraction.
+  """
+  import shutil
+  import tempfile
+  import threading
+  from scalable_agent_tpu import telemetry
+  from scalable_agent_tpu.runtime import ring_buffer
+
+  t1 = 101 if not smoke else 6
+  h, w = (72, 96) if not smoke else (24, 32)
+  dur = 4.0 if not smoke else 0.8
+  unroll = _transport_unroll(t1, h, w)
+  results = {}
+
+  # --- (a) registry micro. ---
+  n = 200_000 if not smoke else 20_000
+  c = telemetry.counter('bench/telemetry_counter')
+  hist = telemetry.histogram('bench/telemetry_hist')
+  t0 = time.perf_counter()
+  for i in range(n):
+    c.inc()
+    hist.observe(i)
+  dt = time.perf_counter() - t0
+  results['registry_ns_per_op'] = round(dt / (2 * n) * 1e9, 1)
+
+  # --- (b) span micro. ---
+  n = 50_000 if not smoke else 5_000
+  t0 = time.perf_counter()
+  for i in range(n):
+    tr = telemetry.make_trace('bench', i, behavior_version=i)
+    for hop in (telemetry.HOP_DONE, telemetry.HOP_WIRE,
+                telemetry.HOP_STAGED, telemetry.HOP_STEP):
+      telemetry.stamp(tr, hop)
+    telemetry.tag_unroll(unroll, tr)
+    telemetry.pop_unroll(unroll)
+  dt = time.perf_counter() - t0
+  results['span_ns'] = round(dt / n * 1e9, 1)
+
+  # --- (c) feed pipeline, tracing on vs off. ---
+  def run_feed(tracing):
+    batch_size = 4
+    tmpdir = tempfile.mkdtemp(prefix='bench_telemetry_')
+    tracer = None
+    if tracing:
+      tracer = telemetry.PipelineTracer(tmpdir)
+      telemetry.set_tracer(tracer)
+    buffer = ring_buffer.TrajectoryBuffer(2 * batch_size)
+    stop = threading.Event()
+
+    def produce(name):
+      seq = 0
+      while not stop.is_set():
+        # _replace: a fresh pytree object per put — the sidecar tag
+        # store keys by identity, so re-putting ONE object would
+        # alias every in-flight tag (production unrolls are always
+        # distinct objects).
+        item = unroll._replace()
+        trace = telemetry.begin_unroll_trace(name, seq)
+        if trace is not None:
+          telemetry.stamp(trace, telemetry.HOP_DONE)
+          telemetry.tag_unroll(item, trace)
+        seq += 1
+        try:
+          buffer.put(item, timeout=0.2)
+        except (TimeoutError, ring_buffer.Closed):
+          continue
+
+    producers = [threading.Thread(target=produce, args=(f'p{i}',),
+                                  daemon=True) for i in range(4)]
+    for p in producers:
+      p.start()
+    prefetcher = ring_buffer.BatchPrefetcher(buffer, batch_size,
+                                             place_fn=lambda b: b)
+    prefetcher.get(timeout=30)
+    if tracer is not None:
+      tracer.on_step(0)
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < dur:
+      prefetcher.get(timeout=30)
+      n += 1
+      if tracer is not None:
+        # The driver's per-step completion call — batch record +
+        # policy-lag arithmetic + the traces.jsonl write.
+        tracer.on_step(n)
+    dt = time.perf_counter() - t0
+    stop.set()
+    prefetcher.close()
+    for p in producers:
+      p.join(timeout=2)
+    row = {'unrolls_per_sec': round(n * batch_size / dt, 1)}
+    if tracer is not None:
+      row['tracer'] = tracer.stats()
+      telemetry.set_tracer(None)
+      tracer.close()
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    return row
+
+  results['feed_trace_off'] = run_feed(False)
+  results['feed_trace_on'] = run_feed(True)
+  on = results['feed_trace_on']['unrolls_per_sec']
+  off = results['feed_trace_off']['unrolls_per_sec']
+  results['overhead_fraction'] = (round(1.0 - on / off, 4)
+                                  if off else None)
+  return results
+
+
 def main():
   # BENCH_SMOKE=1: tiny shapes on CPU — validates bench mechanics in CI
   # without the chip. The driver runs the real thing (no env var, TPU).
@@ -1851,6 +1971,19 @@ def main():
     })
     return
 
+  # BENCH_ONLY=telemetry: just the tracing/registry overhead rows
+  # (the scripts/ci.sh telemetry smoke — the on/off accept gate).
+  if os.environ.get('BENCH_ONLY') == 'telemetry':
+    tele = bench_telemetry(smoke)
+    _emit({
+        'metric': 'telemetry_overhead_fraction',
+        'value': tele.get('overhead_fraction'),
+        'unit': ('feed-throughput fraction lost with tracing on%s'
+                 % (' (SMOKE)' if smoke else '')),
+        'telemetry': tele,
+    })
+    return
+
   # BENCH_ONLY=overload: just the overload rows (the scripts/ci.sh
   # chaos-adjacent smoke — shed-rate/tail-latency mechanics on CPU).
   if os.environ.get('BENCH_ONLY') == 'overload':
@@ -1896,6 +2029,9 @@ def main():
   replay = None
   if os.environ.get('BENCH_SKIP_REPLAY') != '1':
     replay = bench_replay(smoke)
+  tele = None
+  if os.environ.get('BENCH_SKIP_TELEMETRY') != '1':
+    tele = bench_telemetry(smoke)
 
   baseline_per_chip = 200_000.0 / 16.0  # north star / v5e-16 chips
   out = {
@@ -1937,6 +2073,8 @@ def main():
     out['learner_plane'] = plane
   if replay is not None:
     out['replay'] = replay
+  if tele is not None:
+    out['telemetry'] = tele
   _emit(out)
 
 
@@ -2043,6 +2181,15 @@ def _headline(out):
     if curves.get('reuse_k2'):
       head['replay']['cue_memory_updates_per_env_frame'] = (
           curves['reuse_k2'].get('updates_per_env_frame'))
+  # The telemetry-plane cost (round 13): the on/off feed overhead the
+  # always-on tracing default is accepted/rejected on (docs/PERF.md
+  # r11) — clip-safe like every other default-flip record.
+  tele = out.get('telemetry')
+  if tele:
+    head['telemetry'] = {
+        'overhead_fraction': tele.get('overhead_fraction'),
+        'span_ns': tele.get('span_ns'),
+        'registry_ns_per_op': tele.get('registry_ns_per_op')}
   return head
 
 

@@ -368,6 +368,16 @@ flags.DEFINE_bool('replay_crc', _DEFAULTS.replay_crc,
                   'Verify replay-tier entries against their '
                   'insert-time CRC at every serve; rot evicts '
                   'instead of re-serving.')
+flags.DEFINE_bool('telemetry_trace', _DEFAULTS.telemetry_trace,
+                  'Per-unroll trace spans (protocol v8) + the '
+                  'traces.jsonl stream and policy-lag attribution '
+                  '(scripts/trace_report.py; docs/OBSERVABILITY.md). '
+                  'Measured overhead below noise — docs/PERF.md r11.')
+flags.DEFINE_integer('telemetry_flight_len',
+                     _DEFAULTS.telemetry_flight_len,
+                     'Flight-recorder depth: recent trace records + '
+                     'registry snapshots dumped with halt bundles '
+                     'and rollback incidents.')
 flags.DEFINE_bool('health_watchdog', _DEFAULTS.health_watchdog,
                   'Learner failure domain (health.py): skip '
                   'non-finite updates on device, roll back to the '

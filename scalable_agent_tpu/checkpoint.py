@@ -151,6 +151,19 @@ class Checkpointer:
     # mismatches — counted separately from structural/partial
     # fallbacks so summaries can alarm on silent disk corruption.
     self.digest_fallbacks = 0
+    # Unified-registry view (round 13, telemetry.py): lazy gauges over
+    # the ladder counters — same numbers as the driver summaries, read
+    # by the drain manifest / flight recorder / remote 'stats' from
+    # one source of truth.
+    from scalable_agent_tpu import telemetry
+    self._gauges = [
+        telemetry.gauge('checkpoint/save_errors',
+                        fn=lambda: self.save_errors),
+        telemetry.gauge('checkpoint/restore_fallbacks',
+                        fn=lambda: self.restore_fallbacks),
+        telemetry.gauge('checkpoint/digest_fallbacks',
+                        fn=lambda: self.digest_fallbacks),
+    ]
 
   def save(self, state: TrainState, step: Optional[int] = None,
            force: bool = False) -> bool:
@@ -579,3 +592,8 @@ class Checkpointer:
   def close(self):
     self._manager.wait_until_finished()
     self._manager.close()
+    # Drop the registry's fn-gauge hold on this instance (identity-
+    # checked — a newer checkpointer's registration survives).
+    from scalable_agent_tpu import telemetry
+    for gauge in self._gauges:
+      telemetry.registry().unregister(gauge.name, gauge)

@@ -376,6 +376,25 @@ class Config:
   # re-verified at every serve (reuse must not multiply host-memory
   # rot into K batches); mismatches evict (replay_evictions_crc).
   replay_crc: bool = True
+  # --- Telemetry plane (round 13; docs/OBSERVABILITY.md). ---
+  # Per-unroll trace spans: each unroll carries a compact trace
+  # context (actor id, sequence, session epoch, behaviour params
+  # version, hop timestamps) stamped at env-step completion and
+  # completed through ingest → staging → serve → train step; the
+  # learner emits traces.jsonl (one line per trained batch with the
+  # policy-lag vector) and scripts/trace_report.py reconstructs
+  # per-hop latency + the lag distribution. Negotiated on the wire
+  # (protocol v8) — older peers simply don't stamp. Default ON: the
+  # bench.py `telemetry` stage measured the overhead below run-to-run
+  # noise (docs/PERF.md r11 records the accept call); False turns off
+  # stamping, the tracer, and the traces.jsonl stream.
+  telemetry_trace: bool = True
+  # Flight-recorder depth: the most recent N trace records (batches /
+  # publishes / installs) plus periodic metrics-registry snapshots
+  # kept in memory and dumped with the health halt bundle and every
+  # rollback incident — the "last N seconds of pipeline history"
+  # an incident postmortem starts from.
+  telemetry_flight_len: int = 512
   # --- Learner failure domain (health.py, round 7). ---
   # Training-health watchdog: the train step skips non-finite updates
   # on device (params carry over unchanged) and the driver escalates

@@ -43,6 +43,7 @@ from scalable_agent_tpu import checkpoint as checkpoint_lib
 from scalable_agent_tpu import health as health_lib
 from scalable_agent_tpu import learner as learner_lib
 from scalable_agent_tpu import observability
+from scalable_agent_tpu import telemetry
 from scalable_agent_tpu.config import (Config, validate_integrity,
                                        validate_replay,
                                        validate_transport)
@@ -468,6 +469,7 @@ def train(config: Config, max_steps: Optional[int] = None,
   prefetcher = None
   writer = None
   incidents = None
+  tracer = None
   try:
     # --- Trajectory buffer + remote ingest, BEFORE inference warmup:
     # remote actor hosts connect and fetch params while this host
@@ -519,7 +521,8 @@ def train(config: Config, max_steps: Optional[int] = None,
           max_unroll_staleness=config.max_unroll_staleness,
           heartbeat_secs=config.remote_heartbeat_secs,
           idle_timeout_secs=config.remote_conn_idle_timeout_secs,
-          wire_crc=config.wire_crc)
+          wire_crc=config.wire_crc,
+          trace=config.telemetry_trace)
       log.info('remote-actor ingest listening on port %d '
                '(session epoch %d)', ingest.port, ingest.session_epoch)
     # --- Inference server (weights served host-side to actor
@@ -674,6 +677,20 @@ def train(config: Config, max_steps: Optional[int] = None,
         config.logdir,
         filename=('incidents.jsonl' if process_index == 0
                   else f'incidents_p{process_index}.jsonl'))
+    # Telemetry plane (round 13, telemetry.py): the pipeline tracer
+    # completes per-unroll trace spans (actor → wire → ingest →
+    # staging → serve → step) into traces.jsonl and keeps the flight
+    # recorder the halt/rollback diagnostics dump. Installed
+    # process-globally BEFORE fleet.start() so the first unroll is
+    # already stamped; the finally clears and closes it.
+    if config.telemetry_trace:
+      tracer = telemetry.PipelineTracer(
+          config.logdir,
+          filename=('traces.jsonl' if process_index == 0
+                    else f'traces_p{process_index}.jsonl'),
+          flight_capacity=config.telemetry_flight_len,
+          epoch=(ingest.session_epoch if ingest is not None else None))
+      telemetry.set_tracer(tracer)
     # Reproducibility: the exact config of every run lives next to its
     # checkpoints/summaries (the reference leaves flags only in shell
     # history).
@@ -727,12 +744,29 @@ def train(config: Config, max_steps: Optional[int] = None,
       _try(writer.close)
     if incidents is not None:
       _try(incidents.close)
+    if tracer is not None:
+      _try(lambda: telemetry.set_tracer(None))
+      _try(tracer.close)
     _try(checkpointer.close)
     raise
 
   steps_done = 0
   profiling = False
   errors: List[BaseException] = []
+  # Unified-registry view of the loop itself (round 13): the step and
+  # frame clocks every other counter is read against. Lazy closures
+  # over the loop locals — the registry reads the live values; the
+  # finally unregisters them (the env-frames closure reaches the
+  # prefetcher, which must not stay registry-pinned after the run).
+  _loop_gauges = [
+      telemetry.gauge('driver/update_steps',
+                      fn=lambda: steps_done + _initial_steps),
+      telemetry.gauge(
+          'driver/env_frames',
+          fn=lambda: (env_frames_fn() if env_frames_fn is not None
+                      else (_initial_steps + steps_done) *
+                      config.frames_per_step)),
+  ]
   # Preemption-drain state: set once the drain is requested (SIGTERM
   # via drain_event, or the deterministic 'preempt_signal' fault);
   # the loop then flushes the already-produced feed instead of
@@ -900,6 +934,11 @@ def train(config: Config, max_steps: Optional[int] = None,
       # Episode stats ride in the trajectory; the prefetcher peeled a
       # host-side view before the device transfer — no device_get here.
       step_now = steps_done + _initial_steps
+      # Trace spans (round 13): the step consuming the oldest served
+      # batch was just dispatched — complete its spans and emit the
+      # batch record with the policy-lag vector (traces.jsonl).
+      if tracer is not None:
+        tracer.on_step(step_now)
       # Stack this step's scalar metrics into ONE device array now —
       # BEFORE the next step is dispatched, so the tiny stack
       # computation precedes it on the device stream. The summary
@@ -1014,11 +1053,33 @@ def train(config: Config, max_steps: Optional[int] = None,
             run.state = state
             published = actor_params(state.params)
             server.update_params(published)
+            rolled_remote_version = None
             if ingest is not None:
-              ingest.publish_params(jax.device_get(published))
+              rolled_remote_version = ingest.publish_params(
+                  jax.device_get(published))
+            if tracer is not None:
+              # The rollback republish is a real publish: the local
+              # lag clock and the install join both see it.
+              tracer.on_publish(step_now,
+                                remote_version=rolled_remote_version)
+            # Flight-recorder dump (round 13): the last N seconds of
+            # pipeline history (trace records + registry snapshots)
+            # next to the rollback incident — a rollback postmortem
+            # starts from what the pipeline was DOING, not just a
+            # counter total.
+            flight_path = None
+            if tracer is not None:
+              try:
+                out_dir = os.path.join(config.logdir, 'diagnostics')
+                os.makedirs(out_dir, exist_ok=True)
+                flight_path = tracer.flight.write(os.path.join(
+                    out_dir, f'flight_rollback_step{step_now}.json'))
+              except OSError:
+                log.exception('flight-recorder dump failed')
             incidents.event('rollback', step=step_now,
                             restored_checkpoint_step=restored_step,
-                            reason=health.last_reason)
+                            reason=health.last_reason,
+                            flight=flight_path)
             log.warning(
                 'health rollback at step %d: restored checkpoint '
                 'step %d (params/optimizer/popart revert; step '
@@ -1026,7 +1087,9 @@ def train(config: Config, max_steps: Optional[int] = None,
         if verdict == health_lib.HALT:
           bundle = health.write_halt_bundle(
               config.logdir, config, step_now,
-              reason=health.last_reason)
+              reason=health.last_reason,
+              flight=(tracer.flight.dump() if tracer is not None
+                      else None))
           incidents.event('health_halt', step=step_now,
                           reason=health.last_reason, bundle=bundle)
           raise health_lib.TrainingDivergence(
@@ -1048,6 +1111,7 @@ def train(config: Config, max_steps: Optional[int] = None,
         # published param versions — the same unit the ingest
         # admission window uses.
         buffer.note_param_version(step_now)
+        remote_version = None
         if (ingest is not None and
             time.monotonic() - last_remote_publish >=
             config.remote_publish_secs and
@@ -1061,7 +1125,15 @@ def train(config: Config, max_steps: Optional[int] = None,
           # multi-host-TP localization ran; device_get is then a
           # pass-through.)
           last_remote_publish = time.monotonic()
-          ingest.publish_params(jax.device_get(published))
+          remote_version = ingest.publish_params(
+              jax.device_get(published))
+        # Trace record + the local publish clock policy lag counts
+        # in. The INGEST-LANE version rides along when this snapshot
+        # also went to the remote fleet: actors' install notices
+        # carry that sequence, and trace_report's publish→install
+        # join keys on it.
+        if tracer is not None:
+          tracer.on_publish(step_now, remote_version=remote_version)
 
       now = time.monotonic()
       if now - last_summary >= config.summary_secs:
@@ -1079,6 +1151,19 @@ def train(config: Config, max_steps: Optional[int] = None,
         writer.scalars(observability.read_stacked_metrics(handle),
                        step_now)
         writer.scalar('env_frames_per_sec', fps_meter.fps(), step_now)
+        # Telemetry plane (round 13): the live policy-lag and
+        # end-to-end span percentiles (the trace stream's headline
+        # numbers, exported on the summary cadence so a lag blow-up
+        # shows without a trace_report run), and one registry
+        # snapshot into the flight recorder — the "what were the
+        # counters doing just before" half of an incident dump. NaN
+        # until traffic flows (rendered '-', not a fake 0).
+        if tracer is not None:
+          for tag, value in tracer.span_percentiles().items():
+            writer.scalar(tag, value, step_now)
+          writer.scalar('trace_untagged_unrolls',
+                        tracer.stats()['untagged_unrolls'], step_now)
+          tracer.flight.note_registry(telemetry.registry().snapshot())
         fleet_stats = fleet.stats(
             healthy_horizon_secs=(stall_timeout_secs
                                   if stall_timeout_secs else 60.0))
@@ -1448,6 +1533,12 @@ def train(config: Config, max_steps: Optional[int] = None,
           # postmortem without a summaries.jsonl dig.
           'health': (health.drain_report()
                      if health is not None else None),
+          # The unified telemetry snapshot (round 13): every
+          # registry-backed counter at drain time, from the same
+          # source of truth the flight recorder and the remote
+          # 'stats' request read — the resume/postmortem gets the
+          # full counter surface without a summaries.jsonl dig.
+          'metrics': telemetry.registry().snapshot(),
           'drain_source': drain_source,
           'drain_latency_secs': round(drain_latency, 3),
           'wall_time': round(time.time(), 3),
@@ -1531,6 +1622,11 @@ def train(config: Config, max_steps: Optional[int] = None,
       checkpointer.close()
       writer.close()
       incidents.close()
+      for gauge in _loop_gauges:
+        telemetry.registry().unregister(gauge.name, gauge)
+      if tracer is not None:
+        telemetry.set_tracer(None)
+        tracer.close()
   return run
 
 

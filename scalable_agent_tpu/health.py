@@ -167,6 +167,19 @@ class HealthMonitor:
     # so the drain manifest / postmortem carries them next to the
     # step-health counters instead of only in summaries.jsonl.
     self._external: Dict[str, int] = {}
+    # Unified-registry view (round 13, telemetry.py): lazy gauges over
+    # this monitor's ladder counters — the drain manifest, flight
+    # recorder, and the remote 'stats' request read the SAME numbers
+    # the driver's summaries carry, from one source of truth.
+    from scalable_agent_tpu import telemetry
+    telemetry.gauge('health/skipped_steps',
+                    fn=lambda: self.skipped_steps)
+    telemetry.gauge('health/flagged_steps',
+                    fn=lambda: self.flagged_steps)
+    telemetry.gauge('health/rollbacks', fn=lambda: self.rollbacks)
+    telemetry.gauge('health/halts', fn=lambda: self.halts)
+    telemetry.gauge('health/sdc_mismatches',
+                    fn=lambda: self.sdc_mismatches)
 
   # --- detectors ---
 
@@ -317,10 +330,15 @@ class HealthMonitor:
   # --- diagnostics ---
 
   def write_halt_bundle(self, logdir: str, config, step: int,
-                        reason: str) -> str:
+                        reason: str, flight=None) -> str:
     """The halt diagnostic bundle: last metrics window + counters +
     config + versions, as one JSON under <logdir>/diagnostics/. The
-    operator gets the divergence trajectory, not just a dead job."""
+    operator gets the divergence trajectory, not just a dead job.
+
+    `flight` (round 13): the telemetry flight recorder's dump — the
+    last N trace records (batches with policy-lag vectors, publishes,
+    installs) plus recent registry snapshots — so the halt ships the
+    preceding PIPELINE history, not only the learner-step window."""
     import jax
     try:
       import jaxlib
@@ -347,6 +365,8 @@ class HealthMonitor:
             'orbax': orbax_version,
         },
     }
+    if flight is not None:
+      bundle['flight'] = flight
     out_dir = os.path.join(logdir, 'diagnostics')
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, f'health_halt_step{int(step)}.json')

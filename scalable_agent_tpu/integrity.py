@@ -78,12 +78,24 @@ class Crc:
     return self
 
 
+# Unified-registry telemetry (round 13): how much content hashing the
+# integrity plane actually performs, and whether this host runs the
+# slow zlib fallback — both feed the registry snapshot the bench's
+# CRC-cost rows and the fleet 'stats' request read.
+from scalable_agent_tpu import telemetry as _telemetry
+_TREE_DIGESTS = _telemetry.counter('integrity/tree_digests')
+_FILE_DIGESTS = _telemetry.counter('integrity/file_digests')
+_telemetry.gauge('integrity/crc_algo_is_fallback',
+                 fn=lambda: 0 if CRC_ALGO == 'crc32c' else 1)
+
+
 def tree_digest(tree) -> int:
   """Content CRC of a pytree of host arrays, in deterministic
   flatten order. Dtype/shape changes ARE content changes: each leaf
   contributes its dtype name and shape to the stream, so a reshaped
   or recast tree never collides with the original."""
   import jax
+  _TREE_DIGESTS.inc()
   crc = Crc()
   for leaf in jax.tree_util.tree_leaves(tree):
     arr = np.asarray(leaf)
@@ -96,6 +108,7 @@ def tree_digest(tree) -> int:
 
 def file_digest(path: str, chunk_bytes: int = 1 << 20) -> int:
   """Content CRC of one file (checkpoint bit-rot ledger)."""
+  _FILE_DIGESTS.inc()
   crc = Crc()
   with open(path, 'rb') as f:
     while True:

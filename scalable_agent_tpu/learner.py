@@ -29,10 +29,17 @@ import optax
 
 from scalable_agent_tpu import losses as losses_lib
 from scalable_agent_tpu import popart as popart_lib
+from scalable_agent_tpu import telemetry
 from scalable_agent_tpu import unreal
 from scalable_agent_tpu import vtrace
 from scalable_agent_tpu.config import Config
 from scalable_agent_tpu.structs import ActorOutput
+
+# Unified-registry telemetry (round 13): registered once at import —
+# the registry replaces by name, so a per-call registration would
+# reset the cumulative build count.
+_STEP_FN_BUILDS = telemetry.counter('learner/step_fn_builds')
+_FRAMES_PER_STEP = telemetry.gauge('learner/frames_per_step')
 
 
 class TrainState(NamedTuple):
@@ -359,6 +366,12 @@ def make_train_step_fn(agent, config: Config, mesh=None):
   metrics). Single source of truth — jitted plain here and with explicit
   shardings in parallel/train_parallel.py (which passes its mesh so the
   Pallas V-trace can shard_map over the data axis)."""
+  # Unified-registry telemetry (round 13): each build corresponds to
+  # one XLA (re)compile of the step — a climbing count mid-run means
+  # shape churn recompiling the hot path; frames_per_step is the
+  # constant trace_report's throughput arithmetic divides by.
+  _STEP_FN_BUILDS.inc()
+  _FRAMES_PER_STEP.set(frames_per_step(config))
   optimizer = make_optimizer(config)
   schedule = make_schedule(config)
 

@@ -25,31 +25,17 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from scalable_agent_tpu import telemetry
 from scalable_agent_tpu.envs import suites
 
 
-class _JsonlAppender:
-  """Shared line-buffered append-only JSONL plumbing (thread-safe):
-  the one place that owns open/lock/write-line/close for both the
-  scalar summaries and the incident stream."""
-
-  def __init__(self, logdir: str, filename: str):
-    os.makedirs(logdir, exist_ok=True)
-    self._path = os.path.join(logdir, filename)
-    self._file = open(self._path, 'a', buffering=1)
-    self._lock = threading.Lock()
-
-  @property
-  def path(self):
-    return self._path
-
-  def _write(self, record: dict, **dumps_kwargs):
-    with self._lock:
-      self._file.write(json.dumps(record, **dumps_kwargs) + '\n')
-
-  def close(self):
-    with self._lock:
-      self._file.close()
+class _JsonlAppender(telemetry.JsonlAppender):
+  """Shared line-buffered append-only JSONL plumbing for the scalar
+  summaries and the incident stream. THE implementation (open/lock/
+  write-line/silent-counted-drop-after-close/fsync-durable) lives in
+  telemetry.JsonlAppender — one copy behind this module's streams AND
+  the tracer's traces.jsonl, so the round-13 crash-safety contract
+  cannot drift between them."""
 
 
 class SummaryWriter(_JsonlAppender):
@@ -59,7 +45,7 @@ class SummaryWriter(_JsonlAppender):
     super().__init__(logdir, filename)
 
   def scalar(self, tag: str, value, step: int):
-    self._write({'wall_time': round(time.time(), 3),
+    self.write({'wall_time': round(time.time(), 3),
                  'step': int(step), 'tag': tag, 'value': float(value)})
 
   def scalars(self, values: Dict[str, float], step: int):
@@ -79,7 +65,7 @@ class SummaryWriter(_JsonlAppender):
              'counts': [int(c) for c in np.asarray(counts).ravel()]}
     if edges is not None:
       event['edges'] = [float(e) for e in np.asarray(edges).ravel()]
-    self._write(event)
+    self.write(event)
 
 
 class EventLog(_JsonlAppender):
@@ -94,6 +80,15 @@ class EventLog(_JsonlAppender):
   cadence.
   """
 
+  # Incident kinds that must survive a kill -9 landing right after
+  # the event (fsync'd): the halt/rollback/SDC records ARE the
+  # postmortem — a line-buffered write that dies in the page cache
+  # with the process defeats the whole stream. Substring match so the
+  # driver's spellings (health_halt, sdc_replica_mismatch,
+  # fault_replica_divergence, actor_slots_quarantined) all qualify
+  # without a fragile exact list.
+  _DURABLE_MARKERS = ('halt', 'rollback', 'sdc', 'quarantin')
+
   def __init__(self, logdir: str, filename: str = 'incidents.jsonl'):
     super().__init__(logdir, filename)
 
@@ -102,7 +97,8 @@ class EventLog(_JsonlAppender):
     if step is not None:
       record['step'] = int(step)
     record.update(fields)
-    self._write(record, default=str)
+    durable = any(m in kind for m in self._DURABLE_MARKERS)
+    self.write(record, durable=durable, default=str)
 
 
 class FpsMeter:
@@ -187,44 +183,36 @@ class ThreadWatchdog:
 class LatencyReservoir:
   """Bounded recent-sample reservoir for latency percentiles
   (thread-safe) — the per-lane transport counters' backing store
-  (round 6): the ingest server records one ack service time per
-  unroll and the driver/bench read p50/p99 from here.
+  (round 6): seconds in, p50/p99 out, for consumers that want the
+  seconds-native API without a registry name (inference admission
+  waits).
 
-  A deque of the most recent `maxlen` samples keeps memory O(1) over
-  unbounded runs while staying faithful to the CURRENT operating
-  point — a cumulative aggregate would average away a regression that
-  starts late in a long run (same rationale as the per-interval merge
-  telemetry in driver.train)."""
+  Since round 13 this is a thin veneer over `telemetry.Histogram`
+  (which IS this design promoted to a registry citizen) — ONE
+  implementation of the bounded-window/nearest-rank/NaN-on-empty
+  contract, so the registry's numbers and this surface can never
+  drift. NaN on empty: 'no traffic yet' renders as '-' in
+  bench/telemetry rows instead of masquerading as a perfect 0 ms
+  latency."""
 
   def __init__(self, maxlen: int = 4096):
-    self._samples = collections.deque(maxlen=maxlen)
-    self._lock = threading.Lock()
-    self._count = 0
+    self._hist = telemetry.Histogram('latency_reservoir',
+                                     maxlen=maxlen)
 
   def record(self, seconds: float):
-    with self._lock:
-      self._samples.append(float(seconds))
-      self._count += 1
+    self._hist.observe(float(seconds))
 
   @property
   def count(self) -> int:
-    with self._lock:
-      return self._count
+    return self._hist.count
 
   def percentiles(self, *qs: float) -> Tuple[float, ...]:
-    """Sample percentiles over the retained window; 0.0 when empty
-    (callers treat 'no traffic yet' as a zero row, not an error)."""
-    with self._lock:
-      snap = sorted(self._samples)
-    if not snap:
-      return tuple(0.0 for _ in qs)
-    last = len(snap) - 1
-    return tuple(snap[min(last, int(round(q * last)))] for q in qs)
+    return self._hist.percentiles(*qs)
 
   def percentile_ms(self, *qs: float) -> Tuple[float, ...]:
     """`percentiles`, in rounded milliseconds — the stats()-surface
-    form every reservoir consumer (ingest ack, inference admission
-    wait) was hand-rolling with its own `round(x * 1e3, 3)`."""
+    form every reservoir consumer was hand-rolling with its own
+    `round(x * 1e3, 3)`."""
     return tuple(round(v * 1e3, 3) for v in self.percentiles(*qs))
 
 

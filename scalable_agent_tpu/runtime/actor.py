@@ -18,11 +18,13 @@ Faithfully preserved semantics:
   stats while the carried state resets to zero.
 """
 
+import threading
 import time
 from typing import Callable, Optional
 
 import numpy as np
 
+from scalable_agent_tpu import telemetry
 from scalable_agent_tpu.structs import (
     ActorOutput, AgentOutput, StepOutput, StepOutputInfo)
 
@@ -199,9 +201,25 @@ def run_actor_loop(actor: Actor, buffer, stop_event,
       raise exc
     on_failure(exc)
 
+  # Trace-span stamping (round 13, telemetry.py): when tracing is on
+  # in this process, each completed unroll gets a fresh trace context
+  # — actor id (the fleet's thread name), per-loop sequence, the
+  # behaviour params version — stamped HOP_DONE here at env-step
+  # completion and carried beside the unroll (identity-keyed sidecar;
+  # the pytree itself cannot grow a leaf without breaking the wire
+  # contract). Downstream hops stamp at ingest/staging/step; a remote
+  # pump pops the tag and ships it on the v8 wire.
+  actor_name = threading.current_thread().name
+  unroll_seq = 0
+
   try:
     while not stop_event.is_set():
       unroll = actor.unroll()
+      trace = telemetry.begin_unroll_trace(actor_name, unroll_seq)
+      if trace is not None:
+        telemetry.stamp(trace, telemetry.HOP_DONE)
+        telemetry.tag_unroll(unroll, trace)
+      unroll_seq += 1
       # Poll-put with a stop-aware grace (round 11): an actor parked
       # on a full buffer used to block UNBOUNDED — quiesce() (which
       # deliberately keeps the buffer open so in-flight unrolls land)

@@ -110,12 +110,12 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from scalable_agent_tpu.observability import (LatencyReservoir,
-                                              ThreadWatchdog)
+from scalable_agent_tpu.observability import ThreadWatchdog
 
 import numpy as np
 
 from scalable_agent_tpu import integrity
+from scalable_agent_tpu import telemetry
 from scalable_agent_tpu.runtime import faults as faults_lib
 from scalable_agent_tpu.runtime import ring_buffer
 
@@ -701,13 +701,33 @@ class Backoff:
 #   - 'hello_params' MAY carry the same client-info dict; the param
 #     lane then appends the cached trailer to its blob replies and
 #     verifies trailers on requests.
-PROTOCOL_VERSION = 7
+# v8 (round 13): per-unroll trace spans, v5/v6/v7-COMPATIBLE both
+# ways (the same negotiation pattern — everything turns OFF per
+# connection for older peers):
+#   - the server-info dict carries 'trace' (a server-wide fact: the
+#     learner runs a telemetry tracer); a v8 client seeing it stamps
+#     each unroll frame with a 5th element — the compact trace
+#     context (telemetry.make_trace: actor id, unroll seq, session
+#     epoch, behaviour params version, [hop, wall_time] stamps). Old
+#     servers never index it; old clients never send it.
+#   - the trace context MAY carry 'pi' = [version, wall_time], the
+#     client's most recent params-install event — how the
+#     publish→installed-at-actor hop reaches the learner's
+#     traces.jsonl without a dedicated side channel (the same
+#     piggyback pattern as the v7 digest_rejected notice).
+#   - 'stats' on the trajectory lane answers ('stats', {...}) — the
+#     on-demand fleet telemetry request: the learner's unified
+#     metrics-registry snapshot plus its ingest stats, served over
+#     the existing control lane so operators (and tests) can read the
+#     single source of truth remotely.
+PROTOCOL_VERSION = 8
 
 # Handshakes accepted without negotiation failure: v5 peers get the
 # round-9 wire exactly (no heartbeats, no busy keepalives, no epoch
 # checks), v6 peers the round-11 wire (no CRC trailers, no digest
-# checks); everything else about the lanes is unchanged.
-_COMPATIBLE_PROTOCOLS = (5, 6, 7)
+# checks), v7 peers the round-12 wire (no trace stamps); everything
+# else about the lanes is unchanged.
+_COMPATIBLE_PROTOCOLS = (5, 6, 7, 8)
 
 # Bound on the reader→worker handoff queue. The request→reply
 # lockstep already implies at most one in-flight unroll per live
@@ -1462,11 +1482,17 @@ class TrajectoryIngestServer:
                max_unroll_staleness: int = 0,
                heartbeat_secs: float = 0.0,
                idle_timeout_secs: float = 0.0,
-               wire_crc: bool = True):
+               wire_crc: bool = True,
+               trace: bool = True):
     if wire_dtype not in (None, '', 'bfloat16'):
       raise ValueError(f'unsupported wire_dtype {wire_dtype!r}')
     self._wire_bf16 = wire_dtype == 'bfloat16'
     self._wire_crc = bool(wire_crc)
+    # v8 trace spans (round 13; config.telemetry_trace): advertised as
+    # a server-wide fact in the hello reply's server-info — v8 clients
+    # then stamp each unroll frame with its trace context, which the
+    # reader/worker complete learner-side (telemetry.PipelineTracer).
+    self._trace = bool(trace)
     self._buffer = buffer
     self._contract = contract
     self._max_staleness = int(max_unroll_staleness)
@@ -1509,30 +1535,45 @@ class TrajectoryIngestServer:
     self._serializations = 0
     self._params_frame = self._make_blob(self._version, params)
     self._stats_lock = threading.Lock()
-    self._unrolls = 0
-    self._rejected = 0
-    self._stale_rejected = 0  # staleness-window admission rejections
-    self._quarantined = 0  # connections dropped for unparseable frames
+    # Round 13: the scattered per-module ints moved into the unified
+    # metrics registry (telemetry.Counter — each has its own lock;
+    # cross-counter atomicity was never relied on). stats() keeps its
+    # exact key surface by reading .value; the drain manifest, halt
+    # bundle, flight recorder, and the remote 'stats' request read the
+    # same objects through registry.snapshot().
+    self._unrolls = telemetry.counter('ingest/unrolls')
+    self._rejected = telemetry.counter('ingest/rejected')
+    self._stale_rejected = telemetry.counter('ingest/stale_rejected')
+    self._quarantined = telemetry.counter('ingest/quarantined')
     # Integrity ledger (round 12): unrolls refused because their v7
     # CRC trailer mismatched (verified before the put — the buffer
     # never saw them), and the discard accounting of thrown-away
     # partial/unparseable frames (the round-12 fix: the quarantine
     # path used to count the CONN but drop how much data died with
     # it).
-    self._wire_crc_rejected = 0
-    self._discarded_frames = 0
-    self._discarded_bytes = 0
+    self._wire_crc_rejected = telemetry.counter(
+        'ingest/wire_crc_rejected')
+    self._discarded_frames = telemetry.counter(
+        'ingest/discarded_frames')
+    self._discarded_bytes = telemetry.counter(
+        'ingest/discarded_bytes')
     self._connections = 0
     self._param_subscribers = 0  # cumulative hello_params adoptions
     # Liveness/restart counters (round 11).
-    self._conns_reaped = 0       # idle/half-open connections closed
-    self._heartbeat_misses = 0   # v6 conns silent past 2x heartbeat
-    self._stale_epoch_rejected = 0  # unrolls from a dead incarnation
+    self._conns_reaped = telemetry.counter('ingest/conns_reaped')
+    self._heartbeat_misses = telemetry.counter(
+        'ingest/heartbeat_misses')
+    self._stale_epoch_rejected = telemetry.counter(
+        'ingest/stale_epoch_rejected')
     self._reattached = 0         # hellos carrying a FOREIGN prior epoch
     self._reconnected = 0        # hellos carrying OUR epoch (same run)
     self._reattach_latency = 0.0  # last reattach: secs since start
     self._unjoined_threads = 0   # close()-time join-deadline misses
-    self._ack_reservoir = LatencyReservoir()
+    # Ack service-time percentiles read straight from the registry
+    # histogram (round 13: telemetry.Histogram IS the
+    # LatencyReservoir design promoted to a registry citizen — a
+    # second reservoir would be the same samples bookkept twice).
+    self._ack_hist = telemetry.histogram('ingest/ack_ms')
     self._closed = threading.Event()
     # Threads/conns are appended by the accept loop, pruned as peers
     # disconnect, snapshotted by close() — all under one lock (flapping
@@ -1628,6 +1669,9 @@ class TrajectoryIngestServer:
             'idle_timeout_secs': self._idle_timeout,
             'wire_crc': self._wire_crc,
             'crc_algo': integrity.CRC_ALGO,
+            # v8: a server-wide fact like wire_crc — a v8 client
+            # seeing it stamps trace contexts on its unroll frames.
+            'trace': self._trace,
             'params_digest': integrity.digest_record(digest)}
     kind = 'params_bf16' if self._wire_bf16 else 'params'
     segments = _oob_frame_segments((kind, version, params, info))
@@ -1668,32 +1712,32 @@ class TrajectoryIngestServer:
                         for c in self._conns if c.stale_rejected}
     lane = self._param_lane.stats()
     wedged = self._wedged_threads()
-    ack_p50_ms, ack_p99_ms = self._ack_reservoir.percentile_ms(
-        0.5, 0.99)
+    p50, p99 = self._ack_hist.percentiles(0.5, 0.99)
+    ack_p50_ms, ack_p99_ms = round(p50, 3), round(p99, 3)
     with self._stats_lock:
-      return {'unrolls': self._unrolls,
-              'rejected': self._rejected,
+      return {'unrolls': self._unrolls.value,
+              'rejected': self._rejected.value,
               # Staleness-window rejections (round 9): unrolls refused
               # because the client's params version fell behind the
               # admission window — benign for the client (it refetches
               # and keeps its connection), but a host whose EVERY
               # unroll is stale is starving; the per-conn map names it.
-              'stale_rejected': self._stale_rejected,
+              'stale_rejected': self._stale_rejected.value,
               'per_conn_stale_rejected': per_conn_stale,
               # Connections dropped after an unparseable/garbage frame
               # (protocol error path): the wire-level quarantine — a
               # corrupting peer loses its connection, the server and
               # every other connection keep going.
-              'quarantined': self._quarantined,
+              'quarantined': self._quarantined.value,
               # v7 payload integrity (round 12): unrolls refused for a
               # mismatched CRC trailer (verified before the put — the
               # buffer provably never saw them), the param-lane ledger
               # of digest-refused publishes, and the discard
               # accounting of thrown-away partial/unparseable frames.
-              'wire_crc_rejected': self._wire_crc_rejected,
+              'wire_crc_rejected': self._wire_crc_rejected.value,
               'publish_digest_rejected': lane['digest_rejected'],
-              'discarded_frames': self._discarded_frames,
-              'discarded_bytes': self._discarded_bytes,
+              'discarded_frames': self._discarded_frames.value,
+              'discarded_bytes': self._discarded_bytes.value,
               'connections': self._connections,  # cumulative
               'live': live,
               # Per-lane transport counters (round 6): the driver
@@ -1718,9 +1762,9 @@ class TrajectoryIngestServer:
               # storm), and the fleet re-attach ledger a restarted
               # learner reports (count + seconds from server start to
               # the latest cross-epoch hello).
-              'conns_reaped': self._conns_reaped,
-              'heartbeat_misses': self._heartbeat_misses,
-              'stale_epoch_rejected': self._stale_epoch_rejected,
+              'conns_reaped': self._conns_reaped.value,
+              'heartbeat_misses': self._heartbeat_misses.value,
+              'stale_epoch_rejected': self._stale_epoch_rejected.value,
               'reattached': self._reattached,
               'reconnected': self._reconnected,
               'reattach_latency_secs': round(self._reattach_latency, 3),
@@ -1774,15 +1818,13 @@ class TrajectoryIngestServer:
         if (conn.heartbeat and not conn.hb_missed
             and silent > 2 * self._heartbeat_secs):
           conn.hb_missed = True
-          with self._stats_lock:
-            self._heartbeat_misses += 1
+          self._heartbeat_misses.inc()
           log.warning('remote actor %s missed its heartbeat window '
                       '(silent %.1fs, cadence %.1fs)', conn.addr,
                       silent, self._heartbeat_secs)
         if silent > idle_window and not conn.reaped:
           conn.reaped = True
-          with self._stats_lock:
-            self._conns_reaped += 1
+          self._conns_reaped.inc()
           log.warning('reaping idle/half-open connection %s (silent '
                       '%.1fs > %.1fs window)', conn.addr, silent,
                       self._idle_timeout)
@@ -1819,7 +1861,8 @@ class TrajectoryIngestServer:
         continue
       if job is None:
         return
-      conn, unroll, t_recv, client_version, client_epoch, crc_pair = job
+      (conn, unroll, t_recv, client_version, client_epoch, crc_pair,
+       trace) = job
       try:
         if crc_pair is not None and crc_pair[0] != crc_pair[1]:
           # v7 payload integrity: the frame's bytes are not the bytes
@@ -1829,8 +1872,7 @@ class TrajectoryIngestServer:
           # benign ('corrupt', computed) reply keeps the connection:
           # the client re-sends once, then quarantines itself.
           computed, wire = crc_pair
-          with self._stats_lock:
-            self._wire_crc_rejected += 1
+          self._wire_crc_rejected.inc()
           conn.crc_rejected += 1
           log.warning(
               'unroll from %s failed its CRC trailer (computed '
@@ -1846,8 +1888,7 @@ class TrajectoryIngestServer:
           # that zero stale-epoch unrolls crossed a restart, and the
           # guard that keeps that true if a proxy/load-balancer ever
           # sits in front of the port.
-          with self._stats_lock:
-            self._stale_epoch_rejected += 1
+          self._stale_epoch_rejected.inc()
           conn.send(('stale_epoch', self.session_epoch))
           continue
         if self._max_staleness and client_version is not None:
@@ -1859,8 +1900,7 @@ class TrajectoryIngestServer:
             # the 'stale' reply carries the current version, so the
             # client's refetch-on-newer-version path fires and the
             # next unroll arrives fresh.
-            with self._stats_lock:
-              self._stale_rejected += 1
+            self._stale_rejected.inc()
             conn.stale_rejected += 1
             conn.send(('stale', current))
             continue
@@ -1870,11 +1910,35 @@ class TrajectoryIngestServer:
             # Reject WITHOUT touching the buffer (a malformed unroll
             # must not poison training) but keep the connection: the
             # actor decides whether this is fatal.
-            with self._stats_lock:
-              self._rejected += 1
+            self._rejected.inc()
             conn.send(('error', 'unroll rejected: '
                        + '; '.join(problems)))
             continue
+        # Trace span (round 13, v8): this unroll passed every check —
+        # stamp COMMIT (admitted; the buffer put below may still wait
+        # on backpressure, which the commit→staged hop then shows as
+        # queue time) and tag the unroll BEFORE the put so the
+        # prefetcher can never consume it ahead of its tag. The
+        # piggybacked params-install notice ('pi') becomes its own
+        # trace record here — the publish→installed-at-actor hop.
+        tracer = telemetry.get_tracer()
+        if trace is not None and tracer is not None:
+          telemetry.stamp(trace, telemetry.HOP_COMMIT)
+          # Commit-time publish counter in the INGEST clock ('cv'):
+          # policy lag for this unroll is cv - bv, a publish-count
+          # delta judged within the clock its behaviour version was
+          # stamped in (the tracer's local clock counts driver
+          # publishes — a different sequence).
+          with self._params_lock:
+            trace['cv'] = self._version
+          install = trace.pop('pi', None)
+          if install is not None:
+            try:
+              tracer.on_install(trace.get('a', conn.addr),
+                                install[0], install[1])
+            except (TypeError, IndexError):
+              pass  # malformed notice from a buggy peer: drop it
+          tracer.tag(unroll, trace)
         # Blocking put IS the backpressure: the delayed ack holds the
         # remote pump exactly like the reference's remote enqueue
         # into the capacity-1 queue. Poll so close() can interrupt.
@@ -1891,13 +1955,12 @@ class TrajectoryIngestServer:
             if self._closed.is_set():
               return
             self._watchdog.beat(name)
-        with self._stats_lock:
-          self._unrolls += 1
+        self._unrolls.inc()
         conn.unrolls += 1
         with self._params_lock:
           version = self._version
         conn.send(('ack', version))
-        self._ack_reservoir.record(time.monotonic() - t_recv)
+        self._ack_hist.observe((time.monotonic() - t_recv) * 1e3)
       except ring_buffer.Closed:
         return  # learner shut down; readers see their conns drop
       except (ConnectionError, OSError):
@@ -2091,11 +2154,30 @@ class TrajectoryIngestServer:
           # WORKER compares just before the put, so a corrupt frame
           # earns its benign reply without ever touching the buffer.
           conn.job_started()
+          # msg[4] (v8) is the unroll's trace context: stamp WIRE here
+          # (frame fully received) — the worker stamps COMMIT and the
+          # rest of the pipeline completes the span.
+          trace = msg[4] if len(msg) > 4 else None
+          if isinstance(trace, dict):
+            telemetry.stamp(trace, telemetry.HOP_WIRE)
+          else:
+            trace = None
           self._ingest_q.put((conn, msg[1], time.monotonic(),
                               msg[2] if len(msg) > 2 else None,
                               msg[3] if len(msg) > 3 else None,
                               (crc_ctx.computed, crc_ctx.wire)
-                              if crc_ctx is not None else None))
+                              if crc_ctx is not None else None,
+                              trace))
+        elif kind == 'stats':
+          # On-demand fleet telemetry (round 13): the unified
+          # metrics-registry snapshot + this server's ingest stats,
+          # served over the existing control lane — operators, tests,
+          # and fleet tooling read the SAME source of truth the drain
+          # manifest and flight recorder use, remotely.
+          conn.send(('stats', {
+              'registry': telemetry.registry().snapshot(),
+              'ingest': self.stats(),
+          }))
         else:
           conn.send(('error', f'unknown message kind {kind!r}'))
       # Loop-condition exit on a closing server: same contract as
@@ -2115,10 +2197,9 @@ class TrajectoryIngestServer:
       # buffer cannot be corrupted by it; it is simply discarded with
       # the connection.
       conn.reaped = True
-      with self._stats_lock:
-        self._conns_reaped += 1
-        self._discarded_frames += 1
-        self._discarded_bytes += liveness.frame_bytes
+      self._conns_reaped.inc()
+      self._discarded_frames.inc()
+      self._discarded_bytes.inc(liveness.frame_bytes)
       log.warning('reaping half-open connection %s: %s (partial '
                   'frame discarded: %d byte(s))', addr, e,
                   liveness.frame_bytes)
@@ -2134,10 +2215,9 @@ class TrajectoryIngestServer:
       # counted but the thrown-away data never was — an operator
       # could not tell a dropped 40-byte hello from a dropped 2 MB
       # unroll burst).
-      with self._stats_lock:
-        self._quarantined += 1
-        self._discarded_frames += 1
-        self._discarded_bytes += liveness.frame_bytes
+      self._quarantined.inc()
+      self._discarded_frames.inc()
+      self._discarded_bytes.inc(liveness.frame_bytes)
       log.warning(
           'protocol/frame error from %s — connection quarantined '
           '(version-skewed peer? this learner speaks v%d; %d byte(s) '
@@ -2306,6 +2386,14 @@ class RemoteActorClient:
     self.crc_rejected = 0
     self.digest_rejected = 0
     self._digest_nack: Optional[int] = None  # rides the retry fetch
+    # v8 trace spans: `trace_ok` flips on when the handshake reply's
+    # server-info advertises a tracing learner — unroll frames then
+    # carry their trace context as a 5th element, and the most recent
+    # params-install event piggybacks on the next one ('pi' notice —
+    # the publish→installed-at-actor hop, same pattern as the digest
+    # nack).
+    self.trace_ok = False
+    self._pending_install: Optional[List] = None
     deadline = time.monotonic() + connect_timeout_secs
     last_err = None
     # Capped exponential backoff + full jitter: after a learner
@@ -2415,7 +2503,8 @@ class RemoteActorClient:
       raise RuntimeError(f'learner rejected request: {reply[1]}')
     return reply
 
-  def _decode_params(self, reply, negotiate: bool = False
+  def _decode_params(self, reply, negotiate: bool = False,
+                     offered_protocol: Optional[int] = None
                      ) -> Tuple[int, object]:
     """(version, tree) from a params reply; 'params_bf16' blobs
     (learner running remote_params_dtype=bfloat16) upcast back to
@@ -2447,6 +2536,15 @@ class RemoteActorClient:
                      and bool(self.server_info.get('wire_crc'))
                      and self.server_info.get('crc_algo') ==
                      integrity.CRC_ALGO)
+      if offered_protocol is not None:
+        # v8: stamp traces only when BOTH sides speak v8 — keyed on
+        # the protocol this client OFFERED (like the CRC negotiation:
+        # a forged older contract must land the same negotiation on
+        # both sides) AND the server's advertised tracing fact.
+        self.trace_ok = (int(offered_protocol) >= 8
+                         and int(self.server_info.get('protocol')
+                                 or 0) >= 8
+                         and bool(self.server_info.get('trace')))
       record = self.server_info.get('params_digest')
       if record is not None:
         verdict = integrity.verify_record(
@@ -2507,7 +2605,11 @@ class RemoteActorClient:
     msg = ('hello', contract, info) if info else ('hello', contract)
     if not offer_crc:
       self._crc = False
-    return self._decode_params(self._rpc(msg), negotiate=offer_crc)
+    self.trace_ok = False  # re-negotiated per handshake below
+    return self._decode_params(
+        self._rpc(msg), negotiate=offer_crc,
+        offered_protocol=(int(offered_protocol)
+                          if offered_protocol is not None else None))
 
   def ping(self) -> int:
     """Application-level heartbeat on the trajectory lane (v6): keeps
@@ -2621,8 +2723,18 @@ class RemoteActorClient:
         pass
       self._param_sock = None
 
+  def note_install(self, version: int):
+    """Record a params install (update_params completed actor-side);
+    the event piggybacks on the NEXT traced unroll frame ('pi'
+    notice) so the learner's traces.jsonl carries the
+    publish→installed-at-actor hop without a dedicated side channel.
+    Only the latest install is kept — the hop of interest is the
+    freshest version's propagation."""
+    self._pending_install = [int(version), round(time.time(), 6)]
+
   def send_unroll(self, unroll,
-                  params_version: Optional[int] = None) -> int:
+                  params_version: Optional[int] = None,
+                  trace: Optional[Dict] = None) -> int:
     """Ship one ActorOutput; returns the learner's params version.
     Uses the out-of-band frame: the unroll's frame stacks ARE the
     message, so they go raw instead of through the pickler.
@@ -2638,8 +2750,24 @@ class RemoteActorClient:
     stamps the frame too (4th element, ignored by old servers): a
     learner incarnation this unroll does not belong to refuses it
     with 'stale_epoch' → SessionEpochMismatch (ConnectionError — the
-    reconnect/re-handshake path is the response)."""
-    if self.session_epoch is not None:
+    reconnect/re-handshake path is the response).
+
+    `trace` (v8, when tracing negotiated): the unroll's trace context
+    — stamped HOP_SEND here and shipped as the 5th frame element so
+    the learner completes the span. A pending params-install notice
+    rides it ('pi'); on a refusal/resend the SAME context ships again
+    (the duplicate hop stamps tell the report a resend happened)."""
+    if trace is not None and self.trace_ok:
+      telemetry.stamp(trace, telemetry.HOP_SEND)
+      if self._pending_install is not None:
+        trace['pi'] = self._pending_install
+        self._pending_install = None
+      msg = ('unroll', unroll,
+             None if params_version is None else int(params_version),
+             None if self.session_epoch is None
+             else int(self.session_epoch),
+             trace)
+    elif self.session_epoch is not None:
       msg = ('unroll', unroll,
              None if params_version is None else int(params_version),
              int(self.session_epoch))
@@ -2650,6 +2778,17 @@ class RemoteActorClient:
     reply = self._rpc(msg, oob=True)
     if reply[0] == 'stale':
       self.stale_rejections += 1
+    return reply[1]
+
+  def fetch_stats(self) -> Dict:
+    """The learner's on-demand telemetry snapshot (v8 'stats' request
+    on the trajectory lane): {'registry': <unified metrics-registry
+    snapshot>, 'ingest': <ingest server stats>}. Raises like any rpc
+    against a dead/old learner (old servers answer 'error' → the
+    RuntimeError path)."""
+    reply = self._rpc(('stats',))
+    if reply[0] != 'stats':
+      raise ProtocolError(f'expected stats, got {reply[0]!r}')
     return reply[1]
 
   def close(self):
@@ -2778,6 +2917,16 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
     server.warmup(spec0.obs_spec, max_size=config.num_actors)
     buffer = ring_buffer.TrajectoryBuffer(
         max(2 * config.num_actors, 2))
+    # Trace-span stamping (round 13, v8): this host stamps HOP_DONE on
+    # each completed unroll with the behaviour params version it acted
+    # with (`version` is the pump's live binding — reads see every
+    # refresh); the pump ships the context on the wire and the learner
+    # completes the span. Negotiated: against a non-tracing/older
+    # learner the pump pops the tags and drops them.
+    if getattr(config, 'telemetry_trace', True):
+      telemetry.configure_actor_tracing(version_fn=lambda: version,
+                                        epoch=known_epoch)
+    client.note_install(version)  # the handshake install IS the first
     fleet = driver_lib.make_fleet(
         config, agent, server.policy, buffer, levels,
         seed_base=seed_base, level_offset=task * config.num_actors,
@@ -2836,6 +2985,12 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
         heartbeat_secs = float(
             new_client.server_info.get('heartbeat_secs') or 0.0)
         server.update_params(new_params)
+        new_client.note_install(v)
+        if getattr(config, 'telemetry_trace', True):
+          # Fresh epoch on every (re)handshake: spans must name the
+          # learner incarnation their unrolls actually fed.
+          telemetry.configure_actor_tracing(
+              version_fn=lambda: version, epoch=known_epoch)
         log.info('remote actor task=%d reconnected, params v%d',
                  task, version)
         return True
@@ -2881,12 +3036,16 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
           continue
         version, params = v, p
         server.update_params(params, version=version)
+        # The install event (the publish→installed-at-actor hop)
+        # piggybacks on the next traced unroll frame.
+        client.note_install(version)
         log.info('remote actor task=%d refreshed params to v%d',
                  task, version)
         return
 
     try:
       unroll = None  # a drop mid-send must not lose the unroll
+      unroll_trace = None  # its trace context rides every (re)send
       corrupt_resent = False  # current unroll already re-sent once?
       last_io = time.monotonic()
       while (stop_after_unrolls is None or
@@ -2899,6 +3058,7 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
             get_timeout = (min(10.0, heartbeat_secs)
                            if heartbeat_secs > 0 else 10.0)
             unroll = buffer.get(timeout=get_timeout)
+            unroll_trace = telemetry.pop_unroll(unroll)
           except TimeoutError:
             fleet.check_health(stall_timeout_secs=300.0)
             errors = fleet.errors()
@@ -2925,7 +3085,8 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
           # still returns the newer version, so the refetch below
           # fires and the NEXT unroll ships fresh.
           ack_version = client.send_unroll(unroll,
-                                           params_version=version)
+                                           params_version=version,
+                                           trace=unroll_trace)
         except UnrollCorrupt as e:
           # The learner's CRC refused our frame. Once is wire noise:
           # re-send the SAME unroll (at-least-once, like any lost
@@ -2959,6 +3120,7 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
           break
         last_io = time.monotonic()
         unroll = None
+        unroll_trace = None
         unrolls_sent += 1
         if ack_version > version:
           try:
@@ -2979,6 +3141,7 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
     except ring_buffer.Closed:
       log.info('local buffer closed; remote actor exiting')
     finally:
+      telemetry.clear_actor_tracing()
       fleet.stop()
       server.close()
   finally:

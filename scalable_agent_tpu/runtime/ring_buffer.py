@@ -49,6 +49,7 @@ import time
 from typing import Callable, List, Optional, Tuple
 
 from scalable_agent_tpu import integrity
+from scalable_agent_tpu import telemetry
 from scalable_agent_tpu.runtime.actor import batch_unrolls
 from scalable_agent_tpu.structs import ActorOutput
 
@@ -106,6 +107,25 @@ class ReplayTier:
     self._staleness_sum = 0
     self._staleness_samples = 0
     self._last_sample = (0, 0)  # (count, staleness_sum) — unsample_last
+    # Unified-registry view (round 13): lazy gauges over the counters
+    # above — the module-local bookkeeping stays authoritative (and
+    # lock-guarded for mutation); the registry reads it. Lock-free
+    # reads of ints are torn-read-benign. Handles kept so the owning
+    # buffer's close() can unregister them (fn-gauges close over
+    # `self` — an unregistered gauge is what lets a finished run's
+    # tier be collected).
+    self._gauges = [
+        telemetry.gauge('replay/occupancy',
+                        fn=lambda: len(self._entries)),
+        telemetry.gauge('replay/evictions_age',
+                        fn=lambda: self.evictions_age),
+        telemetry.gauge('replay/evictions_version',
+                        fn=lambda: self.evictions_version),
+        telemetry.gauge('replay/evictions_crc',
+                        fn=lambda: self.evictions_crc),
+        telemetry.gauge('replay/reused_unrolls',
+                        fn=lambda: self.reused_unrolls),
+    ]
 
   def note_param_version(self, version: int):
     """Advance the current published param version (driver publish
@@ -275,6 +295,24 @@ class TrajectoryBuffer:
     # budgets and the learner_updates_per_env_frame denominator read
     # the prefetcher's serve-time fresh_slots_served instead.
     self._fresh_unrolls = 0
+    # Unified-registry view (round 13): same pattern as the replay
+    # tier — lazy gauges over this instance's occupancy/backpressure
+    # counters, so the drain manifest / flight recorder / fleet stats
+    # request read them without a stats() plumbing path. close()
+    # unregisters them (identity-checked, so a newer buffer's
+    # registration survives an older one's teardown).
+    self._gauges = [
+        telemetry.gauge('buffer/occupancy',
+                        fn=lambda: len(self._deque)),
+        telemetry.gauge('buffer/high_water',
+                        fn=lambda: self._high_water),
+        telemetry.gauge('buffer/put_waits',
+                        fn=lambda: self._put_waits),
+        telemetry.gauge('buffer/fresh_unrolls',
+                        fn=lambda: self._fresh_unrolls),
+    ]
+    if replay is not None:
+      self._gauges += replay._gauges
 
   @property
   def replay(self) -> Optional[ReplayTier]:
@@ -408,6 +446,13 @@ class TrajectoryBuffer:
       self._closed = True
       self._not_full.notify_all()
       self._not_empty.notify_all()
+    # Release the registry's hold on this instance (and its replay
+    # tier): the fn-gauges close over self, and a closed buffer must
+    # be collectable, not pinned by telemetry for the process
+    # lifetime. Identity-checked — a newer incarnation's registration
+    # under the same names is left alone.
+    for gauge in self._gauges:
+      telemetry.registry().unregister(gauge.name, gauge)
 
   def stats(self):
     """Occupancy/backpressure counters (driver summary surface):
@@ -719,6 +764,16 @@ class BatchPrefetcher:
     self._gets = 0
     self._blocked_gets = 0
     self._wait_secs = 0.0
+    # Unified-registry view (round 13); unregistered by close().
+    self._gauges = [
+        telemetry.gauge('staging/staged_batches',
+                        fn=lambda: self._staged),
+        telemetry.gauge('staging/blocked_gets',
+                        fn=lambda: self._blocked_gets),
+        telemetry.gauge('staging/serves', fn=lambda: self._serves),
+        telemetry.gauge('staging/fresh_slots_served',
+                        fn=lambda: self._fresh_served),
+    ]
     self._thread = threading.Thread(target=self._loop,
                                     name='batch-prefetcher', daemon=True)
     self._thread.start()
@@ -732,8 +787,15 @@ class BatchPrefetcher:
     Both modes compose fresh:replayed slots through the buffer's
     replay tier (fresh first); replayed unrolls skip the host stats
     peel."""
+    tracer = telemetry.get_tracer()
     if self._stager is None:
       items, n_fresh = self._buffer.get_unrolls(self._batch_size)
+      if tracer is not None:
+        # Trace hop (round 13): this batch's fresh unrolls were
+        # picked for staging — completes each sidecar span's STAGED
+        # stamp and opens the batch's entry in the tracer's FIFO
+        # (serve/step stamps follow in this same FIFO order).
+        tracer.on_batch(items, n_fresh)
       batch = batch_unrolls(items)
       if self._fresh_aware:
         return self._place_fn(batch, n_fresh), n_fresh
@@ -744,10 +806,15 @@ class BatchPrefetcher:
     # slots (available instantly) fill the tail of the batch.
     replayed = self._buffer.sample_replay(self._batch_size)
     n_fresh = self._batch_size - len(replayed)
+    fresh_items = []
     for _ in range(n_fresh):
-      self._stager.add(self._buffer.get())
+      unroll = self._buffer.get()
+      fresh_items.append(unroll)
+      self._stager.add(unroll)
     for unroll in replayed:
       self._stager.add(unroll, peel_view=False)
+    if tracer is not None:
+      tracer.on_batch(fresh_items + replayed, n_fresh)
     return self._stager.finish(), n_fresh
 
   def _loop(self):
@@ -815,6 +882,11 @@ class BatchPrefetcher:
       self._serves += 1
       if first_serve:
         self._fresh_served += entry[2]
+        tracer = telemetry.get_tracer()
+        if tracer is not None:
+          # First serve = the learner picked this staged batch up
+          # (re-serves ride the same arena; no new pipeline traversal).
+          tracer.on_serve()
       if not first_serve:
         self._reserves += 1
         if self._reserve_fn is not None:
@@ -875,6 +947,9 @@ class BatchPrefetcher:
     self._thread.join(timeout=5)
     # Release staged device batches (and, via the loop thread's abort,
     # any partial arena): a closed prefetcher must not pin batch-sized
-    # HBM buffers for the rest of the process lifetime.
+    # HBM buffers for the rest of the process lifetime — and neither
+    # may the registry pin the prefetcher itself via its fn-gauges.
     with self._lock:
       self._out.clear()
+    for gauge in self._gauges:
+      telemetry.registry().unregister(gauge.name, gauge)

@@ -830,6 +830,37 @@ def run_partition_storm(logdir: str, smoke: bool = SMOKE,
     if tag not in tags:
       errors.append(f'summary tag {tag!r} missing')
 
+  # Trace-plane view of the storm (round 13): the learner children
+  # ran with tracing on (default), so traces.jsonl spans BOTH
+  # incarnations — the report's timeline shows the kill -9 window as
+  # the batch gap it caused, with the incident markers interleaved.
+  # Soft telemetry (recorded, not a hard SLO): both learner
+  # incarnations must have produced spans with the full remote hop
+  # chain, or the telemetry plane regressed under faults.
+  try:
+    sys.path.insert(0, REPO)
+    from scripts import trace_report
+    trace_summary = trace_report.summarize(
+        trace_report.load_traces(logdir),
+        trace_report.load_incidents(logdir))
+    results['trace'] = {
+        'batches': trace_summary['batches'],
+        'unrolls': trace_summary['unrolls'],
+        'hops': [row['hop'] for row in trace_summary['hops']],
+        'policy_lag_p99': trace_summary['policy_lag']['p99'],
+        'timeline_seconds': len(trace_summary['timeline']),
+    }
+    if trace_summary['batches'] == 0:
+      errors.append('telemetry: zero trace batch records across the '
+                    'partition storm (tracing is default-on)')
+    hop_set = set(results['trace']['hops'])
+    for hop in ('send->wire', 'wire->commit', 'serve->step'):
+      if hop not in hop_set:
+        errors.append(f'telemetry: remote hop {hop!r} missing from '
+                      'the storm trace — spans not crossing the wire')
+  except Exception as e:  # pragma: no cover - diagnostics only
+    errors.append(f'trace report over the storm logdir failed: {e!r}')
+
   results['wall_secs'] = round(time.monotonic() - t0, 2)
   return results, errors
 

@@ -70,6 +70,61 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_remote.py \
 'fingerprint or discard or integrity' \
   -p no:cacheprovider
 
+echo '== telemetry metric-name lint (every counter/gauge/histogram'
+echo '   registered in scalable_agent_tpu/ must appear in the'
+echo '   docs/OBSERVABILITY.md inventory, and no documented name may'
+echo '   be orphaned — greppable-literal registration is the contract'
+echo '   that makes this a static check) =='
+python - <<'LINT_EOF'
+import pathlib
+import re
+import sys
+
+root = pathlib.Path('scalable_agent_tpu')
+# Every registration uses the literal-string module helpers
+# (telemetry.counter('x/y') / gauge / histogram — telemetry.py itself
+# calls them bare, integrity.py as _telemetry.*): the lint greps that
+# spelling, which is why non-literal names are forbidden.
+# A dot-prefixed call that is NOT telemetry.* (writer.histogram of
+# the summary stream) is a different API — the lookbehind excludes
+# it; placeholder examples in docstrings use <angle brackets>, which
+# the name filter drops.
+pat = re.compile(
+    r"(?:\btelemetry\.|\b_telemetry\.|(?<![\w.]))"
+    r"(?:counter|gauge|histogram)\(\s*'([^']+)'")
+registered = set()
+for path in sorted(root.rglob('*.py')):
+    for m in pat.finditer(path.read_text()):
+        if re.fullmatch(r'[a-z0-9_]+(?:/[a-z0-9_]+)+', m.group(1)):
+            registered.add(m.group(1))
+doc = pathlib.Path('docs/OBSERVABILITY.md').read_text()
+documented = set(re.findall(r'`([a-z0-9_]+(?:/[a-z0-9_]+)+)`', doc))
+undocumented = sorted(registered - documented)
+orphaned = sorted(documented - registered)
+if undocumented:
+    print('UNDOCUMENTED metric names (add to docs/OBSERVABILITY.md '
+          'inventory):')
+    for n in undocumented:
+        print(f'  {n}')
+if orphaned:
+    print('ORPHANED documented names (no longer registered in '
+          'scalable_agent_tpu/):')
+    for n in orphaned:
+        print(f'  {n}')
+if undocumented or orphaned:
+    sys.exit(1)
+print(f'metric-name lint OK: {len(registered)} registered names all '
+      'documented, none orphaned')
+LINT_EOF
+
+echo '== telemetry smoke (trace spans end to end: registry semantics,'
+echo '   tracer pipeline, v8 negotiation + remote stamping,'
+echo '   trace_report reconstruction; then the tiny tracing-on/off'
+echo '   overhead rows via BENCH_ONLY=telemetry — <60 s CPU) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py \
+  tests/test_observability.py -q -p no:cacheprovider
+BENCH_SMOKE=1 BENCH_ONLY=telemetry python bench.py
+
 echo '== inference-plane smoke (state-cache golden parity + slot'
 echo '   lifecycle selector, then the tiny cache×depth bench rows'
 echo '   via BENCH_ONLY=inference_plane — <60 s CPU) =='

@@ -440,12 +440,52 @@ def main():
     integrity_final['publish_digest_rejected'] = max(
         integrity_final['publish_digest_rejected'],
         ing.get('publish_digest_rejected', 0))
+  # Round 13: the unified metrics registry is the SAME source of
+  # truth the drain manifest / flight recorder / fleet 'stats' read —
+  # cross-check the summaries-derived integrity counters against the
+  # registrations that OUTLIVE the run (ingest Counters, the health
+  # monitor's gauges; a disagreement means a reporting path rotted,
+  # itself a soak finding). checkpoint/* gauges are deliberately
+  # absent here: Checkpointer.close() unregisters them inside
+  # driver.train's finally, and the direct
+  # run.checkpointer.digest_fallbacks read above already covers that
+  # counter.
+  from scalable_agent_tpu import telemetry
+  registry_snap = telemetry.registry().snapshot()
+  registry_integrity = {
+      'wire_crc_rejected': registry_snap.get('ingest/wire_crc_rejected'),
+      'sdc_replica_mismatches': registry_snap.get(
+          'health/sdc_mismatches'),
+  }
+  for name, reg_value in registry_integrity.items():
+    if reg_value is None:
+      continue
+    integrity_final[name] = max(integrity_final[name], int(reg_value))
   for name, value in sorted(integrity_final.items()):
     if value:
       problems.append(
           f'integrity violation over the soak window: {name}={value} '
           '(expected 0 on clean hardware — suspect this host\'s '
           'NIC/RAM/disk; docs/RUNBOOK.md §9)')
+  # Telemetry-plane liveness (round 13): with tracing on (default),
+  # the soak window must have produced a parseable trace stream with
+  # span coverage — a silent tracer over a long run is a telemetry
+  # regression, not a shrug.
+  telemetry_block = {'registry_names': len(registry_snap)}
+  if cfg.telemetry_trace:
+    sys.path.insert(0, REPO)
+    from scripts import trace_report
+    trace_summary = trace_report.summarize(
+        trace_report.load_traces(logdir))
+    telemetry_block.update({
+        'trace_batches': trace_summary['batches'],
+        'trace_unrolls': trace_summary['unrolls'],
+        'policy_lag_p99': trace_summary['policy_lag']['p99'],
+        'e2e_ms_p99': trace_summary['e2e_ms']['p99'],
+    })
+    if trace_summary['batches'] == 0:
+      problems.append('telemetry_trace on but traces.jsonl carries '
+                      'zero batch records over the soak window')
   if steps < (20 if not smoke else 2):
     problems.append(f'only {steps} learner steps in {seconds:.0f}s')
   if not losses or not np.all(np.isfinite(losses)):
@@ -550,6 +590,7 @@ def main():
                               round(float(max(sigmas_max)), 5)]
                              if sigmas_max else None),
       'integrity': integrity_final,
+      'telemetry': telemetry_block,
       'churn': churn_artifact,
       'stack': {
           'torso': cfg.torso, 'compute_dtype': cfg.compute_dtype,

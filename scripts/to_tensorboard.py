@@ -61,9 +61,54 @@ def _histogram_raw_args(event):
               bucket_limits=list(edges[1:]), bucket_counts=list(counts))
 
 
+def _trace_report_module():
+  """scripts/trace_report — the one owner of the hop-order/delta
+  algorithm (its `span_hop_deltas`). Script-run resolution: when this
+  file runs as `python scripts/to_tensorboard.py`, sys.path[0] is
+  scripts/ itself, so `trace_report` imports flat; as a package
+  member (`from scripts import to_tensorboard`, the tests) the
+  relative-package spelling resolves."""
+  try:
+    from scripts import trace_report
+  except ImportError:
+    import trace_report
+  return trace_report
+
+
+def _trace_events(event):
+  """One traces.jsonl 'batch' record → [(tag, value, step)] scalars:
+  the per-batch policy-lag mean/max (the V-trace staleness curve an
+  operator actually watches) and the mean per-hop latency across the
+  batch's spans (round 13 — the trace stream's TensorBoard view;
+  hop deltas computed by trace_report.span_hop_deltas so the two
+  views can never disagree)."""
+  if event.get('k') != 'batch':
+    return []
+  span_hop_deltas = _trace_report_module().span_hop_deltas
+  step = int(event.get('step', 0))
+  rows = []
+  lags = event.get('lag') or []
+  if lags:
+    rows.append(('trace/policy_lag_mean', sum(lags) / len(lags), step))
+    rows.append(('trace/policy_lag_max', float(max(lags)), step))
+  deltas = {}
+  for span in event.get('spans') or []:
+    span_deltas, e2e = span_hop_deltas(span)
+    for (n0, n1), ms in span_deltas:
+      deltas.setdefault(f'trace/hop_{n0}_{n1}_ms', []).append(ms)
+    if e2e is not None:
+      deltas.setdefault('trace/e2e_ms', []).append(e2e)
+  for tag, values in deltas.items():
+    rows.append((tag, sum(values) / len(values), step))
+  return rows
+
+
 def convert(logdir, out=None):
-  """Convert every summary stream under `logdir`; returns
-  {run_name: events_written}."""
+  """Convert every summary AND trace stream under `logdir`; returns
+  {run_name: events_written}. Trace streams (traces.jsonl, round 13)
+  become a `trace`/`trace_pN` run of hop-latency and policy-lag
+  scalars so TensorBoard operators keep their view of the new
+  telemetry plane."""
   try:
     from torch.utils.tensorboard import SummaryWriter
   except ImportError as e:
@@ -74,9 +119,36 @@ def convert(logdir, out=None):
 
   out = out or os.path.join(logdir, 'tb')
   streams = sorted(glob.glob(os.path.join(logdir, '*summaries*.jsonl')))
-  if not streams:
-    raise FileNotFoundError(f'no *summaries*.jsonl under {logdir!r}')
+  trace_streams = sorted(glob.glob(os.path.join(logdir,
+                                                'traces*.jsonl')))
+  if not streams and not trace_streams:
+    raise FileNotFoundError(
+        f'no *summaries*.jsonl or traces*.jsonl under {logdir!r}')
   written = {}
+  for path in trace_streams:
+    base = os.path.basename(path)
+    run = ('trace' if base == 'traces.jsonl'
+           else 'trace_' + base[len('traces_'):].removesuffix('.jsonl'))
+    run_dir = os.path.join(out, run)
+    if os.path.isdir(run_dir):
+      shutil.rmtree(run_dir)
+    writer = SummaryWriter(run_dir)
+    n = 0
+    with open(path) as f:
+      for line in f:
+        line = line.strip()
+        if not line:
+          continue
+        try:
+          event = json.loads(line)
+        except json.JSONDecodeError:
+          continue
+        for tag, value, step in _trace_events(event):
+          writer.add_scalar(tag, value, global_step=step,
+                            walltime=event.get('t'))
+          n += 1
+    writer.close()
+    written[run] = n
   for path in streams:
     run = _run_name(path)
     run_dir = os.path.join(out, run)

@@ -178,3 +178,20 @@ def test_fed_learner_smoke_via_fleet_factory(tmp_path):
 
   run = driver.train(cfg, max_steps=3, fleet_factory=fleet_factory)
   assert run.frames == 3 * cfg.frames_per_step
+
+
+def test_telemetry_bench_smoke():
+  """The round-13 stage: registry/span micro rows + the tracing
+  on/off feed pair that carries the always-on accept call
+  (docs/PERF.md r11)."""
+  results = bench.bench_telemetry(smoke=True)
+  assert results['registry_ns_per_op'] > 0
+  assert results['span_ns'] > 0
+  assert results['feed_trace_off']['unrolls_per_sec'] > 0
+  on = results['feed_trace_on']
+  assert on['unrolls_per_sec'] > 0
+  # The traced run actually traced: batch records were emitted and
+  # every produced unroll carried its span.
+  assert on['tracer']['batches'] > 0
+  assert on['tracer']['untagged_unrolls'] == 0
+  assert results['overhead_fraction'] is not None

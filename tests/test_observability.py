@@ -150,3 +150,102 @@ def test_thread_watchdog_names_wedged_threads():
   dog.unregister('worker-0')
   assert dog.wedged(0.05) == []
   assert dog.names() == ['reader-a']
+
+
+# --------------------------------------------------------------------
+# Round-13 satellites: appender crash-safety, fsync'd incidents,
+# NaN-on-empty reservoir, FpsMeter pruning under bursts, stacked
+# metrics round-trip with registry-backed names.
+# --------------------------------------------------------------------
+
+
+def test_writer_after_close_is_silent_drop_counted(tmp_path):
+  writer = obs.SummaryWriter(str(tmp_path))
+  writer.scalar('a', 1.0, step=1)
+  writer.close()
+  writer.close()  # idempotent
+  # The old behavior: ValueError from the closed file in whatever
+  # thread lost the race. Now: silent drop + counter.
+  writer.scalar('a', 2.0, step=2)
+  writer.scalars({'b': 3.0}, step=2)
+  assert writer.dropped_writes == 2
+  with open(writer.path) as f:
+    lines = [json.loads(line) for line in f if line.strip()]
+  assert len(lines) == 1 and lines[0]['step'] == 1
+
+
+def test_event_log_durable_kinds_fsync_and_survive(tmp_path):
+  log = obs.EventLog(str(tmp_path))
+  log.event('rollback', step=3, reason='x')
+  log.event('health_halt', step=4)
+  log.event('sdc_replica_mismatch', step=5)
+  log.event('preempt_drain_start', step=6)  # non-durable kind
+  # Durable kinds flushed+fsync'd: visible on disk BEFORE close()
+  # (the kill -9 survival property, observable as flushed bytes).
+  with open(log.path) as f:
+    kinds = [json.loads(line)['kind'] for line in f if line.strip()]
+  assert kinds[:3] == ['rollback', 'health_halt',
+                       'sdc_replica_mismatch']
+  log.close()
+  log.event('rollback', step=9)  # after close: dropped, not raised
+  assert log.dropped_writes == 1
+
+
+def test_latency_reservoir_empty_percentiles_are_nan():
+  import math
+  reservoir = obs.LatencyReservoir()
+  p50, p99 = reservoir.percentiles(0.5, 0.99)
+  assert math.isnan(p50) and math.isnan(p99)
+  p50_ms, = reservoir.percentile_ms(0.5)
+  assert math.isnan(p50_ms)
+  reservoir.record(0.010)
+  p50_ms, = reservoir.percentile_ms(0.5)
+  assert p50_ms == 10.0
+
+
+def test_fps_meter_prunes_window_under_bursty_updates():
+  meter = obs.FpsMeter(window_secs=0.2)
+  # Burst far more events than the window retains, then idle past the
+  # window: the deque must prune to empty and fps decay to ~0 while
+  # total_frames keeps the cumulative count.
+  for _ in range(500):
+    meter.update(10)
+  assert meter.total_frames == 5000
+  assert meter.fps() > 0
+  import time as time_lib
+  time_lib.sleep(0.3)
+  assert meter.fps() == 0.0
+  assert len(meter._events) == 0  # pruned, not just ignored
+  # A fresh burst after the idle gap re-fills the window only with
+  # recent events (no stale carry-over inflating the rate).
+  meter.update(10)
+  assert len(meter._events) == 1
+
+
+def test_stack_metrics_round_trips_registry_backed_names():
+  """The deferred-readback path round-trips metric dicts keyed by the
+  round-13 registry naming convention (slashes and all) — the summary
+  writer consumes exactly what stack_metrics was fed."""
+  import jax.numpy as jnp
+  metrics = {
+      'learner/step_fn_builds': jnp.asarray(2.0),
+      'ingest/unrolls': jnp.asarray(7.0),
+      'total_loss': jnp.asarray(0.5),
+  }
+  handle = obs.stack_metrics(metrics)
+  out = obs.read_stacked_metrics(handle)
+  assert out == {'learner/step_fn_builds': 2.0,
+                 'ingest/unrolls': 7.0, 'total_loss': 0.5}
+  # Keys are sorted at stack time: order-insensitive round trip.
+  assert list(handle[0]) == sorted(metrics)
+
+
+def test_dropped_writes_feed_registry_counter(tmp_path):
+  from scalable_agent_tpu import telemetry
+  before = telemetry.registry().snapshot().get(
+      'observability/dropped_writes', 0)
+  writer = obs.SummaryWriter(str(tmp_path), filename='x.jsonl')
+  writer.close()
+  writer.scalar('a', 1.0, step=1)
+  after = telemetry.registry().snapshot()['observability/dropped_writes']
+  assert after == before + 1

@@ -1,0 +1,557 @@
+"""The round-13 telemetry plane: unified metrics registry, per-unroll
+trace spans (v8 wire negotiation + learner-side completion), the
+flight recorder, and trace_report reconstruction.
+
+The e2e test is the acceptance bar: a 2-process fleet run (learner +
+no-accelerator remote child) whose traces.jsonl reconstructs
+per-unroll hop-by-hop latency and the per-batch policy-lag histogram
+through scripts/trace_report.py.
+"""
+
+import json
+import math
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from scalable_agent_tpu import telemetry
+from scalable_agent_tpu.runtime import remote, ring_buffer
+from scalable_agent_tpu.structs import (ActorOutput, AgentOutput,
+                                        StepOutput, StepOutputInfo)
+from scripts import trace_report
+
+
+def _tiny_unroll(seed=0, t1=3, num_actions=3):
+  rng = np.random.RandomState(seed)
+  return ActorOutput(
+      level_name=np.int32(0),
+      agent_state=(np.zeros((1, 4), np.float32),
+                   np.ones((1, 4), np.float32)),
+      env_outputs=StepOutput(
+          reward=rng.randn(t1).astype(np.float32),
+          info=StepOutputInfo(np.zeros(t1, np.float32),
+                              np.zeros(t1, np.int32)),
+          done=np.zeros(t1, bool),
+          observation=(
+              rng.randint(0, 255, (t1, 4, 6, 3)).astype(np.uint8),
+              np.zeros((t1, 5), np.int32))),
+      agent_outputs=AgentOutput(
+          action=rng.randint(0, num_actions, t1).astype(np.int32),
+          policy_logits=rng.randn(t1, num_actions).astype(np.float32),
+          baseline=rng.randn(t1).astype(np.float32)))
+
+
+# --------------------------------------------------------------------
+# Metrics registry.
+# --------------------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram_snapshot():
+  reg = telemetry.MetricsRegistry()
+  c = reg.counter('t/c')
+  c.inc()
+  c.inc(4)
+  g = reg.gauge('t/g')
+  g.set(2.5)
+  backing = {'n': 7}
+  reg.gauge('t/lazy', fn=lambda: backing['n'])
+  h = reg.histogram('t/h')
+  for v in (1.0, 2.0, 3.0):
+    h.observe(v)
+  snap = reg.snapshot()
+  assert snap['t/c'] == 5
+  assert snap['t/g'] == 2.5
+  assert snap['t/lazy'] == 7
+  assert snap['t/h']['count'] == 3
+  assert snap['t/h']['p50'] == 2.0
+  backing['n'] = 9  # lazy gauges read live values
+  assert reg.snapshot()['t/lazy'] == 9
+
+
+def test_registry_replaces_by_name_latest_wins():
+  reg = telemetry.MetricsRegistry()
+  old = reg.counter('t/c')
+  old.inc(10)
+  new = reg.counter('t/c')  # a new component incarnation
+  new.inc(1)
+  assert reg.snapshot()['t/c'] == 1  # the live incarnation
+
+
+def test_gauge_callback_failure_reads_nan():
+  reg = telemetry.MetricsRegistry()
+  reg.gauge('t/boom', fn=lambda: 1 / 0)
+  assert math.isnan(reg.snapshot()['t/boom'])
+
+
+def test_histogram_empty_percentiles_are_nan():
+  h = telemetry.Histogram('t/h')
+  p50, p99 = h.percentiles(0.5, 0.99)
+  assert math.isnan(p50) and math.isnan(p99)
+  assert math.isnan(h.snapshot_value()['p50'])
+
+
+# --------------------------------------------------------------------
+# Trace contexts + sidecar tag store.
+# --------------------------------------------------------------------
+
+
+def test_make_trace_and_stamp():
+  tr = telemetry.make_trace('a-0', 3, epoch=7, behavior_version=2)
+  telemetry.stamp(tr, telemetry.HOP_DONE, t=1.0)
+  telemetry.stamp(tr, telemetry.HOP_SEND, t=2.0)
+  assert tr['a'] == 'a-0' and tr['s'] == 3
+  assert tr['e'] == 7 and tr['bv'] == 2
+  assert tr['h'] == [['done', 1.0], ['send', 2.0]]
+  assert telemetry.stamp(None, telemetry.HOP_WIRE) is None  # tolerant
+
+
+def test_tag_store_identity_keyed_and_bounded():
+  store = telemetry._TagStore(capacity=2)
+  a, b, c = _tiny_unroll(1), _tiny_unroll(2), _tiny_unroll(3)
+  store.tag(a, {'a': 'x'})
+  store.tag(b, {'a': 'y'})
+  store.tag(c, {'a': 'z'})  # evicts the oldest (a)
+  assert store.pop(a) is None
+  assert store.evicted == 1
+  assert store.pop(b) == {'a': 'y'}
+  assert store.pop(b) is None  # popped once
+
+
+# --------------------------------------------------------------------
+# PipelineTracer: staged/served FIFOs, lag clocks, traces.jsonl.
+# --------------------------------------------------------------------
+
+
+def _read_jsonl(path):
+  with open(path) as f:
+    return [json.loads(line) for line in f if line.strip()]
+
+
+def test_tracer_completes_spans_and_batch_records(tmp_path):
+  tracer = telemetry.PipelineTracer(str(tmp_path))
+  try:
+    tracer.on_publish(10)  # local publish clock -> 1
+    u1, u2 = _tiny_unroll(1), _tiny_unroll(2)
+    for i, u in enumerate((u1, u2)):
+      tr = telemetry.make_trace('local-0', i, behavior_version=0)
+      telemetry.stamp(tr, telemetry.HOP_DONE)
+      tracer.tag(u, tr)
+    tracer.on_batch([u1, u2], n_fresh=2)
+    tracer.on_serve()
+    tracer.on_step(5)
+    records = _read_jsonl(tracer.path)
+  finally:
+    tracer.close()
+  kinds = [r['k'] for r in records]
+  assert kinds == ['publish', 'batch']
+  batch = records[-1]
+  assert batch['step'] == 5 and batch['n_fresh'] == 2
+  # Local clock: publish count 1 - behaviour version 0 = lag 1.
+  assert batch['lag'] == [1, 1]
+  for span in batch['spans']:
+    hops = [h[0] for h in span['h']]
+    assert hops == ['done', 'staged', 'serve', 'step']
+  assert tracer.stats()['batches'] == 1
+  assert tracer.stats()['unrolls'] == 2
+
+
+def test_tracer_remote_clock_uses_commit_version(tmp_path):
+  tracer = telemetry.PipelineTracer(str(tmp_path))
+  try:
+    u = _tiny_unroll(1)
+    tr = telemetry.make_trace('r0', 0, behavior_version=4)
+    tr['cv'] = 9  # what the ingest worker stamps at commit
+    tracer.tag(u, tr)
+    tracer.on_batch([u], n_fresh=1)
+    tracer.on_serve()
+    tracer.on_step(1)
+    records = _read_jsonl(tracer.path)
+  finally:
+    tracer.close()
+  assert records[-1]['lag'] == [5]  # 9 - 4, ingest clock
+
+
+def test_tracer_untagged_unrolls_counted(tmp_path):
+  tracer = telemetry.PipelineTracer(str(tmp_path))
+  try:
+    tracer.on_batch([_tiny_unroll(1)], n_fresh=1)  # never tagged
+    assert tracer.stats()['untagged_unrolls'] == 1
+  finally:
+    tracer.close()
+
+
+def test_flight_recorder_ring_and_registry_snapshots():
+  flight = telemetry.FlightRecorder(capacity=8, snapshots=2)
+  for i in range(20):
+    flight.record({'k': 'batch', 'step': i})
+  flight.note_registry({'a': 1})
+  flight.note_registry({'a': 2})
+  flight.note_registry({'a': 3})
+  dump = flight.dump()
+  assert len(dump['records']) == 8
+  assert dump['records'][-1]['step'] == 19
+  assert [s['metrics']['a'] for s in dump['registry_snapshots']] == \
+      [2, 3]
+
+
+def test_flight_recorder_write_is_json(tmp_path):
+  flight = telemetry.FlightRecorder()
+  flight.record({'k': 'publish', 'v': 1})
+  path = flight.write(str(tmp_path / 'flight.json'))
+  with open(path) as f:
+    dump = json.load(f)
+  assert dump['records'][0]['v'] == 1
+
+
+# --------------------------------------------------------------------
+# v8 wire negotiation + remote stamping.
+# --------------------------------------------------------------------
+
+
+def test_v8_trace_negotiated_and_span_stamped_across_wire(tmp_path):
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(buffer, {'w': np.zeros(2)},
+                                         host='127.0.0.1')
+  tracer = telemetry.PipelineTracer(str(tmp_path))
+  telemetry.set_tracer(tracer)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    client.handshake({'protocol': remote.PROTOCOL_VERSION})
+    assert client.trace_ok
+    client.note_install(1)
+    unroll = _tiny_unroll(1)
+    tr = telemetry.make_trace('child-0', 0, behavior_version=1)
+    telemetry.stamp(tr, telemetry.HOP_DONE)
+    client.send_unroll(unroll, params_version=1, trace=tr)
+    landed = buffer.get(timeout=5)
+    span = telemetry.pop_unroll(landed)
+    assert span is not None
+    hops = [h[0] for h in span['h']]
+    assert hops == ['done', 'send', 'wire', 'commit']
+    assert span['cv'] == 1  # ingest publish clock at commit
+    assert 'pi' not in span  # install notice consumed server-side
+    assert tracer.stats()['param_installs'] == 1
+    records = _read_jsonl(tracer.path)
+    installs = [r for r in records if r['k'] == 'install']
+    assert installs and installs[0]['a'] == 'child-0'
+    assert installs[0]['v'] == 1
+  finally:
+    telemetry.set_tracer(None)
+    tracer.close()
+    client.close()
+    server.close()
+    buffer.close()
+
+
+def test_v8_v7_interop_trace_negotiated_off(tmp_path):
+  """A forged v7 contract keeps the old wire exactly: trace_ok stays
+  off and unroll frames carry no 5th element (the server parses them
+  as v7)."""
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(buffer, {'w': np.zeros(2)},
+                                         host='127.0.0.1')
+  tracer = telemetry.PipelineTracer(str(tmp_path))
+  telemetry.set_tracer(tracer)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    client.handshake({'protocol': 7})
+    assert not client.trace_ok
+    tr = telemetry.make_trace('old-0', 0)
+    client.send_unroll(_tiny_unroll(1), params_version=1, trace=tr)
+    landed = buffer.get(timeout=5)
+    assert telemetry.pop_unroll(landed) is None
+    assert tracer.stats()['untagged_unrolls'] == 0  # just no span
+  finally:
+    telemetry.set_tracer(None)
+    tracer.close()
+    client.close()
+    server.close()
+    buffer.close()
+
+
+def test_trace_off_server_negotiates_off(tmp_path):
+  """--telemetry_trace=false learner: server-info advertises no
+  tracing, the client doesn't stamp."""
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(buffer, {'w': np.zeros(2)},
+                                         host='127.0.0.1', trace=False)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    client.handshake({'protocol': remote.PROTOCOL_VERSION})
+    assert not client.trace_ok
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
+
+
+def test_stats_request_serves_registry_snapshot():
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(buffer, {'w': np.zeros(2)},
+                                         host='127.0.0.1')
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    client.handshake({'protocol': remote.PROTOCOL_VERSION})
+    client.send_unroll(_tiny_unroll(1))
+    stats = client.fetch_stats()
+    assert stats['ingest']['unrolls'] == 1
+    # The registry view of the same counter — one source of truth.
+    assert stats['registry']['ingest/unrolls'] == 1
+    assert 'ingest/ack_ms' in stats['registry']
+  finally:
+    client.close()
+    server.close()
+    buffer.close()
+
+
+# --------------------------------------------------------------------
+# Prefetcher integration: spans complete through the real feed path.
+# --------------------------------------------------------------------
+
+
+def test_prefetcher_completes_spans_through_feed(tmp_path):
+  tracer = telemetry.PipelineTracer(str(tmp_path))
+  telemetry.set_tracer(tracer)
+  buffer = ring_buffer.TrajectoryBuffer(8)
+  try:
+    for i in range(4):
+      u = _tiny_unroll(i)
+      tr = telemetry.begin_unroll_trace('local-0', i)
+      assert tr is not None  # tracer installed -> tracing on
+      telemetry.stamp(tr, telemetry.HOP_DONE)
+      telemetry.tag_unroll(u, tr)
+      buffer.put(u)
+    prefetcher = ring_buffer.BatchPrefetcher(buffer, 4,
+                                             place_fn=lambda b: b)
+    prefetcher.get(timeout=10)
+    tracer.on_step(1)
+    records = _read_jsonl(tracer.path)
+    batch = [r for r in records if r['k'] == 'batch'][-1]
+    assert len(batch['spans']) == 4
+    for span in batch['spans']:
+      assert [h[0] for h in span['h']] == ['done', 'staged', 'serve',
+                                           'step']
+    # Behaviour version defaulted to the tracer's publish clock (0).
+    assert batch['lag'] == [0, 0, 0, 0]
+    prefetcher.close()
+  finally:
+    telemetry.set_tracer(None)
+    tracer.close()
+    buffer.close()
+
+
+# --------------------------------------------------------------------
+# trace_report reconstruction.
+# --------------------------------------------------------------------
+
+
+def test_trace_report_summarize_hops_and_lag(tmp_path):
+  tracer = telemetry.PipelineTracer(str(tmp_path))
+  t0 = time.time()
+  tracer.on_publish(1)
+  for step in range(3):
+    u = _tiny_unroll(step)
+    tr = telemetry.make_trace('a0', step, behavior_version=0)
+    telemetry.stamp(tr, telemetry.HOP_DONE, t0 + step)
+    telemetry.stamp(tr, telemetry.HOP_SEND, t0 + step + 0.010)
+    telemetry.stamp(tr, telemetry.HOP_WIRE, t0 + step + 0.030)
+    tracer.tag(u, tr)
+    tracer.on_batch([u], n_fresh=1)
+    tracer.on_serve()
+    tracer.on_step(step)
+  tracer.on_install('a0', 1, t0 + 0.5)
+  tracer.close()
+
+  records = trace_report.load_traces(str(tmp_path))
+  summary = trace_report.summarize(records)
+  assert summary['batches'] == 3 and summary['unrolls'] == 3
+  hops = {row['hop']: row for row in summary['hops']}
+  assert hops['done->send']['count'] == 3
+  assert abs(hops['done->send']['p50_ms'] - 10.0) < 2.0
+  assert abs(hops['send->wire']['p50_ms'] - 20.0) < 2.0
+  assert 'wire->staged' in hops and 'serve->step' in hops
+  assert summary['policy_lag']['histogram'] == {1: 3}
+  assert summary['publish_to_install_secs']['count'] == 1
+  # The text renderer never crashes on the summary (NaN -> '-').
+  text = trace_report.render(summary)
+  assert 'policy lag' in text
+
+
+def test_trace_report_render_handles_empty():
+  summary = trace_report.summarize([])
+  text = trace_report.render(summary)
+  assert '-' in text  # NaN percentiles render as '-'
+
+
+# --------------------------------------------------------------------
+# Acceptance: 2-process fleet run -> trace_report reconstruction.
+# --------------------------------------------------------------------
+
+
+def test_e2e_remote_fleet_traces_and_report(tmp_path):
+  """The acceptance bar: a learner + a no-accelerator remote actor
+  child (2 OS processes) train with tracing on; traces.jsonl then
+  reconstructs per-unroll hop-by-hop latency across the wire
+  (done→send→wire→commit→staged→serve→step) and the per-batch
+  policy-lag histogram, and the summary scalars carry the live
+  policy-lag percentiles."""
+  import _remote_actor_child
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu.config import Config
+
+  base = dict(
+      env_backend='bandit', batch_size=2, unroll_length=5,
+      num_action_repeats=1, episode_length=4, height=24, width=32,
+      torso='shallow', use_py_process=False, use_instruction=False,
+      total_environment_frames=10**6, inference_timeout_ms=5,
+      checkpoint_secs=0, summary_secs=0, seed=17,
+      publish_params_every=1)
+  with socket.create_server(('127.0.0.1', 0)) as s:
+    port = s.getsockname()[1]
+  learner_cfg = Config(logdir=str(tmp_path), num_actors=0,
+                       remote_actor_port=port, **base)
+  child = _remote_actor_child.spawn(f'127.0.0.1:{port}',
+                                    dict(base, num_actors=2))
+  try:
+    run = driver.train(learner_cfg, max_steps=4,
+                       stall_timeout_secs=120)
+    assert int(run.state.update_steps) == 4
+    out, _ = child.communicate(timeout=120)
+    assert child.returncode == 0, out[-2000:]
+  finally:
+    if child.poll() is None:
+      child.kill()
+      child.communicate()
+
+  records = trace_report.load_traces(str(tmp_path))
+  summary = trace_report.summarize(
+      records, trace_report.load_incidents(str(tmp_path)))
+  assert summary['batches'] >= 3
+  assert summary['unrolls'] >= 6
+  hops = {row['hop'] for row in summary['hops']}
+  # The full remote pipeline, hop by hop, across both processes.
+  for hop in ('done->send', 'send->wire', 'wire->commit',
+              'commit->staged', 'staged->serve', 'serve->step'):
+    assert hop in hops, (hop, hops)
+  assert summary['e2e_ms']['count'] >= 6
+  assert not math.isnan(summary['e2e_ms']['p99'])
+  # Policy lag: behaviour versions rode the wire; the histogram is
+  # the publish-delta distribution (≥0, small on a healthy loopback).
+  lag_hist = summary['policy_lag']['histogram']
+  assert lag_hist and sum(lag_hist.values()) >= 6
+  assert all(int(k) >= 0 for k in lag_hist)
+  # Publish→install joins: the child reported at least its handshake
+  # install, and versions joined against publish records.
+  assert summary['publish_to_install_secs']['count'] >= 1
+  # The report renders end to end.
+  text = trace_report.render(summary)
+  assert 'per-hop latency' in text
+  # Live summary export: the lag percentiles reached summaries.jsonl.
+  with open(os.path.join(str(tmp_path), 'summaries.jsonl')) as f:
+    tags = {json.loads(line)['tag'] for line in f if line.strip()}
+  for tag in ('policy_lag_p50', 'policy_lag_p99', 'unroll_e2e_p50_ms',
+              'unroll_e2e_p99_ms', 'trace_untagged_unrolls'):
+    assert tag in tags, tag
+
+
+def test_halt_bundle_carries_flight_dump(tmp_path):
+  from scalable_agent_tpu import health as health_lib
+  monitor = health_lib.HealthMonitor()
+  flight = telemetry.FlightRecorder()
+  flight.record({'k': 'batch', 'step': 7, 'lag': [2]})
+  flight.note_registry({'ingest/unrolls': 5})
+  path = monitor.write_halt_bundle(str(tmp_path), None, step=7,
+                                   reason='test', flight=flight.dump())
+  with open(path) as f:
+    bundle = json.load(f)
+  assert bundle['flight']['records'][0]['step'] == 7
+  assert bundle['flight']['registry_snapshots'][0]['metrics'] == \
+      {'ingest/unrolls': 5}
+
+
+def test_health_counters_reach_registry():
+  from scalable_agent_tpu import health as health_lib
+  monitor = health_lib.HealthMonitor()
+  monitor.observe_values(1, {'step_ok': 0.0})
+  snap = telemetry.registry().snapshot()
+  assert snap['health/skipped_steps'] == 1
+  assert snap['health/flagged_steps'] == 1
+
+
+def test_trace_report_hop_order_matches_telemetry():
+  """trace_report keeps its own literal HOP_ORDER (operator machines
+  run it without the package's dependency chain) — this is the pin
+  that keeps the two in sync."""
+  assert tuple(trace_report.HOP_ORDER) == telemetry.HOP_ORDER
+
+
+def test_closed_components_unregister_their_gauges():
+  """fn-gauges close over their owner: close() must drop the
+  registry's hold (identity-checked — a newer incarnation's
+  registration survives an older one's teardown)."""
+  reg = telemetry.registry()
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  assert reg.get('buffer/occupancy') is not None
+  buffer2 = ring_buffer.TrajectoryBuffer(4)  # replaces the names
+  buffer.close()  # older instance: must NOT evict buffer2's gauges
+  assert reg.get('buffer/occupancy') is buffer2._gauges[0]
+  buffer2.close()
+  assert reg.get('buffer/occupancy') is None
+
+
+def test_malformed_trace_context_does_not_kill_the_reader(tmp_path):
+  """A buggy v8 peer shipping a trace dict without a stamp list must
+  not crash the ingest reader outside the quarantine accounting —
+  stamp() repairs the shape and the unroll still lands + acks."""
+  assert telemetry.stamp({'a': 'x'}, telemetry.HOP_WIRE)['h']
+  assert telemetry.stamp({'a': 'x', 'h': 'junk'},
+                         telemetry.HOP_WIRE)['h']
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(buffer, {'w': np.zeros(2)},
+                                         host='127.0.0.1')
+  tracer = telemetry.PipelineTracer(str(tmp_path))
+  telemetry.set_tracer(tracer)
+  client = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                    connect_timeout_secs=10)
+  try:
+    client.handshake({'protocol': remote.PROTOCOL_VERSION})
+    # Bypass send_unroll's stamping: ship the malformed context raw.
+    reply = client._rpc(('unroll', _tiny_unroll(1), None, None,
+                         {'a': 'buggy-peer'}), oob=True)
+    assert reply[0] == 'ack'
+    landed = buffer.get(timeout=5)
+    span = telemetry.pop_unroll(landed)
+    assert [h[0] for h in span['h']] == ['wire', 'commit']
+    stats = server.stats()
+    assert stats['unrolls'] == 1 and stats['quarantined'] == 0
+  finally:
+    telemetry.set_tracer(None)
+    tracer.close()
+    client.close()
+    server.close()
+    buffer.close()
+
+
+def test_publish_install_join_uses_ingest_lane_version(tmp_path):
+  """Install notices carry the ingest lane's version sequence; the
+  join must key on the publish record's 'rv', not the step-stamped
+  label (which is a different clock at production cadences)."""
+  tracer = telemetry.PipelineTracer(str(tmp_path))
+  t0 = time.time()
+  # Step-stamped label 100, ingest-lane version 2 (the sequences
+  # diverge immediately at publish_params_every > 1).
+  tracer.on_publish(100, remote_version=2)
+  tracer.on_install('a0', 2, t0 + 0.25)
+  tracer.on_publish(200)  # local-only publish: no 'rv', no join key
+  tracer.close()
+  summary = trace_report.summarize(
+      trace_report.load_traces(str(tmp_path)))
+  assert summary['publish_to_install_secs']['count'] == 1

@@ -69,3 +69,46 @@ def test_truncated_final_line_is_skipped(tmp_path):
     f.write('{"tag": "loss/total", "va')  # truncated mid-write
   written = to_tensorboard.convert(str(tmp_path))
   assert written == {'train': 1}
+
+
+def test_trace_stream_converts_to_scalars(tmp_path):
+  """traces.jsonl (round 13) -> a `trace` TB run with hop-latency and
+  policy-lag scalars, read back through the EventAccumulator."""
+  import json
+  t0 = 1000.0
+  with open(tmp_path / 'traces.jsonl', 'w') as f:
+    f.write(json.dumps({'k': 'publish', 'v': 1, 't': t0}) + '\n')
+    f.write(json.dumps({
+        'k': 'batch', 'step': 2, 't': t0 + 1.0, 'pv': 1,
+        'n_fresh': 2, 'lag': [1, 3],
+        'spans': [
+            {'a': 'a0', 's': 0, 'bv': 0,
+             'h': [['done', t0], ['send', t0 + 0.010],
+                   ['wire', t0 + 0.030], ['commit', t0 + 0.031],
+                   ['staged', t0 + 0.040], ['serve', t0 + 0.050],
+                   ['step', t0 + 0.051]]},
+            {'a': 'a1', 's': 0, 'bv': 0,
+             'h': [['done', t0], ['staged', t0 + 0.020],
+                   ['serve', t0 + 0.030], ['step', t0 + 0.031]]},
+        ]}) + '\n')
+  # A summaries stream alongside: both convert, into separate runs.
+  from scalable_agent_tpu import observability as obs
+  writer = obs.SummaryWriter(str(tmp_path))
+  writer.scalar('loss/total', 1.0, step=2)
+  writer.close()
+
+  written = to_tensorboard.convert(str(tmp_path))
+  assert written['train'] == 1
+  assert written['trace'] > 0
+  acc = tb_accumulator.EventAccumulator(
+      str(tmp_path / 'tb' / 'trace'))
+  acc.Reload()
+  tags = set(acc.Tags()['scalars'])
+  assert 'trace/policy_lag_mean' in tags
+  assert 'trace/policy_lag_max' in tags
+  assert 'trace/hop_done_send_ms' in tags
+  assert 'trace/e2e_ms' in tags
+  lag_mean = acc.Scalars('trace/policy_lag_mean')[0]
+  assert lag_mean.step == 2 and abs(lag_mean.value - 2.0) < 1e-6
+  hop = acc.Scalars('trace/hop_done_send_ms')[0]
+  assert abs(hop.value - 10.0) < 1e-3  # one span has done->send
