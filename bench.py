@@ -1916,6 +1916,96 @@ def bench_telemetry(smoke):
   return results
 
 
+def bench_slo(smoke):
+  """SLO-engine overhead (round 14; docs/PERF.md r12): the cost of
+  judging every run continuously, measured so the default is an
+  accept/reject call with numbers. Three rows:
+
+  a) evaluator tick: one SloEvaluator.observe over a registry-scale
+     snapshot (default objective set + ~50 synthetic metric names),
+     µs/tick — the per-cadence cost the engine thread pays;
+  b) verdict: SloEvaluator.verdict() µs (the finalize-path cost);
+  c) profiler-capture overhead: a tiny jitted step loop timed bare vs
+     wrapped in a bounded jax.profiler trace (what a triggered
+     page-capture costs the K steps it covers), plus the trace write
+     wall time.
+  """
+  import shutil
+  import tempfile
+  import jax
+  import jax.numpy as jnp
+  from scalable_agent_tpu import slo as slo_lib
+  from scalable_agent_tpu import telemetry
+
+  results = {}
+  objectives = slo_lib.load_objectives()
+  results['objectives'] = len(objectives)
+
+  # --- (a) evaluator tick over a registry-scale snapshot. ---
+  reg = telemetry.MetricsRegistry()
+  for i in range(40):
+    c = reg.counter(f'bench/slo_c{i}')
+    c.inc(i)
+  h = reg.histogram('trace/policy_lag')
+  h2 = reg.histogram('trace/e2e_ms')
+  for i in range(512):
+    h.observe(i % 7)
+    h2.observe(50.0 + i % 31)
+  g = reg.gauge('driver/env_plane_utilization')
+  g.set(0.8)
+  crc = reg.counter('ingest/wire_crc_rejected')
+  evaluator = slo_lib.SloEvaluator(objectives, min_samples=2)
+  n = 2_000 if not smoke else 200
+  t_base = time.time()
+  t0 = time.perf_counter()
+  for i in range(n):
+    crc.inc(0)  # snapshot stays cheap-but-live
+    evaluator.observe(reg.snapshot(), now=t_base + i * 0.5)
+  dt = time.perf_counter() - t0
+  results['evaluator_tick_us'] = round(dt / n * 1e6, 2)
+
+  # --- (b) verdict cost. ---
+  n = 2_000 if not smoke else 200
+  t0 = time.perf_counter()
+  for _ in range(n):
+    evaluator.verdict()
+  dt = time.perf_counter() - t0
+  results['verdict_us'] = round(dt / n * 1e6, 2)
+
+  # --- (c) profiler-capture overhead around a tiny jitted loop. ---
+  steps = 20 if not smoke else 6
+  x = jnp.ones((256, 256), jnp.float32)
+
+  @jax.jit
+  def step(x):
+    return jnp.tanh(x @ x) * 0.5
+
+  def run_steps():
+    y = x
+    t0 = time.perf_counter()
+    for _ in range(steps):
+      y = step(y)
+    jax.block_until_ready(y)
+    return time.perf_counter() - t0
+
+  run_steps()  # compile
+  bare = min(run_steps() for _ in range(3))
+  tmpdir = tempfile.mkdtemp(prefix='bench_slo_prof_')
+  t0 = time.perf_counter()
+  jax.profiler.start_trace(tmpdir)
+  traced = run_steps()
+  jax.profiler.stop_trace()
+  capture_wall = time.perf_counter() - t0
+  shutil.rmtree(tmpdir, ignore_errors=True)
+  results['profiled_steps'] = steps
+  results['bare_steps_ms'] = round(bare * 1e3, 3)
+  results['traced_steps_ms'] = round(traced * 1e3, 3)
+  results['capture_wall_ms'] = round(capture_wall * 1e3, 3)
+  results['capture_overhead_fraction'] = (
+      round(traced / bare - 1.0, 4) if bare > 0 else None)
+  return results
+
+
 def main():
   # BENCH_SMOKE=1: tiny shapes on CPU — validates bench mechanics in CI
   # without the chip. The driver runs the real thing (no env var, TPU).
@@ -1984,6 +2074,19 @@ def main():
     })
     return
 
+  # BENCH_ONLY=slo: just the SLO-engine overhead rows (the
+  # scripts/ci.sh slo lane — evaluator tick + triggered-capture cost).
+  if os.environ.get('BENCH_ONLY') == 'slo':
+    slo_rows = bench_slo(smoke)
+    _emit({
+        'metric': 'slo_evaluator_tick_us',
+        'value': slo_rows.get('evaluator_tick_us'),
+        'unit': ('microseconds per SLO evaluator tick, default '
+                 'objective set%s' % (' (SMOKE)' if smoke else '')),
+        'slo': slo_rows,
+    })
+    return
+
   # BENCH_ONLY=overload: just the overload rows (the scripts/ci.sh
   # chaos-adjacent smoke — shed-rate/tail-latency mechanics on CPU).
   if os.environ.get('BENCH_ONLY') == 'overload':
@@ -2032,6 +2135,9 @@ def main():
   tele = None
   if os.environ.get('BENCH_SKIP_TELEMETRY') != '1':
     tele = bench_telemetry(smoke)
+  slo_rows = None
+  if os.environ.get('BENCH_SKIP_SLO') != '1':
+    slo_rows = bench_slo(smoke)
 
   baseline_per_chip = 200_000.0 / 16.0  # north star / v5e-16 chips
   out = {
@@ -2075,6 +2181,8 @@ def main():
     out['replay'] = replay
   if tele is not None:
     out['telemetry'] = tele
+  if slo_rows is not None:
+    out['slo'] = slo_rows
   _emit(out)
 
 
@@ -2190,6 +2298,17 @@ def _headline(out):
         'overhead_fraction': tele.get('overhead_fraction'),
         'span_ns': tele.get('span_ns'),
         'registry_ns_per_op': tele.get('registry_ns_per_op')}
+  # The SLO-engine cost (round 14): evaluator tick + triggered-
+  # capture overhead — the numbers the always-on judging default is
+  # accepted/rejected on (docs/PERF.md r12), clip-safe like every
+  # other default-flip record.
+  slo_rows = out.get('slo')
+  if slo_rows:
+    head['slo'] = {
+        'evaluator_tick_us': slo_rows.get('evaluator_tick_us'),
+        'verdict_us': slo_rows.get('verdict_us'),
+        'capture_overhead_fraction':
+            slo_rows.get('capture_overhead_fraction')}
   return head
 
 

@@ -378,6 +378,42 @@ flags.DEFINE_integer('telemetry_flight_len',
                      'Flight-recorder depth: recent trace records + '
                      'registry snapshots dumped with halt bundles '
                      'and rollback incidents.')
+# --- SLO engine (round 14; slo.py, docs/OBSERVABILITY.md). ---
+flags.DEFINE_bool('slo_engine', _DEFAULTS.slo_engine,
+                  'Declarative SLO evaluation over the metrics '
+                  'registry: burn-rate windows, slo_violation '
+                  'incidents, the per-run SLO_VERDICT.json go/no-go '
+                  'artifact, and triggered deep diagnostics '
+                  '(docs/OBSERVABILITY.md SLO inventory; overhead '
+                  'measured, docs/PERF.md r12).')
+flags.DEFINE_string('slo_spec', _DEFAULTS.slo_spec,
+                    'JSON objective-set file; empty = the shipped '
+                    'default objectives (slo.DEFAULT_OBJECTIVES).')
+flags.DEFINE_float('slo_fast_window_secs',
+                   _DEFAULTS.slo_fast_window_secs,
+                   'Fast burn window for objectives that do not pin '
+                   'their own (must be fully violating to burn).')
+flags.DEFINE_float('slo_slow_window_secs',
+                   _DEFAULTS.slo_slow_window_secs,
+                   'Slow burn window (>= half violating confirms a '
+                   'sustained burn).')
+flags.DEFINE_float('slo_interval_secs', _DEFAULTS.slo_interval_secs,
+                   'Evaluator thread cadence (0 = derive from '
+                   'summary_secs; the summary block also evaluates).')
+flags.DEFINE_bool('slo_capture', _DEFAULTS.slo_capture,
+                  'Triggered deep diagnostics on the first burn of a '
+                  'page-severity objective: flight dump + trace '
+                  'slice + a bounded jax.profiler capture into '
+                  '<logdir>/diagnostics/ (one per objective per run).')
+flags.DEFINE_integer('slo_capture_steps', _DEFAULTS.slo_capture_steps,
+                     'Learner steps a triggered profiler capture '
+                     'covers.')
+flags.DEFINE_string('slo_fps_baseline', _DEFAULTS.slo_fps_baseline,
+                    'Per-host fps baseline file for the fps_floor '
+                    'objective (JSON {hostname: {"fps": value}}; '
+                    'scripts/slo_report.py --update-fps-baseline '
+                    'records one). Empty = objective reads '
+                    'no_baseline.')
 flags.DEFINE_bool('health_watchdog', _DEFAULTS.health_watchdog,
                   'Learner failure domain (health.py): skip '
                   'non-finite updates on device, roll back to the '

@@ -395,6 +395,44 @@ class Config:
   # rollback incident — the "last N seconds of pipeline history"
   # an incident postmortem starts from.
   telemetry_flight_len: int = 512
+  # --- SLO engine (round 14; slo.py, docs/OBSERVABILITY.md). The
+  # sensor-to-verdict half of the control loop: declarative objectives
+  # over the metrics registry, evaluated continuously on fast/slow
+  # burn windows, with the per-run SLO_VERDICT.json go/no-go artifact
+  # and triggered deep diagnostics on page-severity burns. Default ON:
+  # the bench.py `slo` stage measured the evaluator tick sub-
+  # millisecond, paid once per cadence interval off the hot loop
+  # (docs/PERF.md r12 records the accept call); False removes the
+  # thread, the verdict, and the captures entirely. ---
+  slo_engine: bool = True
+  # Objective set: '' = the shipped defaults (slo.DEFAULT_OBJECTIVES —
+  # one per instrumented plane, the table in docs/OBSERVABILITY.md);
+  # a path loads a JSON list of objective dicts instead. A spec naming
+  # an unregistered metric is a spin-up error, not a silent no-op.
+  slo_spec: str = ''
+  # Default burn windows for objectives that don't pin their own:
+  # multi-window burn-rate alerting — the fast window must be FULLY
+  # violating and at least half the slow window too before an
+  # objective burns (a blip must not page; a sustained burn must).
+  slo_fast_window_secs: float = 30.0
+  slo_slow_window_secs: float = 300.0
+  # Evaluator cadence (its own thread; the driver's summary block
+  # also evaluates, so detection is step-synchronous whenever
+  # summaries are frequent). 0 = derive from summary_secs.
+  slo_interval_secs: float = 0.0
+  # Triggered deep diagnostics: on the FIRST burn of a severity=page
+  # objective, dump the flight recorder + a trace_report slice over
+  # the violation window into <logdir>/diagnostics/ and capture a
+  # bounded jax.profiler trace of the next slo_capture_steps learner
+  # steps (one capture per objective per run).
+  slo_capture: bool = True
+  slo_capture_steps: int = 5
+  # Per-host fps baseline file (JSON {hostname: {'fps': value}}): the
+  # fps_floor objective judges throughput against THIS host's
+  # recorded capability ('' = no baseline — the objective reads
+  # no_baseline, never a violation). scripts/slo_report.py
+  # --update-fps-baseline records a known-good run into it.
+  slo_fps_baseline: str = ''
   # --- Learner failure domain (health.py, round 7). ---
   # Training-health watchdog: the train step skips non-finite updates
   # on device (params carry over unchanged) and the driver escalates
@@ -628,6 +666,63 @@ def validate_integrity(config: Config) -> List[str]:
         'corrupted on the wire is inserted already-rotten and will '
         're-serve cleanly — the replay check only covers rot AFTER '
         'retention')
+  return warnings
+
+
+def validate_slo(config: Config) -> List[str]:
+  """Validate the SLO knob group (round 14); raises ValueError on
+  hard errors, returns warnings (same contract as validate_replay /
+  validate_transport / validate_integrity — driver.train calls it
+  before spin-up). The spec file itself is loaded (and therefore
+  validated) by slo.load_objectives at engine construction; here the
+  cross-links."""
+  warnings = []
+  if config.slo_fast_window_secs <= 0:
+    raise ValueError(f'slo_fast_window_secs must be > 0, got '
+                     f'{config.slo_fast_window_secs}')
+  if config.slo_slow_window_secs <= 0:
+    raise ValueError(f'slo_slow_window_secs must be > 0, got '
+                     f'{config.slo_slow_window_secs}')
+  if config.slo_capture_steps < 1:
+    raise ValueError(f'slo_capture_steps must be >= 1, got '
+                     f'{config.slo_capture_steps}')
+  if config.slo_interval_secs < 0:
+    raise ValueError(f'slo_interval_secs must be >= 0, got '
+                     f'{config.slo_interval_secs}')
+  if not config.slo_engine:
+    if config.slo_spec:
+      warnings.append(
+          'slo_spec=%r with slo_engine=False: the objective set is '
+          'loaded by the engine — nothing will judge it' %
+          config.slo_spec)
+    return warnings
+  if config.slo_fast_window_secs >= config.slo_slow_window_secs:
+    warnings.append(
+        'slo_fast_window_secs=%.1f >= slo_slow_window_secs=%.1f: the '
+        'slow window no longer confirms a sustained burn — every '
+        'fast-window blip escalates straight to a violation' %
+        (config.slo_fast_window_secs, config.slo_slow_window_secs))
+  if (config.slo_interval_secs > 0 and
+      config.slo_interval_secs * 3 > config.slo_fast_window_secs):
+    warnings.append(
+        'slo_interval_secs=%.1f leaves fewer than the 3 samples the '
+        'fast window (%.1fs) needs before a value objective can '
+        'burn — the policy-lag/utilization/fleet objectives would be '
+        'structurally unable to fire; lower the interval or widen '
+        'slo_fast_window_secs' %
+        (config.slo_interval_secs, config.slo_fast_window_secs))
+  if not config.telemetry_trace:
+    warnings.append(
+        'slo_engine=True with telemetry_trace=False: the policy-lag '
+        'and end-to-end-span objectives will evaluate as no_data '
+        '(their histograms never fill), and page captures lose the '
+        'flight/trace-slice artifacts — the verdict only judges the '
+        'counter planes')
+  if config.slo_capture and not config.health_watchdog:
+    warnings.append(
+        'slo_capture=True with health_watchdog=False: SLO burns '
+        'cannot feed the external-incident ledger (no monitor), so '
+        'drain manifests and halt bundles will not name them')
   return warnings
 
 

@@ -43,9 +43,10 @@ from scalable_agent_tpu import checkpoint as checkpoint_lib
 from scalable_agent_tpu import health as health_lib
 from scalable_agent_tpu import learner as learner_lib
 from scalable_agent_tpu import observability
+from scalable_agent_tpu import slo as slo_lib
 from scalable_agent_tpu import telemetry
 from scalable_agent_tpu.config import (Config, validate_integrity,
-                                       validate_replay,
+                                       validate_replay, validate_slo,
                                        validate_transport)
 from scalable_agent_tpu.envs import factory, suites
 from scalable_agent_tpu.models import ImpalaAgent, init_params
@@ -342,6 +343,10 @@ def train(config: Config, max_steps: Optional[int] = None,
   # ingest without wire CRC).
   for warning in validate_integrity(config):
     log.warning('%s', warning)
+  # SLO knob group (round 14): hard range errors raise; cross-links
+  # (engine without tracing, capture without the watchdog) log.
+  for warning in validate_slo(config):
+    log.warning('%s', warning)
   # NOTE round 8: the fused Pallas V-trace is no longer rejected under
   # a mesh — the sharded step runs it shard_map'ped over the data axis
   # (vtrace.py / ops/vtrace_pallas.sharded_from_importance_weights;
@@ -470,6 +475,7 @@ def train(config: Config, max_steps: Optional[int] = None,
   writer = None
   incidents = None
   tracer = None
+  slo_engine = None
   try:
     # --- Trajectory buffer + remote ingest, BEFORE inference warmup:
     # remote actor hosts connect and fetch params while this host
@@ -712,6 +718,39 @@ def train(config: Config, max_steps: Optional[int] = None,
     # lockstep — the rollback restore stays a valid collective.
     health = (health_lib.monitor_from_config(config)
               if config.health_watchdog else None)
+    # SLO engine (round 14, slo.py): the declarative-objective judge
+    # over the metrics registry. Its thread snapshots the registry on
+    # a cadence (the summary block also evaluates, so detection is
+    # step-synchronous whenever summaries are frequent), emits
+    # structured slo_violation incidents + the slo_violations summary
+    # scalar, feeds burns into health's external-incident ledger, and
+    # on the first page-severity burn captures its own explanation
+    # (flight dump + trace slice now; a bounded jax.profiler capture
+    # via the loop below). The finally writes SLO_VERDICT.json —
+    # the per-run go/no-go artifact chaos/soak/slo_report consume.
+    if config.slo_engine:
+      slo_objectives = slo_lib.load_objectives(
+          config.slo_spec,
+          fast_window_secs=config.slo_fast_window_secs,
+          slow_window_secs=config.slo_slow_window_secs)
+      # Derived cadence: summary-paced, but ALWAYS at least ~4
+      # samples inside the fast burn window — value objectives need
+      # min_samples (3) fast-window samples before they can burn, so
+      # an interval as long as the window would leave the page
+      # objectives structurally unable to fire (validate_slo warns
+      # when an EXPLICIT interval does this).
+      slo_interval = (config.slo_interval_secs
+                      if config.slo_interval_secs > 0 else
+                      min(max(float(config.summary_secs), 1.0), 30.0,
+                          config.slo_fast_window_secs / 4.0))
+      slo_engine = slo_lib.SloEngine(
+          slo_objectives, config.logdir, writer=writer,
+          incidents=incidents,
+          flight=(tracer.flight if tracer is not None else None),
+          health=health, capture=config.slo_capture,
+          interval_secs=slo_interval,
+          baseline=slo_lib.load_baseline(config.slo_fps_baseline))
+      slo_engine.start()
     run = TrainRun(config, agent, state, fleet, prefetcher, server,
                    checkpointer, writer, stats, fps_meter,
                    ingest=ingest, health=health)
@@ -747,11 +786,23 @@ def train(config: Config, max_steps: Optional[int] = None,
     if tracer is not None:
       _try(lambda: telemetry.set_tracer(None))
       _try(tracer.close)
+    if slo_engine is not None:
+      _try(slo_engine.stop)  # no verdict: the run never started
     _try(checkpointer.close)
     raise
 
   steps_done = 0
   profiling = False
+  # Operator-requested profile window state: `pending` until the
+  # window actually starts (DEFERRED past any in-flight SLO capture,
+  # never silently skipped), then the captured stop step.
+  profile_dir_pending = bool(config.profile_dir)
+  profile_stop_step = None
+  # SLO-triggered profiler capture in flight: (objective name, the
+  # steps_done value at which the bounded trace stops). jax.profiler
+  # supports one trace at a time, so this and the config.profile_dir
+  # window are mutually exclusive in the loop below.
+  slo_profile = None
   errors: List[BaseException] = []
   # Unified-registry view of the loop itself (round 13): the step and
   # frame clocks every other counter is read against. Lazy closures
@@ -767,6 +818,26 @@ def train(config: Config, max_steps: Optional[int] = None,
                       else (_initial_steps + steps_done) *
                       config.frames_per_step)),
   ]
+  # Plane-state gauges (round 14): the summary block's utilization
+  # split and fleet quorum, registered into the unified registry so
+  # the SLO engine (and the flight recorder / drain manifest) judge
+  # the SAME numbers the summaries carry. Created lazily at the first
+  # summary interval — a default 0.0 before any measurement would
+  # read as a dead plane to the env_plane_utilization objective.
+  _plane_gauges: Dict[str, telemetry.Gauge] = {}
+
+  def _set_plane_gauge(name, value):
+    gauge = _plane_gauges.get(name)
+    if gauge is None:
+      # Literal registration names (the ci.sh lint contract).
+      if name == 'env':
+        gauge = telemetry.gauge('driver/env_plane_utilization')
+      elif name == 'learner':
+        gauge = telemetry.gauge('driver/learner_plane_utilization')
+      else:
+        gauge = telemetry.gauge('driver/fleet_healthy_fraction')
+      _plane_gauges[name] = gauge
+    gauge.set(value)
   # Preemption-drain state: set once the drain is requested (SIGTERM
   # via drain_event, or the deterministic 'preempt_signal' fault);
   # the loop then flushes the already-produced feed instead of
@@ -895,14 +966,53 @@ def train(config: Config, max_steps: Optional[int] = None,
       # no tracing at all): [start, start+num) learner steps, placed
       # after warmup so compiles don't drown the timeline.
       if config.profile_dir:
-        if steps_done == config.profile_start_step:
+        # The operator window DEFERS past an in-flight SLO capture
+        # (>= start step + the pending flag) instead of silently
+        # skipping it when the two collide on the one profiler.
+        if (profile_dir_pending and not profiling
+            and slo_profile is None
+            and steps_done >= config.profile_start_step):
           jax.profiler.start_trace(config.profile_dir)
           profiling = True
-        elif profiling and steps_done == (config.profile_start_step +
-                                          config.profile_num_steps):
+          profile_dir_pending = False
+          profile_stop_step = steps_done + config.profile_num_steps
+        elif profiling and steps_done >= profile_stop_step:
           jax.profiler.stop_trace()
           profiling = False
           log.info('profiler trace written to %s', config.profile_dir)
+      # SLO-triggered deep diagnostics (round 14): a page-severity
+      # burn queued a bounded profiler capture — the next
+      # slo_capture_steps learner steps trace into
+      # diagnostics/slo_profile_<objective>/ (the flight dump and the
+      # trace slice already landed from the engine thread). One
+      # capture at a time; the operator-requested profile_dir window
+      # wins when both want the profiler.
+      if slo_engine is not None and not profiling:
+        if slo_profile is not None:
+          name, end_step = slo_profile
+          if steps_done >= end_step:
+            jax.profiler.stop_trace()
+            slo_profile = None
+            log.info('SLO diagnostic profile for %r complete', name)
+        else:
+          req = slo_engine.take_profile_request()
+          if req is not None:
+            slo_prof_dir = os.path.join(config.logdir, 'diagnostics',
+                                        f'slo_profile_{req}')
+            os.makedirs(slo_prof_dir, exist_ok=True)
+            try:
+              jax.profiler.start_trace(slo_prof_dir)
+            except Exception:
+              log.exception('SLO profiler capture failed to start')
+              slo_engine.note_profile(req, None)
+            else:
+              slo_profile = (req,
+                             steps_done + config.slo_capture_steps)
+              slo_engine.note_profile(req, slo_prof_dir)
+              log.warning(
+                  'SLO page (%s): capturing a %d-step profiler trace '
+                  'into %s', req, config.slo_capture_steps,
+                  slo_prof_dir)
       # Fault-injection seam (runtime/faults.py 'nan_burst'): rewards
       # become NaN on the staged device batch, driving a non-finite
       # loss through the REAL loss/grad path — what organic divergence
@@ -1287,10 +1397,10 @@ def train(config: Config, max_steps: Optional[int] = None,
         # bound.
         d_feed_wait = pf['wait_secs'] - last_reuse_snap.get(
             'feed_wait_secs', 0.0)
-        writer.scalar(
-            'learner_plane_utilization',
-            min(max(1.0 - d_feed_wait / interval, 0.0), 1.0)
-            if interval > 0 else 0.0, step_now)
+        learner_util = (min(max(1.0 - d_feed_wait / interval, 0.0),
+                            1.0) if interval > 0 else 0.0)
+        writer.scalar('learner_plane_utilization', learner_util,
+                      step_now)
         d_put_wait = (buf_stats['put_wait_secs'] -
                       last_reuse_snap['put_wait_secs'])
         # Producer-thread count for the normalization: local actors
@@ -1301,10 +1411,15 @@ def train(config: Config, max_steps: Optional[int] = None,
         if ingest is not None:
           producers += ingest.stats()['live']
         producers = max(producers, 1)
-        writer.scalar(
-            'env_plane_utilization',
-            min(max(1.0 - d_put_wait / (interval * producers), 0.0),
-                1.0) if interval > 0 else 0.0, step_now)
+        env_util = (min(max(1.0 - d_put_wait / (interval * producers),
+                            0.0), 1.0) if interval > 0 else 0.0)
+        writer.scalar('env_plane_utilization', env_util, step_now)
+        # Registry mirror of the plane split + fleet quorum (round
+        # 14): the numbers the SLO engine's env_plane_utilization /
+        # fleet_healthy_fraction objectives judge.
+        _set_plane_gauge('env', env_util)
+        _set_plane_gauge('learner', learner_util)
+        _set_plane_gauge('fleet', fleet_stats['healthy_fraction'])
         # Fresh vs reused frame counters (cumulative): reused = tier
         # replays (re-staged) + whole-batch re-serves (zero-H2D).
         frames_fresh = pf['fresh_slots_served'] * frames_per_unroll
@@ -1466,6 +1581,24 @@ def train(config: Config, max_steps: Optional[int] = None,
                           max(rates), step_now)
           last_ingest_snap = ing
           last_ingest_time = now
+        # Telemetry self-health (round 14 satellites): silently
+        # dropped JSONL writes (any stream, process-wide) and the
+        # flight recorder's occupancy — asserted to reach
+        # summaries.jsonl by the e2e remote test alongside the trace
+        # scalars.
+        writer.scalar('dropped_writes',
+                      telemetry.dropped_writes_total(), step_now)
+        if tracer is not None:
+          writer.scalar('trace_flight_records', len(tracer.flight),
+                        step_now)
+        # Step-synchronous SLO evaluation (round 14): the engine's
+        # thread covers long summary gaps; this call makes detection
+        # deterministic wherever summaries are frequent (chaos runs
+        # at summary_secs=0 — the storm's violation is judged the
+        # step it happens, and the triggered capture still has loop
+        # steps left to profile).
+        if slo_engine is not None:
+          slo_engine.observe()
       # Checkpoint cadence: Orbax saves are collective across hosts;
       # clocks differ, so all hosts act on PROCESS 0's decision (a
       # host-local clock here would desync the barrier and deadlock).
@@ -1539,6 +1672,11 @@ def train(config: Config, max_steps: Optional[int] = None,
           # 'stats' request read — the resume/postmortem gets the
           # full counter surface without a summaries.jsonl dig.
           'metrics': telemetry.registry().snapshot(),
+          # SLO state at drain time (round 14): the preempted run's
+          # verdict-so-far, so the resume/postmortem sees which
+          # objectives were burning when the platform pulled the node.
+          'slo': (slo_engine.verdict() if slo_engine is not None
+                  else None),
           'drain_source': drain_source,
           'drain_latency_secs': round(drain_latency, 3),
           'wall_time': round(time.time(), 3),
@@ -1578,15 +1716,36 @@ def train(config: Config, max_steps: Optional[int] = None,
           checkpointer.save_errors, checkpointer.restore_fallbacks)
     except Exception:
       log.exception('robustness summary failed')
-    if profiling:
+    # SLO verdict (round 14): stop the evaluator thread and write the
+    # per-run SLO_VERDICT.json — BEFORE component teardown, so the
+    # final observation still sees every fn-gauge its objectives
+    # judge. Written on every exit path (a crashed run's verdict is
+    # exactly what the postmortem wants); chaos/soak/slo_report read
+    # the file.
+    if slo_engine is not None:
+      try:
+        slo_engine.stop()
+        verdict_name = ('SLO_VERDICT.json' if process_index == 0
+                        else f'SLO_VERDICT_p{process_index}.json')
+        verdict = slo_engine.finalize(
+            os.path.join(config.logdir, verdict_name),
+            extra={'clean_exit': exiting_clean,
+                   'update_steps': _initial_steps + steps_done})
+        (log.info if verdict['pass'] else log.warning)(
+            'SLO verdict: %s (%d objective(s), violations: %s) -> %s',
+            'PASS' if verdict['pass'] else 'FAIL',
+            len(verdict['objectives']),
+            verdict['violations'] or 'none', verdict_name)
+      except Exception:
+        log.exception('SLO verdict write failed')
+    if profiling or slo_profile is not None:
       jax.profiler.stop_trace()
-    elif (config.profile_dir and
-          steps_done <= config.profile_start_step):
+    elif config.profile_dir and profile_dir_pending:
       log.warning(
-          'profile_dir set but the run ended at step %d, before '
-          'profile_start_step=%d — no trace was captured (lower '
-          '--profile_start_step)', steps_done,
-          config.profile_start_step)
+          'profile_dir set but the run ended at step %d before the '
+          'window could start (profile_start_step=%d, or an SLO '
+          'capture held the profiler) — no operator trace was '
+          'captured', steps_done, config.profile_start_step)
     fleet.stop()
     prefetcher.close()
     server.close()
@@ -1623,6 +1782,8 @@ def train(config: Config, max_steps: Optional[int] = None,
       writer.close()
       incidents.close()
       for gauge in _loop_gauges:
+        telemetry.registry().unregister(gauge.name, gauge)
+      for gauge in _plane_gauges.values():
         telemetry.registry().unregister(gauge.name, gauge)
       if tracer is not None:
         telemetry.set_tracer(None)

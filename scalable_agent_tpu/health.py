@@ -38,6 +38,7 @@ import collections
 import dataclasses
 import json
 import os
+import threading
 import time
 from typing import Dict, NamedTuple, Optional
 
@@ -163,10 +164,14 @@ class HealthMonitor:
     self.last_reason = ''     # why the most recent bad step was bad
     # External (non-learner-step) incidents other planes report into
     # the health surface (round 11: the transport watchdog's wedged
-    # ingest threads, reaped half-open connections) — counted per kind
-    # so the drain manifest / postmortem carries them next to the
-    # step-health counters instead of only in summaries.jsonl.
+    # ingest threads, reaped half-open connections; round 14: SLO
+    # burns from the evaluator thread) — counted per kind so the
+    # drain manifest / postmortem carries them next to the
+    # step-health counters instead of only in summaries.jsonl. Lock:
+    # since round 14 note_external is called from the SLO engine's
+    # thread as well as the driver thread.
     self._external: Dict[str, int] = {}
+    self._external_lock = threading.Lock()
     # Unified-registry view (round 13, telemetry.py): lazy gauges over
     # this monitor's ladder counters — the drain manifest, flight
     # recorder, and the remote 'stats' request read the SAME numbers
@@ -298,11 +303,13 @@ class HealthMonitor:
     these are not learner-step verdicts — but the counts ride
     `stats()`/`drain_report()` so the drain manifest and the halt
     bundle name what the transport plane absorbed."""
-    self._external[kind] = self._external.get(kind, 0) + int(count)
+    with self._external_lock:
+      self._external[kind] = self._external.get(kind, 0) + int(count)
 
   @property
   def external_incidents(self) -> Dict[str, int]:
-    return dict(self._external)
+    with self._external_lock:
+      return dict(self._external)
 
   def stats(self) -> Dict[str, float]:
     """Counters the driver writes to summaries every interval."""
@@ -323,8 +330,11 @@ class HealthMonitor:
     of re-deriving it from summaries.jsonl."""
     report = dict(self.stats())
     report['last_reason'] = self.last_reason
-    if self._external:
-      report['external_incidents'] = dict(self._external)
+    # Locked copy: the SLO engine's thread may note_external a burn
+    # while the drain builds the manifest (round 14).
+    external = self.external_incidents
+    if external:
+      report['external_incidents'] = external
     return report
 
   # --- diagnostics ---

@@ -87,7 +87,10 @@ class EventLog(_JsonlAppender):
   # driver's spellings (health_halt, sdc_replica_mismatch,
   # fault_replica_divergence, actor_slots_quarantined) all qualify
   # without a fragile exact list.
-  _DURABLE_MARKERS = ('halt', 'rollback', 'sdc', 'quarantin')
+  # 'slo' (round 14): an SLO violation/capture record is the page an
+  # operator will be reading — it must survive the crash it may be
+  # narrating.
+  _DURABLE_MARKERS = ('halt', 'rollback', 'sdc', 'quarantin', 'slo')
 
   def __init__(self, logdir: str, filename: str = 'incidents.jsonl'):
     super().__init__(logdir, filename)

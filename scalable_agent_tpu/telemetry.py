@@ -441,6 +441,16 @@ class FlightRecorder:
       self._snapshots.append({'wall_time': round(time.time(), 3),
                               'metrics': snapshot})
 
+  def __len__(self) -> int:
+    """Trace records currently retained in the ring."""
+    with self._lock:
+      return len(self._records)
+
+  @property
+  def snapshots_held(self) -> int:
+    with self._lock:
+      return len(self._snapshots)
+
   def dump(self) -> Dict:
     with self._lock:
       return {'wall_time': round(time.time(), 3),
@@ -468,6 +478,13 @@ class FlightRecorder:
 # the race — a respawning actor logging one last episode could take
 # its fleet slot down over a log line.
 _DROPPED_WRITES = counter('observability/dropped_writes')
+
+
+def dropped_writes_total() -> int:
+  """Process-wide silently-dropped JSONL writes (the driver's summary
+  export and the SLO engine's dropped_writes objective both read this
+  instead of reaching for the private counter)."""
+  return _DROPPED_WRITES.value
 
 
 class JsonlAppender:
@@ -572,6 +589,15 @@ class PipelineTracer:
     self._m_dropped = counter('trace/dropped_records')
     self._h_lag = histogram('trace/policy_lag')
     self._h_e2e = histogram('trace/e2e_ms')
+    # Flight-recorder occupancy (round 14): fn-gauges over the ring so
+    # the registry snapshot (and the driver's summary export) can say
+    # how much incident history a dump would ship. Unregistered at
+    # close() — they close over this per-run tracer's flight ring.
+    self._flight_gauges = [
+        gauge('trace/flight_records', fn=lambda: len(self.flight)),
+        gauge('trace/flight_snapshots',
+              fn=lambda: self.flight.snapshots_held),
+    ]
 
   @property
   def path(self) -> str:
@@ -713,6 +739,8 @@ class PipelineTracer:
 
   def close(self):
     self._writer.close()
+    for g in self._flight_gauges:
+      _REGISTRY.unregister(g.name, g)
 
 
 _tracer_lock = threading.Lock()

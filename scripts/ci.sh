@@ -111,11 +111,84 @@ if orphaned:
           'scalable_agent_tpu/):')
     for n in orphaned:
         print(f'  {n}')
-if undocumented or orphaned:
+# Round 14: the SLO layer rides the same static contract. Every
+# DEFAULT objective's metric must be a REGISTERED name (an objective
+# judging a metric nobody registers silently evaluates as no_data
+# forever — that is a CI failure, not a shrug), and the
+# docs/OBSERVABILITY.md SLO inventory table must match the shipped
+# default set by NAME, both directions.
+slo_src = pathlib.Path('scalable_agent_tpu/slo.py').read_text()
+slo_metrics = set(re.findall(r"metric='([a-z0-9_]+(?:/[a-z0-9_]+)+)'",
+                             slo_src))
+slo_names = set(re.findall(r"Objective\(name='([a-z0-9_]+)'",
+                           slo_src))
+unregistered = sorted(slo_metrics - registered)
+doc_slo = set(re.findall(
+    r"^\|\s*`([a-z0-9_]+)`\s*\|\s*`[a-z0-9_]+(?:/[a-z0-9_]+)+`",
+    doc, re.MULTILINE))
+undoc_slo = sorted(slo_names - doc_slo)
+orphan_slo = sorted(doc_slo - slo_names)
+if unregistered:
+    print('SLO objectives over UNREGISTERED metrics:')
+    for n in unregistered:
+        print(f'  {n}')
+if undoc_slo:
+    print('SLO objectives missing from the docs/OBSERVABILITY.md '
+          'inventory table:')
+    for n in undoc_slo:
+        print(f'  {n}')
+if orphan_slo:
+    print('ORPHANED documented SLO objectives (not in '
+          'slo.DEFAULT_OBJECTIVES):')
+    for n in orphan_slo:
+        print(f'  {n}')
+if undocumented or orphaned or unregistered or undoc_slo or orphan_slo:
     sys.exit(1)
 print(f'metric-name lint OK: {len(registered)} registered names all '
-      'documented, none orphaned')
+      f'documented, none orphaned; {len(slo_names)} SLO objectives '
+      'over registered metrics, inventory in sync')
 LINT_EOF
+
+echo '== slo lane (round 14: declarative objectives over the registry,'
+echo '   burn-rate evaluation, triggered deep diagnostics, the'
+echo '   SLO_VERDICT.json go/no-go artifact + slo_report regression'
+echo '   gate; then a tiny driver run asserting the verdict lands with'
+echo '   every default objective evaluated and zero captures on a'
+echo '   clean run, and the tiny evaluator/capture bench rows — <90 s'
+echo '   CPU) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_slo.py -q \
+  -p no:cacheprovider
+JAX_PLATFORMS=cpu python - <<'SLO_EOF'
+import json, logging, os, subprocess, sys, tempfile
+logging.basicConfig(level=logging.WARNING)
+sys.path.insert(0, os.getcwd())
+from scalable_agent_tpu import driver, slo
+from scalable_agent_tpu.config import Config
+logdir = tempfile.mkdtemp(prefix='ci_slo_')
+cfg = Config(logdir=logdir, env_backend='bandit', num_actors=2,
+             batch_size=2, unroll_length=5, num_action_repeats=1,
+             episode_length=4, height=24, width=32, torso='shallow',
+             use_py_process=False, use_instruction=False,
+             total_environment_frames=10**9, inference_timeout_ms=5,
+             checkpoint_secs=0, summary_secs=0, seed=11)
+driver.train(cfg, max_steps=6, stall_timeout_secs=60)
+verdict = slo.read_verdict(logdir)
+assert verdict is not None, 'no SLO_VERDICT.json from the clean run'
+assert verdict['pass'], f"clean run verdict FAILED: {verdict['violations']}"
+assert not verdict['captures'], 'clean run triggered captures'
+expected = {o.name for o in slo.DEFAULT_OBJECTIVES}
+got = set(verdict['objectives'])
+assert got == expected, f'verdict objectives {got ^ expected} out of sync'
+for name, e in verdict['objectives'].items():
+    assert e['state'] in ('ok', 'no_data', 'no_baseline'), (name, e)
+# The go/no-go gate agrees: slo_report exits 0 on the passing verdict.
+rc = subprocess.run([sys.executable, 'scripts/slo_report.py', logdir],
+                    stdout=subprocess.DEVNULL).returncode
+assert rc == 0, f'slo_report exited {rc} on a passing verdict'
+print(f'slo lane OK: {len(got)} objectives evaluated, verdict PASS, '
+      'zero captures, slo_report gate green')
+SLO_EOF
+BENCH_SMOKE=1 BENCH_ONLY=slo python bench.py
 
 echo '== telemetry smoke (trace spans end to end: registry semantics,'
 echo '   tracer pipeline, v8 negotiation + remote stamping,'

@@ -1,0 +1,114 @@
+"""Live learner telemetry from the v8 `stats` control-lane request.
+
+    python scripts/fleet_stats.py HOST:PORT [--json] [--filter ingest]
+
+Connects to a RUNNING learner's trajectory ingest port (the same one
+actor hosts use), issues the round-13 `stats` request
+(RemoteActorClient.fetch_stats), and pretty-prints the reply — the
+unified metrics-registry snapshot plus the ingest server's stats
+surface — for live operator debugging: no logdir access, no restart,
+no summaries.jsonl dig. Histograms render as count/p50/p99/max rows;
+`--filter` substring-matches names; `--json` dumps the raw reply.
+
+The request rides a real handshake-free connection: `stats` is served
+on the trajectory lane before any contract is offered, so this tool
+never has to know the run's env/agent shapes.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _fmt(v):
+  if v is None:
+    return '-'
+  if isinstance(v, float):
+    if math.isnan(v):
+      return '-'
+    return f'{v:.3f}'.rstrip('0').rstrip('.')
+  return str(v)
+
+
+def render(stats, name_filter=''):
+  out = []
+  w = out.append
+  registry = stats.get('registry') or {}
+  ingest = stats.get('ingest') or {}
+  w('== metrics registry (%d names) ==' % len(registry))
+  scalars = {}
+  hists = {}
+  for name, value in registry.items():
+    if name_filter and name_filter not in name:
+      continue
+    (hists if isinstance(value, dict) else scalars)[name] = value
+  for name in sorted(scalars):
+    w(f'  {name:<44} {_fmt(scalars[name])}')
+  if hists:
+    w(f"  {'-- histograms --':<44} "
+      f"{'count':>8} {'p50':>10} {'p99':>10} {'max':>10}")
+    for name in sorted(hists):
+      h = hists[name]
+      w(f"  {name:<44} {_fmt(h.get('count')):>8} "
+        f"{_fmt(h.get('p50')):>10} {_fmt(h.get('p99')):>10} "
+        f"{_fmt(h.get('max')):>10}")
+  w('')
+  w('== ingest server ==')
+  for key in sorted(ingest):
+    value = ingest[key]
+    if name_filter and name_filter not in key:
+      continue
+    if isinstance(value, dict):
+      w(f'  {key}:')
+      for sub in sorted(value):
+        w(f'    {sub:<42} {_fmt(value[sub])}')
+    else:
+      w(f'  {key:<44} {_fmt(value)}')
+  return '\n'.join(out)
+
+
+def fetch(address, connect_timeout_secs=10.0):
+  """One fetch_stats round trip against a live learner. Separated
+  from main() so the smoke test can drive it against an in-process
+  ingest server."""
+  from scalable_agent_tpu.runtime import remote
+  client = remote.RemoteActorClient(
+      address, connect_timeout_secs=connect_timeout_secs)
+  try:
+    return client.fetch_stats()
+  finally:
+    client.close()
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(
+      description='pretty-print a live learner\'s v8 stats reply '
+                  '(registry + ingest)')
+  parser.add_argument('address', help='learner ingest HOST:PORT')
+  parser.add_argument('--json', action='store_true',
+                      help='dump the raw reply as JSON instead')
+  parser.add_argument('--filter', default='',
+                      help='substring filter on metric/stat names')
+  parser.add_argument('--timeout', type=float, default=10.0,
+                      help='connect timeout seconds')
+  args = parser.parse_args(argv)
+  try:
+    stats = fetch(args.address, connect_timeout_secs=args.timeout)
+  except Exception as e:
+    print(f'could not fetch stats from {args.address!r}: {e}',
+          file=sys.stderr)
+    return 1
+  if args.json:
+    print(json.dumps(stats, indent=2, sort_keys=True, default=str))
+  else:
+    print(render(stats, name_filter=args.filter))
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -486,6 +486,31 @@ def main():
     if trace_summary['batches'] == 0:
       problems.append('telemetry_trace on but traces.jsonl carries '
                       'zero batch records over the soak window')
+  # Round 14: the run judged itself continuously (slo.py default
+  # objective set) — a soak whose SLO verdict fails is a red soak
+  # naming the objective, and the soak artifact carries the verdict
+  # so chip-run triage starts from margins, not raw counters.
+  from scalable_agent_tpu import slo as slo_lib
+  slo_verdict = slo_lib.read_verdict(logdir)
+  slo_block = None
+  if cfg.slo_engine:
+    if slo_verdict is None:
+      problems.append('slo_engine on but the run wrote no '
+                      'SLO_VERDICT.json')
+    else:
+      slo_block = {
+          'pass': slo_verdict.get('pass'),
+          'violations': slo_verdict.get('violations') or [],
+          'captures': sorted(slo_verdict.get('captures') or {}),
+          'margins': {
+              name: e.get('margin')
+              for name, e in
+              (slo_verdict.get('objectives') or {}).items()},
+      }
+      if not slo_verdict.get('pass'):
+        problems.append(
+            'SLO verdict FAILED over the soak window: '
+            + ', '.join(slo_verdict.get('violations') or ['?']))
   if steps < (20 if not smoke else 2):
     problems.append(f'only {steps} learner steps in {seconds:.0f}s')
   if not losses or not np.all(np.isfinite(losses)):
@@ -591,6 +616,7 @@ def main():
                              if sigmas_max else None),
       'integrity': integrity_final,
       'telemetry': telemetry_block,
+      'slo': slo_block,
       'churn': churn_artifact,
       'stack': {
           'torso': cfg.torso, 'compute_dtype': cfg.compute_dtype,

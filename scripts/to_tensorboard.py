@@ -95,6 +95,8 @@ def _trace_events(event):
   for span in event.get('spans') or []:
     span_deltas, e2e = span_hop_deltas(span)
     for (n0, n1), ms in span_deltas:
+      if ms is None:  # clock-skewed cross-host hop: no fake 0 point
+        continue
       deltas.setdefault(f'trace/hop_{n0}_{n1}_ms', []).append(ms)
     if e2e is not None:
       deltas.setdefault('trace/e2e_ms', []).append(e2e)

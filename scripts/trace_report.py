@@ -41,19 +41,31 @@ HOP_ORDER = ('done', 'send', 'wire', 'commit', 'staged', 'serve',
 
 def span_hop_deltas(span):
   """One span's `[hop, wall_time]` list → (adjacent-hop deltas, e2e):
-  `([((hop_from, hop_to), ms), ...], e2e_ms_or_None)`. Keeps the
-  FIRST stamp per hop name in pipeline order — a resend re-stamps
-  send/wire, and the first traversal is the latency story. The ONE
+  `([((hop_from, hop_to), ms_or_None), ...], e2e_ms_or_None)`. Keeps
+  the FIRST stamp per hop name in pipeline order — a resend re-stamps
+  send/wire, and the first traversal is the latency story. A NEGATIVE
+  raw delta (cross-host wall clocks skew past each other — NTP,
+  docs/OBSERVABILITY.md) yields ms=None: the report renders '-'
+  instead of laundering skew into a fake 0-ms latency, and consumers
+  (summarize, to_tensorboard) skip None rows. Malformed stamp entries
+  (wrong arity, non-numeric time) are ignored, never a crash — this
+  runs over streams written by crashed/buggy peers. The ONE
   implementation behind summarize() and to_tensorboard's trace
   conversion, so the two views can never disagree on a hop."""
   seen = {}
-  for name, t in span.get('h') or []:
+  for entry in span.get('h') or []:
+    try:
+      name, t = entry
+      t = float(t)
+    except (TypeError, ValueError):
+      continue
     seen.setdefault(name, t)
   ordered = [(n, seen[n]) for n in HOP_ORDER if n in seen]
-  deltas = [((n0, n1), max(t1 - t0, 0.0) * 1e3)
+  deltas = [((n0, n1), (t1 - t0) * 1e3 if t1 >= t0 else None)
             for (n0, t0), (n1, t1) in zip(ordered, ordered[1:])]
-  e2e = ((ordered[-1][1] - ordered[0][1]) * 1e3
-         if len(ordered) >= 2 else None)
+  e2e = None
+  if len(ordered) >= 2 and ordered[-1][1] >= ordered[0][1]:
+    e2e = (ordered[-1][1] - ordered[0][1]) * 1e3
   return deltas, e2e
 
 
@@ -159,7 +171,8 @@ def summarize(records, incidents=()):
         actors.add(span.get('a'))
         deltas, e2e = span_hop_deltas(span)
         for pair, ms in deltas:
-          hop_deltas[pair].append(ms)
+          if ms is not None:  # clock-skewed hops render '-', not 0
+            hop_deltas[pair].append(ms)
         if e2e is not None:
           e2e_ms.append(e2e)
   hop_rows = []

@@ -64,6 +64,64 @@ def _read_jsonl(path):
 # --- admission control -------------------------------------------------
 
 
+def test_admission_denied_slots_quarantine_deterministically():
+  """Round-14 regression pin for the overload-storm quarantine flake:
+  slots whose every (re)spawn is denied by inference-slot admission
+  must quarantine after EXACTLY quarantine_after+1 consecutive
+  denials, driven purely by check_health calls — never by wall-clock
+  luck. The storm used to assert `slots_quarantined == fleet - slots`
+  against a fixed SIGTERM timer and lost the race to the full-jitter
+  respawn backoff 7/12 seeds; the harness now gates its SIGTERM on
+  the quarantine incident ledger, and THIS test pins the ladder's
+  determinism the gate relies on (zero-jitter backoff: the count is a
+  function of health checks alone)."""
+  import random
+  from scalable_agent_tpu.runtime.fleet import ActorFleet
+  from scalable_agent_tpu.runtime.remote import Backoff
+
+  class _ZeroJitter(random.Random):
+    def uniform(self, a, b):
+      return 0.0
+
+  quarantine_after = 2
+  spawn_attempts = {0: 0, 1: 0}
+
+  def make_actor(i):
+    spawn_attempts[i] += 1
+    raise SlotUnavailable(f'arena exhausted (slot {i})')
+
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  fleet = ActorFleet(make_actor, buffer, num_actors=2,
+                     quarantine_after=quarantine_after)
+  for slot in fleet._slots:
+    slot.backoff = Backoff(base=1e-6, cap=1e-6, rng=_ZeroJitter())
+  fleet.start()  # start-time denials degrade (streak 1), never raise
+  assert fleet.stats()['slots_quarantined'] == 0
+  checks = 0
+  while fleet.stats()['slots_quarantined'] < 2:
+    fleet.check_health()
+    checks += 1
+    assert checks <= 2 * (quarantine_after + 2), (
+        'quarantine did not complete within a deterministic number '
+        f'of health checks (attempts: {spawn_attempts})')
+  # Exactly fleet-minus-capacity slots quarantined — the storm's SLO.
+  assert fleet.stats()['slots_quarantined'] == 2
+  # The ladder's arithmetic: the start denial is streak 1; each
+  # respawn bumps the streak and spawns only while streak <=
+  # quarantine_after; the attempt that pushes the streak past the
+  # budget quits WITHOUT spawning. Total spawn attempts per slot ==
+  # quarantine_after, exactly.
+  assert spawn_attempts == {0: quarantine_after,
+                            1: quarantine_after}
+  # Quarantined slots are terminal: no further spawns ever.
+  for _ in range(3):
+    assert fleet.check_health() == []
+  assert spawn_attempts == {0: quarantine_after,
+                            1: quarantine_after}
+  fleet.stop(timeout=2)
+  buffer.close()
+
+
 def test_block_waitlist_hands_over_released_slot():
   """block policy: an exhausted acquire PARKS; releasing a slot hands
   it to the waiter directly, and the stale handle cannot touch its

@@ -355,7 +355,11 @@ def test_prefetcher_completes_spans_through_feed(tmp_path):
 
 def test_trace_report_summarize_hops_and_lag(tmp_path):
   tracer = telemetry.PipelineTracer(str(tmp_path))
-  t0 = time.time()
+  # Backdated synthetic stamps: the staged/serve/step hops are
+  # stamped at REAL now by the tracer, and the round-14 skew rule
+  # drops negative deltas — future-dated done/send/wire stamps would
+  # read as clock skew.
+  t0 = time.time() - 30.0
   tracer.on_publish(1)
   for step in range(3):
     u = _tiny_unroll(step)
@@ -367,7 +371,8 @@ def test_trace_report_summarize_hops_and_lag(tmp_path):
     tracer.on_batch([u], n_fresh=1)
     tracer.on_serve()
     tracer.on_step(step)
-  tracer.on_install('a0', 1, t0 + 0.5)
+  # Install AFTER the publish in record order (summarize sorts by t).
+  tracer.on_install('a0', 1, time.time() + 0.5)
   tracer.close()
 
   records = trace_report.load_traces(str(tmp_path))
@@ -389,6 +394,76 @@ def test_trace_report_render_handles_empty():
   summary = trace_report.summarize([])
   text = trace_report.render(summary)
   assert '-' in text  # NaN percentiles render as '-'
+
+
+def test_span_hop_deltas_duplicate_resend_stamps():
+  """A resend re-stamps send/wire; the FIRST stamp per hop is the
+  latency story (round-14 satellite: pinned on a pathological
+  stream, not just documented)."""
+  span = {'h': [['done', 10.0], ['send', 10.5], ['wire', 11.0],
+                ['send', 13.0], ['wire', 14.0], ['commit', 11.2]]}
+  deltas, e2e = trace_report.span_hop_deltas(span)
+  assert dict(((a, b), ms) for (a, b), ms in deltas) == {
+      ('done', 'send'): pytest.approx(500.0),
+      ('send', 'wire'): pytest.approx(500.0),
+      ('wire', 'commit'): pytest.approx(200.0, abs=1e-6)}
+  assert e2e == pytest.approx(1200.0)
+
+
+def test_span_hop_deltas_clock_skew_renders_dash_never_zero():
+  """Cross-host wall clocks can skew past each other (NTP): a
+  negative hop delta must surface as '-' (None), never a laundered
+  0 ms — and never a crash."""
+  span = {'h': [['done', 100.0], ['send', 100.2], ['wire', 99.8],
+                ['commit', 100.4]]}
+  deltas, e2e = trace_report.span_hop_deltas(span)
+  by_pair = dict(deltas)
+  assert by_pair[('send', 'wire')] is None          # skewed: no number
+  assert by_pair[('wire', 'commit')] == pytest.approx(600.0)
+  assert e2e == pytest.approx(400.0)                # done <= commit
+  # A span whose LAST hop skews before its first: no e2e either.
+  skewed = {'h': [['done', 100.0], ['send', 99.0]]}
+  deltas, e2e = trace_report.span_hop_deltas(skewed)
+  assert deltas == [(('done', 'send'), None)] and e2e is None
+  # summarize() skips the skewed hops instead of polluting p50 with
+  # zeros, and the renderer stays crash-free.
+  rec = {'k': 'batch', 'step': 1, 't': 100.0, 'lag': [],
+         'spans': [span, skewed]}
+  summary = trace_report.summarize([rec])
+  hops = {row['hop']: row for row in summary['hops']}
+  assert 'send->wire' not in hops  # only skewed observations existed
+  assert hops['wire->commit']['count'] == 1
+  assert trace_report.render(summary)
+
+
+def test_span_hop_deltas_malformed_stamps_never_crash():
+  for h in (None, 'junk', [['done']], [['done', 'not-a-time']],
+            [[1, 2, 3]], [None]):
+    deltas, e2e = trace_report.span_hop_deltas({'h': h})
+    assert deltas == [] and e2e is None
+
+
+def test_trace_report_main_empty_traces_file(tmp_path, capsys):
+  """An empty traces.jsonl (a run that died before its first batch)
+  exits 1 with the how-to hint, never a crash."""
+  (tmp_path / 'traces.jsonl').write_text('')
+  assert trace_report.main([str(tmp_path)]) == 1
+  assert 'no traces' in capsys.readouterr().err
+
+
+def test_to_tensorboard_skips_skewed_hop_points():
+  """to_tensorboard consumes the same span_hop_deltas: a skewed hop
+  contributes NO scalar point (round-14 satellite — the two views
+  keep agreeing)."""
+  from scripts import to_tensorboard
+  event = {'k': 'batch', 'step': 3, 'lag': [1],
+           'spans': [{'h': [['done', 100.0], ['send', 99.0],
+                            ['wire', 100.5]]}]}
+  rows = to_tensorboard._trace_events(event)
+  tags = [t for t, _, _ in rows]
+  assert 'trace/hop_done_send_ms' not in tags  # skewed: skipped
+  assert 'trace/hop_send_wire_ms' in tags
+  assert 'trace/policy_lag_mean' in tags
 
 
 # --------------------------------------------------------------------
@@ -458,7 +533,11 @@ def test_e2e_remote_fleet_traces_and_report(tmp_path):
   with open(os.path.join(str(tmp_path), 'summaries.jsonl')) as f:
     tags = {json.loads(line)['tag'] for line in f if line.strip()}
   for tag in ('policy_lag_p50', 'policy_lag_p99', 'unroll_e2e_p50_ms',
-              'unroll_e2e_p99_ms', 'trace_untagged_unrolls'):
+              'unroll_e2e_p99_ms', 'trace_untagged_unrolls',
+              # Round-14 satellites: the flight-recorder ring and the
+              # JSONL dropped-writes ledger reach summaries.jsonl end
+              # to end (before, only the trace scalars were asserted).
+              'trace_flight_records', 'dropped_writes'):
     assert tag in tags, tag
 
 
@@ -484,6 +563,34 @@ def test_health_counters_reach_registry():
   snap = telemetry.registry().snapshot()
   assert snap['health/skipped_steps'] == 1
   assert snap['health/flagged_steps'] == 1
+
+
+def test_flight_recorder_gauges_registered_and_unregistered(tmp_path):
+  """Round-14 satellite: the tracer registers fn-gauges over its
+  flight ring (trace/flight_records, trace/flight_snapshots) and
+  unregisters them at close — identity-checked like every other
+  per-run fn-gauge."""
+  reg = telemetry.registry()
+  tracer = telemetry.PipelineTracer(str(tmp_path))
+  try:
+    tracer.flight.record({'k': 'batch', 'step': 1})
+    tracer.flight.note_registry({'a': 1})
+    snap = reg.snapshot()
+    assert snap['trace/flight_records'] == 1
+    assert snap['trace/flight_snapshots'] == 1
+    assert len(tracer.flight) == 1
+  finally:
+    tracer.close()
+  assert reg.get('trace/flight_records') is None
+  assert reg.get('trace/flight_snapshots') is None
+
+
+def test_dropped_writes_total_counts_post_close_writes(tmp_path):
+  before = telemetry.dropped_writes_total()
+  writer = telemetry.JsonlAppender(str(tmp_path), 'x.jsonl')
+  writer.close()
+  writer.write({'late': True})
+  assert telemetry.dropped_writes_total() == before + 1
 
 
 def test_trace_report_hop_order_matches_telemetry():
