@@ -2006,6 +2006,134 @@ def bench_slo(smoke):
   return results
 
 
+def bench_controller(smoke):
+  """Self-healing-controller overhead (round 15; controller.py): the
+  cost of the verdict-to-actuation loop, measured so the default
+  observe-mode thread is an accept/reject call with numbers. Rows:
+
+  a) idle tick: one Controller.tick over a healthy snapshot with the
+     default rule set — the steady-state cost the controller thread
+     pays every interval (nothing burning, nothing engaged);
+  b) acting tick: the same tick while a rule is escalating — includes
+     the actuator set, the CONTROLLER_LOG.json rewrite, and the
+     incident emission (paid only when a knob actually moves);
+  c) full escalate->revert cycle wall time through a real SloEngine
+     snapshot path (the engine lock + deep copy included).
+  """
+  import shutil
+  from scalable_agent_tpu import controller as controller_lib
+  from scalable_agent_tpu import slo as slo_lib
+  from scalable_agent_tpu import telemetry
+
+  results = {}
+  tmpdir = tempfile.mkdtemp(prefix='bench_ctrl_')
+
+  class _Engine:
+    def __init__(self, snap):
+      self.snap = snap
+
+    def control_snapshot(self):
+      return {n: dict(e) for n, e in self.snap.items()}
+
+  def _entry(state, margin):
+    return {'state': state, 'margin': margin, 'value': margin,
+            'severity': 'page', 'target': 1.0, 'burns': 0}
+
+  rules = controller_lib.load_rules()
+  results['rules'] = len(rules)
+  healthy = {r.objective: _entry(slo_lib.OK, 10.0) for r in rules}
+  knobs = {'replay_k': 1, 'admission': 'block', 'publish_secs': 2.0,
+           'fleet_size': 4}
+
+  def _actuators():
+    acts = []
+    for name, lo, hi in (('replay_k', 1, 4), ('publish_secs', 2.0,
+                                              30.0),
+                         ('fleet_size', 1, 64)):
+      acts.append(controller_lib.Actuator(
+          name, kind='float' if name == 'publish_secs' else 'int',
+          get_fn=lambda n=name: knobs[n],
+          set_fn=lambda v, n=name: knobs.__setitem__(n, v),
+          minimum=lo, maximum=hi))
+    acts.append(controller_lib.Actuator(
+        'admission', kind='enum',
+        get_fn=lambda: knobs['admission'],
+        set_fn=lambda v: knobs.__setitem__('admission', v),
+        values=('block', 'shed', 'grow')))
+    return acts
+
+  # --- (a) idle tick over the default table. ---
+  engine = _Engine(healthy)
+  ctrl = controller_lib.Controller(engine, rules, _actuators(),
+                                   tmpdir, mode='act',
+                                   interval_secs=3600.0)
+  n = 20_000 if not smoke else 1_000
+  t0 = time.perf_counter()
+  for i in range(n):
+    ctrl.tick(now=float(i))
+  dt = time.perf_counter() - t0
+  results['idle_tick_us'] = round(dt / n * 1e6, 2)
+  ctrl.stop()
+
+  # --- (b) acting tick: one rule escalating every tick (cooldown 0,
+  # bounded knob reset each round so a set really happens). ---
+  burning = dict(healthy)
+  burning['fleet_healthy_fraction'] = _entry(slo_lib.BURNING, -0.5)
+  hot_rule = controller_lib.Rule(
+      objective='fleet_healthy_fraction', actuator='fleet_size',
+      direction='up', step=1, cooldown_secs=0.0, clear_margin=0.5)
+  ctrl = controller_lib.Controller(_Engine(burning), [hot_rule],
+                                   _actuators(), tmpdir, mode='act',
+                                   interval_secs=3600.0)
+  n = 300 if not smoke else 50
+  t0 = time.perf_counter()
+  for i in range(n):
+    knobs['fleet_size'] = 4
+    ctrl.tick(now=float(i))
+  dt = time.perf_counter() - t0
+  results['acting_tick_us'] = round(dt / n * 1e6, 2)
+  ctrl.stop()
+
+  # --- (c) escalate->revert cycle through a REAL SloEngine. ---
+  reg = telemetry.MetricsRegistry()
+  gauge = reg.gauge('driver/fleet_healthy_fraction')
+  gauge.set(1.0)
+  objective = slo_lib.Objective(
+      name='fleet_healthy_fraction',
+      metric='driver/fleet_healthy_fraction', comparison='>=',
+      target=0.6, severity='page', fast_window_secs=2.0,
+      slow_window_secs=8.0)
+  engine2 = slo_lib.SloEngine([objective], tmpdir, registry=reg,
+                              capture=False, min_samples=2)
+  cycle_rule = controller_lib.Rule(
+      objective='fleet_healthy_fraction', actuator='fleet_size',
+      direction='up', step=1, trigger_margin=0.2, clear_margin=0.3,
+      cooldown_secs=0.0)
+  knobs['fleet_size'] = 4
+  ctrl = controller_lib.Controller(engine2, [cycle_rule],
+                                   _actuators(), tmpdir, mode='act',
+                                   interval_secs=3600.0)
+  t0 = time.perf_counter()
+  now = 1000.0
+  gauge.set(0.5)
+  for _ in range(4):
+    now += 1.0
+    engine2.observe(now=now)
+  actions = ctrl.tick(now=now)
+  gauge.set(1.0)
+  for _ in range(4):
+    now += 1.0
+    engine2.observe(now=now)
+  actions += ctrl.tick(now=now)
+  results['cycle_wall_ms'] = round((time.perf_counter() - t0) * 1e3,
+                                   3)
+  results['cycle_actions'] = len(actions)
+  ctrl.stop()
+  engine2.stop()
+  shutil.rmtree(tmpdir, ignore_errors=True)
+  return results
+
+
 def main():
   # BENCH_SMOKE=1: tiny shapes on CPU — validates bench mechanics in CI
   # without the chip. The driver runs the real thing (no env var, TPU).
@@ -2087,6 +2215,19 @@ def main():
     })
     return
 
+  # BENCH_ONLY=controller: just the controller-loop rows (the
+  # scripts/ci.sh controller lane — idle/acting tick + cycle cost).
+  if os.environ.get('BENCH_ONLY') == 'controller':
+    ctrl_rows = bench_controller(smoke)
+    _emit({
+        'metric': 'controller_idle_tick_us',
+        'value': ctrl_rows.get('idle_tick_us'),
+        'unit': ('microseconds per idle controller tick, default '
+                 'rule table%s' % (' (SMOKE)' if smoke else '')),
+        'controller': ctrl_rows,
+    })
+    return
+
   # BENCH_ONLY=overload: just the overload rows (the scripts/ci.sh
   # chaos-adjacent smoke — shed-rate/tail-latency mechanics on CPU).
   if os.environ.get('BENCH_ONLY') == 'overload':
@@ -2138,6 +2279,9 @@ def main():
   slo_rows = None
   if os.environ.get('BENCH_SKIP_SLO') != '1':
     slo_rows = bench_slo(smoke)
+  ctrl_rows = None
+  if os.environ.get('BENCH_SKIP_CONTROLLER') != '1':
+    ctrl_rows = bench_controller(smoke)
 
   baseline_per_chip = 200_000.0 / 16.0  # north star / v5e-16 chips
   out = {
@@ -2183,6 +2327,8 @@ def main():
     out['telemetry'] = tele
   if slo_rows is not None:
     out['slo'] = slo_rows
+  if ctrl_rows is not None:
+    out['controller'] = ctrl_rows
   _emit(out)
 
 
@@ -2309,6 +2455,16 @@ def _headline(out):
         'verdict_us': slo_rows.get('verdict_us'),
         'capture_overhead_fraction':
             slo_rows.get('capture_overhead_fraction')}
+  # The controller-loop cost (round 15): idle/acting tick + the full
+  # escalate->revert cycle — the numbers the default observe-mode
+  # thread is accepted/rejected on, clip-safe like every other
+  # default-flip record.
+  ctrl_rows = out.get('controller')
+  if ctrl_rows:
+    head['controller'] = {
+        'idle_tick_us': ctrl_rows.get('idle_tick_us'),
+        'acting_tick_us': ctrl_rows.get('acting_tick_us'),
+        'cycle_wall_ms': ctrl_rows.get('cycle_wall_ms')}
   return head
 
 

@@ -414,6 +414,37 @@ flags.DEFINE_string('slo_fps_baseline', _DEFAULTS.slo_fps_baseline,
                     'scripts/slo_report.py --update-fps-baseline '
                     'records one). Empty = objective reads '
                     'no_baseline.')
+# --- Self-healing controller (round 15; controller.py,
+# docs/RUNBOOK.md §12). ---
+flags.DEFINE_enum('controller', _DEFAULTS.controller,
+                  ['off', 'observe', 'act'],
+                  'Verdict-to-actuation loop over the SLO engine: '
+                  'observe (default) dry-runs the policy table into '
+                  'CONTROLLER_LOG.json; act applies the bounded '
+                  'moves (replay_k, admission mode, publish '
+                  'cadence, fleet size); off removes the thread. '
+                  'CHAOS_STORM=controller is the acceptance drill.')
+flags.DEFINE_string('controller_policy', _DEFAULTS.controller_policy,
+                    'JSON rule-list file; empty = the shipped '
+                    'controller.DEFAULT_RULES table '
+                    '(docs/OBSERVABILITY.md).')
+flags.DEFINE_float('controller_interval_secs',
+                   _DEFAULTS.controller_interval_secs,
+                   'Controller tick cadence (0 = share the SLO '
+                   "engine's derived interval).")
+flags.DEFINE_integer('controller_replay_k_max',
+                     _DEFAULTS.controller_replay_k_max,
+                     'Hard upper bound for the replay_k actuator '
+                     '(the bounded-move guarantee).')
+flags.DEFINE_float('controller_publish_secs_max',
+                   _DEFAULTS.controller_publish_secs_max,
+                   'Hard upper bound for the publish-cadence '
+                   'actuator, seconds.')
+flags.DEFINE_float('fleet_probation_secs',
+                   _DEFAULTS.fleet_probation_secs,
+                   'Quarantine probation cool-down before a '
+                   'rehabilitation attempt (fleet slots and the '
+                   "remote client's CRC self-quarantine).")
 flags.DEFINE_bool('health_watchdog', _DEFAULTS.health_watchdog,
                   'Learner failure domain (health.py): skip '
                   'non-finite updates on device, roll back to the '

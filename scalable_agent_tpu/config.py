@@ -433,6 +433,35 @@ class Config:
   # no_baseline, never a violation). scripts/slo_report.py
   # --update-fps-baseline records a known-good run into it.
   slo_fps_baseline: str = ''
+  # --- Self-healing controller (round 15; controller.py,
+  # docs/RUNBOOK.md §12). The verdict-to-actuation half of the
+  # control loop: a controller thread maps the SLO engine's burning
+  # set + margins to bounded actuator moves through a declarative
+  # policy table. 'observe' (default) is the dry run — every move the
+  # policy WOULD make is logged (CONTROLLER_LOG.json, applied:false)
+  # and nothing is touched; 'act' applies them (replay_k, admission
+  # mode, remote publish cadence, fleet size); 'off' removes the
+  # thread and the log. The acceptance drill is
+  # CHAOS_STORM=controller; cost is bench.py's `controller` stage. ---
+  controller: str = 'observe'             # off | observe | act
+  # Policy table: '' = controller.DEFAULT_RULES (the table in
+  # docs/OBSERVABILITY.md); a path loads a JSON rule list. A rule
+  # over an unknown actuator is a spin-up error.
+  controller_policy: str = ''
+  # Controller tick cadence (0 = derive from the SLO engine's
+  # interval — the judge and the actuator loop then share a clock).
+  controller_interval_secs: float = 0.0
+  # Hard upper bound the replay_k actuator may escalate to (the
+  # bounded-move guarantee; IMPACT's measured-safe reuse range).
+  controller_replay_k_max: int = 4
+  # Hard upper bound for the publish-cadence actuator, seconds.
+  controller_publish_secs_max: float = 30.0
+  # Quarantine probation (round 15): how long a quarantined fleet
+  # slot (or a self-quarantined remote client) must cool down before
+  # a rehabilitation attempt — one probe (re)spawn/unroll, then
+  # re-quarantine on repeat failure. The controller's grow-fleet move
+  # reclaims slots through this ladder (slots_rehabilitated).
+  fleet_probation_secs: float = 30.0
   # --- Learner failure domain (health.py, round 7). ---
   # Training-health watchdog: the train step skips non-finite updates
   # on device (params carry over unchanged) and the driver escalates
@@ -723,6 +752,63 @@ def validate_slo(config: Config) -> List[str]:
         'slo_capture=True with health_watchdog=False: SLO burns '
         'cannot feed the external-incident ledger (no monitor), so '
         'drain manifests and halt bundles will not name them')
+  return warnings
+
+
+def validate_controller(config: Config) -> List[str]:
+  """Validate the self-healing-controller knob group (round 15);
+  raises ValueError on hard errors, returns warnings (same contract
+  as the other validate_* groups — driver.train calls it before
+  spin-up). The policy file itself is loaded (and validated) by
+  controller.load_rules at construction; here the cross-links."""
+  warnings = []
+  if config.controller not in ('off', 'observe', 'act'):
+    raise ValueError(f'controller must be off|observe|act, got '
+                     f'{config.controller!r}')
+  if config.controller_interval_secs < 0:
+    raise ValueError(f'controller_interval_secs must be >= 0, got '
+                     f'{config.controller_interval_secs}')
+  if config.controller_replay_k_max < 1:
+    raise ValueError(f'controller_replay_k_max must be >= 1, got '
+                     f'{config.controller_replay_k_max}')
+  if config.controller_publish_secs_max <= 0:
+    raise ValueError(f'controller_publish_secs_max must be > 0, got '
+                     f'{config.controller_publish_secs_max}')
+  if config.fleet_probation_secs < 0:
+    raise ValueError(f'fleet_probation_secs must be >= 0, got '
+                     f'{config.fleet_probation_secs}')
+  if (config.remote_heartbeat_secs == 0
+      and config.remote_conn_idle_timeout_secs > 0
+      and config.fleet_probation_secs >
+      config.remote_conn_idle_timeout_secs):
+    warnings.append(
+        'fleet_probation_secs=%.1f exceeds the idle-reaping window '
+        '(remote_conn_idle_timeout_secs=%.1f) with heartbeats '
+        'disabled: a remote client cooling down in CRC probation '
+        'cannot ping, so the learner will reap it as half-open '
+        'mid-probation — enable heartbeats or shorten the cool-down'
+        % (config.fleet_probation_secs,
+           config.remote_conn_idle_timeout_secs))
+  if config.controller == 'off':
+    if config.controller_policy:
+      warnings.append(
+          'controller_policy=%r with controller=off: the policy '
+          'table is loaded by the controller — nothing will read it'
+          % config.controller_policy)
+    return warnings
+  if not config.slo_engine:
+    warnings.append(
+        'controller=%s with slo_engine=False: the controller\'s only '
+        'input is the SLO engine\'s burning set and margins — it '
+        'will be disabled for this run' % config.controller)
+  if (config.controller == 'act' and config.surrogate == 'vtrace'
+      and config.controller_replay_k_max > 1):
+    warnings.append(
+        'controller=act may raise replay_k up to %d, but '
+        'surrogate=vtrace has no clipped-target anchor against '
+        'reused data (IMPACT, arXiv 1912.00167) — consider '
+        '--surrogate=impact, or cap --controller_replay_k_max=1'
+        % config.controller_replay_k_max)
   return warnings
 
 

@@ -40,12 +40,14 @@ import jax.numpy as jnp
 from jax.experimental import multihost_utils
 
 from scalable_agent_tpu import checkpoint as checkpoint_lib
+from scalable_agent_tpu import controller as controller_lib
 from scalable_agent_tpu import health as health_lib
 from scalable_agent_tpu import learner as learner_lib
 from scalable_agent_tpu import observability
 from scalable_agent_tpu import slo as slo_lib
 from scalable_agent_tpu import telemetry
-from scalable_agent_tpu.config import (Config, validate_integrity,
+from scalable_agent_tpu.config import (Config, validate_controller,
+                                       validate_integrity,
                                        validate_replay, validate_slo,
                                        validate_transport)
 from scalable_agent_tpu.envs import factory, suites
@@ -186,7 +188,8 @@ def make_fleet(config: Config, agent, policy, buffer, levels,
     return env, process, actor
 
   return ActorFleet(make_actor, buffer, n,
-                    quarantine_after=config.fleet_quarantine_after)
+                    quarantine_after=config.fleet_quarantine_after,
+                    probation_secs=config.fleet_probation_secs)
 
 
 def _choose_eval_mesh():
@@ -254,6 +257,8 @@ class TrainRun:
     self.fps_meter = fps_meter
     self.ingest = ingest
     self.health = health  # HealthMonitor (None when watchdog is off)
+    self.controller = None  # controller.Controller (round 15), set
+                            # by train() when --controller != off
     # Set by train() when sample reuse is on: a closure over the
     # prefetcher's serve-time fresh-slot counter, so `frames` reports
     # FRESH env frames (reuse makes update_steps × frames_per_step an
@@ -346,6 +351,11 @@ def train(config: Config, max_steps: Optional[int] = None,
   # SLO knob group (round 14): hard range errors raise; cross-links
   # (engine without tracing, capture without the watchdog) log.
   for warning in validate_slo(config):
+    log.warning('%s', warning)
+  # Controller knob group (round 15): hard enum/range errors raise;
+  # cross-links (controller without the SLO engine, act-mode replay
+  # escalation without the IMPACT anchor) log.
+  for warning in validate_controller(config):
     log.warning('%s', warning)
   # NOTE round 8: the fused Pallas V-trace is no longer rejected under
   # a mesh — the sharded step runs it shard_map'ped over the data axis
@@ -476,6 +486,12 @@ def train(config: Config, max_steps: Optional[int] = None,
   incidents = None
   tracer = None
   slo_engine = None
+  ctrl = None
+  # The remote-publish cadence as a mutable cell (round 15): the loop
+  # below reads publish_cadence['secs'] instead of the frozen config
+  # field, so the controller's publish_secs actuator can stretch it
+  # live (a float store/load is GIL-atomic).
+  publish_cadence = {'secs': float(config.remote_publish_secs)}
   try:
     # --- Trajectory buffer + remote ingest, BEFORE inference warmup:
     # remote actor hosts connect and fetch params while this host
@@ -502,7 +518,13 @@ def train(config: Config, max_steps: Optional[int] = None,
         capacity, replay=replay_tier, replay_ratio=config.replay_ratio)
     buffer.note_param_version(_initial_steps)
     frames_per_unroll = config.unroll_length * config.num_action_repeats
-    reuse_on = config.replay_k > 1 or config.replay_ratio > 0
+    # Serve-time fresh-frame accounting is ALSO armed whenever an
+    # acting controller could raise replay_k mid-run (round 15): the
+    # steps-derived arithmetic would overcount env frames the moment
+    # the knob moves, and the serve-time counter is exact at
+    # replay_k=1 too.
+    reuse_on = (config.replay_k > 1 or config.replay_ratio > 0
+                or config.controller == 'act')
     # ONE localization for both the ingest snapshot and the inference
     # server, UNCONDITIONALLY before the ingest branch: actor_params
     # is a cross-host collective in multi-host-TP mode, and
@@ -756,6 +778,65 @@ def train(config: Config, max_steps: Optional[int] = None,
                    ingest=ingest, health=health)
     run._env_frames_fn = env_frames_fn
     fleet.start()
+    # --- Self-healing controller (round 15, controller.py): the
+    # verdict-to-actuation half of the control loop. The policy table
+    # maps the SLO engine's burning set + margins to bounded moves on
+    # the actuators this topology exposes: the prefetcher's replay_k,
+    # the inference server's admission mode, the remote publish
+    # cadence (the mutable cell below — the loop reads it instead of
+    # the frozen config field), and the fleet's elastic target size
+    # (grow = unpark/rehabilitate quarantined slots via probation).
+    # observe mode evaluates and logs every move without touching
+    # anything; the finally writes CONTROLLER_LOG.json either way. ---
+    if config.controller != 'off' and slo_engine is not None:
+      ctrl_rules = controller_lib.load_rules(config.controller_policy)
+      actuators = [
+          controller_lib.Actuator(
+              'replay_k', kind='int',
+              get_fn=lambda: prefetcher.replay_k,
+              set_fn=prefetcher.set_replay_k,
+              minimum=1,
+              maximum=max(config.controller_replay_k_max,
+                          config.replay_k)),
+          controller_lib.Actuator(
+              'admission', kind='enum',
+              get_fn=lambda: server.admission,
+              set_fn=server.set_admission,
+              values=inference_lib.ADMISSION_POLICIES),
+      ]
+      if ingest is not None:
+        actuators.append(controller_lib.Actuator(
+            'publish_secs', kind='float',
+            get_fn=lambda: publish_cadence['secs'],
+            set_fn=lambda v: publish_cadence.__setitem__(
+                'secs', float(v)),
+            minimum=float(config.remote_publish_secs),
+            maximum=max(config.controller_publish_secs_max,
+                        float(config.remote_publish_secs))))
+      if config.num_actors > 0 and hasattr(fleet, 'set_target_size'):
+        actuators.append(controller_lib.Actuator(
+            'fleet_size', kind='int',
+            get_fn=fleet.target_size,
+            set_fn=fleet.set_target_size,
+            minimum=1, maximum=config.num_actors))
+      ctrl_interval = (config.controller_interval_secs
+                       if config.controller_interval_secs > 0
+                       else slo_interval)
+      ctrl = controller_lib.Controller(
+          slo_engine, ctrl_rules, actuators, config.logdir,
+          mode=config.controller, interval_secs=ctrl_interval,
+          incidents=incidents, health=health,
+          log_name=('CONTROLLER_LOG.json' if process_index == 0
+                    else f'CONTROLLER_LOG_p{process_index}.json'))
+      run.controller = ctrl
+      ctrl.start()
+      log.info('controller started in %r mode: %d rule(s) over %d '
+               'actuator(s)', config.controller, len(ctrl._rules),
+               len(actuators))
+    elif config.controller != 'off':
+      log.warning('controller=%s ignored: the SLO engine is off and '
+                  'the controller has no other input',
+                  config.controller)
   except BaseException:
     # Best-effort bounded teardown, most-critical-first: the ingest
     # port release leads (a second interrupt landing mid-cleanup must
@@ -786,6 +867,8 @@ def train(config: Config, max_steps: Optional[int] = None,
     if tracer is not None:
       _try(lambda: telemetry.set_tracer(None))
       _try(tracer.close)
+    if ctrl is not None:
+      _try(ctrl.stop)  # no log finalize: the run never started
     if slo_engine is not None:
       _try(slo_engine.stop)  # no verdict: the run never started
     _try(checkpointer.close)
@@ -1224,7 +1307,7 @@ def train(config: Config, max_steps: Optional[int] = None,
         remote_version = None
         if (ingest is not None and
             time.monotonic() - last_remote_publish >=
-            config.remote_publish_secs and
+            publish_cadence['secs'] and
             ingest.stats()['live'] > 0):
           # Remote hosts poll-on-ack: publishing bumps the version the
           # next ack reports (the reference's per-run gRPC weight
@@ -1591,6 +1674,21 @@ def train(config: Config, max_steps: Optional[int] = None,
         if tracer is not None:
           writer.scalar('trace_flight_records', len(tracer.flight),
                         step_now)
+        # Controller surface (round 15): the action/revert counts and
+        # the live actuator state, so a knob the controller moved is
+        # visible in the same stream the objectives are judged from.
+        if ctrl is not None:
+          ctrl_counts = ctrl.counts()
+          writer.scalar('controller_actions', ctrl_counts['actions'],
+                        step_now)
+          writer.scalar('controller_reverts', ctrl_counts['reverts'],
+                        step_now)
+          writer.scalar('controller_engaged', ctrl.engaged_rules(),
+                        step_now)
+          writer.scalar('controller_replay_k', prefetcher.replay_k,
+                        step_now)
+          writer.scalar('controller_publish_secs',
+                        publish_cadence['secs'], step_now)
         # Step-synchronous SLO evaluation (round 14): the engine's
         # thread covers long summary gaps; this call makes detection
         # deterministic wherever summaries are frequent (chaos runs
@@ -1677,6 +1775,12 @@ def train(config: Config, max_steps: Optional[int] = None,
           # objectives were burning when the platform pulled the node.
           'slo': (slo_engine.verdict() if slo_engine is not None
                   else None),
+          # Controller state at drain time (round 15): what the run
+          # did to itself before the platform pulled the node —
+          # alongside the health ledger's controller_<actuator>
+          # entries.
+          'controller': (dict(ctrl.counts(), mode=ctrl.mode)
+                         if ctrl is not None else None),
           'drain_source': drain_source,
           'drain_latency_secs': round(drain_latency, 3),
           'wall_time': round(time.time(), 3),
@@ -1716,6 +1820,22 @@ def train(config: Config, max_steps: Optional[int] = None,
           checkpointer.save_errors, checkpointer.restore_fallbacks)
     except Exception:
       log.exception('robustness summary failed')
+    # Controller (round 15): stop the actuation thread FIRST (it
+    # reads the engine and moves component knobs — both about to be
+    # torn down) and write CONTROLLER_LOG.json on every exit path;
+    # the action log is the operator's record of what the run did to
+    # itself.
+    if ctrl is not None:
+      try:
+        ctrl.stop()
+        ctrl_counts = ctrl.finalize()
+        log.info('controller [%s]: %d action(s) (%d escalation(s), '
+                 '%d revert(s), %d applied) -> CONTROLLER_LOG.json',
+                 ctrl.mode, ctrl_counts['actions'],
+                 ctrl_counts['escalations'], ctrl_counts['reverts'],
+                 ctrl_counts['applied'])
+      except Exception:
+        log.exception('controller finalize failed')
     # SLO verdict (round 14): stop the evaluator thread and write the
     # per-run SLO_VERDICT.json — BEFORE component teardown, so the
     # final observation still sees every fn-gauge its objectives

@@ -90,7 +90,11 @@ class EventLog(_JsonlAppender):
   # 'slo' (round 14): an SLO violation/capture record is the page an
   # operator will be reading — it must survive the crash it may be
   # narrating.
-  _DURABLE_MARKERS = ('halt', 'rollback', 'sdc', 'quarantin', 'slo')
+  # 'controller' (round 15): a controller_action record is the
+  # self-healing audit trail — a knob the run moved on its own must
+  # survive whatever crash follows it.
+  _DURABLE_MARKERS = ('halt', 'rollback', 'sdc', 'quarantin', 'slo',
+                      'controller')
 
   def __init__(self, logdir: str, filename: str = 'incidents.jsonl'):
     super().__init__(logdir, filename)

@@ -67,6 +67,15 @@ class _Slot:
     self.backoff = Backoff(base=0.5, cap=30.0)
     self.next_respawn_time: float = 0.0
     self.quarantined: bool = False
+    # Elastic fleet (round 15): a PARKED slot is deliberately idle —
+    # excluded from spawning, health checks, and the quorum
+    # denominator (set_target_size is the controller's shrink/grow
+    # seam). `quarantined_at` feeds the probation cool-down;
+    # `probation` marks a rehabilitated slot whose NEXT failure
+    # re-quarantines immediately (one probe, not a fresh ladder).
+    self.parked: bool = False
+    self.quarantined_at: float = 0.0
+    self.probation: bool = False
 
 
 class ActorFleet:
@@ -82,13 +91,17 @@ class ActorFleet:
   """
 
   def __init__(self, make_actor: Callable, buffer, num_actors: int,
-               quarantine_after: int = 5):
+               quarantine_after: int = 5,
+               probation_secs: float = 30.0):
     self._make_actor = make_actor
     self._buffer = buffer
     self._quarantine_after = int(quarantine_after)
+    self._probation_secs = float(probation_secs)
     self._stop = threading.Event()
     self._lock = threading.Lock()
     self._slots: List[_Slot] = [_Slot(i) for i in range(num_actors)]
+    self._slots_rehabilitated = 0  # probation cleared by an unroll
+    self._rehabilitations = 0      # probation attempts started
 
   @property
   def stop_event(self):
@@ -96,6 +109,8 @@ class ActorFleet:
 
   def start(self):
     for slot in self._slots:
+      if slot.parked:
+        continue  # parked before start (elastic fleets spin up small)
       try:
         self._spawn(slot)
       except Exception as e:
@@ -150,10 +165,23 @@ class ActorFleet:
         slot.last_heartbeat = time.monotonic()
         slot.unrolls_done += 1
         # A completed unroll is the success signal that resets the
-        # respawn ladder: streak, backoff, and pacing all clear.
+        # respawn ladder: streak, backoff, and pacing all clear — and
+        # it is what clears PROBATION: a rehabilitated slot has
+        # proven itself only once it lands real data (round 15,
+        # counted as slots_rehabilitated).
         slot.respawn_streak = 0
         slot.backoff.reset()
         slot.next_respawn_time = 0.0
+        if slot.probation:
+          slot.probation = False
+          self._slots_rehabilitated += 1
+          log.info('actor %d REHABILITATED: probation unroll '
+                   'completed; the slot rejoins the fleet',
+                   slot.index)
+        if slot.parked:
+          # The controller shrank the fleet under us: land this
+          # unroll (already put), then exit the loop cleanly.
+          return False
         return True
 
     def on_failure(exc):
@@ -182,10 +210,13 @@ class ActorFleet:
     bad: List[_Slot] = []
     with self._lock:
       for slot in self._slots:
-        if slot.quarantined:
-          continue  # gave up on this slot; stats() carries the count
-        dead = slot.error is not None or (
-            slot.thread is not None and not slot.thread.is_alive())
+        if slot.quarantined or slot.parked:
+          continue  # gave up / deliberately idle; stats() carries both
+        # thread-None counts as dead (round 15): a slot unparked after
+        # never spawning (elastic grow) has no thread and no error —
+        # it must still be picked up here and spawned.
+        dead = (slot.error is not None or slot.thread is None
+                or not slot.thread.is_alive())
         stalled = (stall_timeout_secs is not None and
                    now - slot.last_heartbeat > stall_timeout_secs)
         # Respawn pacing: a failing slot is retried only once its
@@ -234,10 +265,17 @@ class ActorFleet:
       # before the health loop touches the slot again.
       slot.next_respawn_time = (time.monotonic()
                                 + slot.backoff.next_delay())
-      give_up = (self._quarantine_after > 0 and
-                 slot.respawn_streak > self._quarantine_after)
+      # Probation (round 15): a rehabilitated slot gets ONE probe
+      # (re)spawn — streak 1 is the probe itself; a second respawn
+      # without a completed unroll re-quarantines immediately instead
+      # of re-running the whole give-up ladder.
+      give_up = ((self._quarantine_after > 0 and
+                  slot.respawn_streak > self._quarantine_after) or
+                 (slot.probation and slot.respawn_streak > 1))
       if give_up:
         slot.quarantined = True
+        slot.quarantined_at = time.monotonic()
+        slot.probation = False
         slot.thread = None
     if give_up:
       log.error(
@@ -261,20 +299,103 @@ class ActorFleet:
         slot.error = e
         slot.thread = None
 
+  # --- elastic fleet size (round 15): the controller's actuator ---
+
+  def target_size(self) -> int:
+    """Contributing slots: neither parked nor quarantined — the value
+    the fleet-size actuator steps (growing past it first unparks,
+    then rehabilitates)."""
+    with self._lock:
+      return sum(1 for s in self._slots
+                 if not s.parked and not s.quarantined)
+
+  def set_target_size(self, n: int) -> Dict[str, List[int]]:
+    """Thread-safe elastic resize toward `n` contributing slots.
+
+    Shrink parks the highest-index contributing slots (each actor
+    exits cleanly after its current unroll — the on_unroll seam; a
+    parked slot leaves the quorum denominator, so shedding load never
+    reads as a dying fleet). Grow first UNPARKS parked slots, then
+    REHABILITATES quarantined ones whose probation cool-down has
+    elapsed: quarantine cleared, probation armed, respawn ladder
+    reset — the next check_health runs the probe spawn, and ONE
+    completed unroll clears probation (slots_rehabilitated); a repeat
+    failure re-quarantines immediately. The fleet never grows past
+    its constructed slot count (the bounded-move guarantee — the
+    controller's actuator registers that as the hard max).
+
+    Returns {'parked': [...], 'unparked': [...], 'rehabilitated':
+    [...]} slot indices. May deliver fewer than requested when every
+    remaining quarantined slot is still inside its cool-down — the
+    caller (controller) simply retries after its own cool-down."""
+    now = time.monotonic()
+    report = {'parked': [], 'unparked': [], 'rehabilitated': []}
+    with self._lock:
+      n = max(0, min(int(n), len(self._slots)))
+      contributing = [s for s in self._slots
+                      if not s.parked and not s.quarantined]
+      if n < len(contributing):
+        for slot in reversed(contributing[n:]):
+          slot.parked = True
+          report['parked'].append(slot.index)
+      elif n > len(contributing):
+        need = n - len(contributing)
+        for slot in self._slots:
+          if need == 0:
+            break
+          if slot.parked and not slot.quarantined:
+            slot.parked = False
+            # Spawn-eligible immediately: a slot parked since start
+            # has no thread; one parked mid-run has a finished one.
+            # Any error from before the park is a closed incident —
+            # it must not surface through errors() as the cause of
+            # whatever stalls the pipeline next.
+            slot.error = None
+            slot.next_respawn_time = 0.0
+            report['unparked'].append(slot.index)
+            need -= 1
+        if need:
+          ready = sorted(
+              (s for s in self._slots if s.quarantined and
+               now - s.quarantined_at >= self._probation_secs),
+              key=lambda s: s.quarantined_at)
+          for slot in ready[:need]:
+            slot.quarantined = False
+            slot.probation = True
+            slot.parked = False
+            slot.respawn_streak = 0
+            slot.backoff.reset()
+            slot.next_respawn_time = 0.0
+            # The quarantine-era error is a CLOSED incident: leaving
+            # it would make errors() surface it as live mid-probation
+            # and misdiagnose an unrelated stall (the slot stays
+            # respawn-eligible — a thread-less slot counts as dead).
+            slot.error = None
+            self._rehabilitations += 1
+            report['rehabilitated'].append(slot.index)
+    for which in ('parked', 'unparked', 'rehabilitated'):
+      if report[which]:
+        log.warning('fleet resize -> %d contributing: %s slots %s',
+                    n, which, report[which])
+    return report
+
   def errors(self) -> List[BaseException]:
     """Errors the learner should act on NOW. A quarantined slot's
     error is a closed incident (logged, counted in stats() — the
     give-up already happened), not the cause of whatever stalls the
     pipeline hours later — surfacing it would misdiagnose the new
-    incident. Exception: when EVERY slot is quarantined the fleet is
-    dead and those errors ARE the cause, so they come back."""
+    incident; a PARKED slot's stale error is the same (the park was
+    deliberate). Exception: when EVERY active slot is quarantined the
+    fleet is dead and those errors ARE the cause, so they come back."""
     with self._lock:
       live = [s.error for s in self._slots
-              if s.error is not None and not s.quarantined]
+              if s.error is not None and not s.quarantined
+              and not s.parked]
       if live:
         return live
-      if self._slots and all(s.quarantined for s in self._slots):
-        return [s.error for s in self._slots if s.error is not None]
+      active = [s for s in self._slots if not s.parked]
+      if active and all(s.quarantined for s in active):
+        return [s.error for s in active if s.error is not None]
       return []
 
   def stats(self, healthy_horizon_secs: float = 60.0):
@@ -296,28 +417,42 @@ class ActorFleet:
                if s.thread is not None and s.thread.is_alive()]
       healthy = [s for s in alive
                  if s.error is None and not s.quarantined and
+                 not s.parked and
                  now - s.last_heartbeat <= healthy_horizon_secs]
       # Wedged = alive with NO heartbeat inside the horizon and no
       # recorded error: the thread runs but produces nothing — the
       # blocked-in-env.step / parked-on-backpressure shape the
       # zero-deadlocked-threads chaos SLO counts (an errored slot is
-      # 'dead pending respawn', a different bucket).
+      # 'dead pending respawn', a different bucket; a parked slot is
+      # deliberately idle, neither).
       wedged = [s for s in alive
                 if s.error is None and not s.quarantined and
+                not s.parked and
                 now - s.last_heartbeat > healthy_horizon_secs]
+      # Quorum denominator = ACTIVE (non-parked) slots (round 15): a
+      # controller-shrunk fleet is smaller on purpose — parked slots
+      # reading as unhealthy would make every deliberate shed look
+      # like a dying plane to the fleet_healthy_fraction objective.
+      active = sum(1 for s in self._slots if not s.parked)
       return {
           'unrolls': sum(s.unrolls_done for s in self._slots),
           'respawns': sum(s.respawns for s in self._slots),
           'alive': len(alive),
           'healthy': len(healthy),
           'wedged': len(wedged),
-          'healthy_fraction': (len(healthy) / len(self._slots)
-                               if self._slots else 1.0),
+          'healthy_fraction': (len(healthy) / active
+                               if active else 1.0),
           # Give-up slots (round 9): respawn exhausted its budget —
           # the honest 'this much of my fleet is permanently gone'
           # number the driver surfaces as `slots_quarantined`.
           'slots_quarantined': sum(1 for s in self._slots
                                    if s.quarantined),
+          # Elastic-fleet surface (round 15).
+          'parked': len(self._slots) - active,
+          'target_size': sum(1 for s in self._slots
+                             if not s.parked and not s.quarantined),
+          'rehabilitations': self._rehabilitations,
+          'slots_rehabilitated': self._slots_rehabilitated,
       }
 
   def _join_all(self, timeout: float, what: str,

@@ -429,6 +429,31 @@ class InferenceServer:
                    for s in self._core_sizes)
     return self._acquire_slot(priority=priority)
 
+  @property
+  def admission(self) -> str:
+    """The live admission policy (GIL-atomic read; the controller's
+    actuator get path)."""
+    return self._admission
+
+  def set_admission(self, mode: str) -> str:
+    """Thread-safe live admission-policy flip (round 15: the
+    controller's overload actuator). Takes effect for every acquire
+    that has not yet chosen its path; callers already PARKED on the
+    waitlist keep their original deadline semantics (block→shed
+    mid-park changes only how their deadline rejection is counted;
+    →grow lets the next arriving acquire grow the arena, which then
+    hands slots to the parked waiters through the normal release
+    path). Returns the previous mode."""
+    if mode not in ADMISSION_POLICIES:
+      raise ValueError(f'unknown inference_admission {mode!r} '
+                       f'(policies: {ADMISSION_POLICIES})')
+    with self._slot_lock:
+      old = self._admission
+      self._admission = mode
+    if old != mode:
+      log.warning('inference admission policy: %s -> %s', old, mode)
+    return old
+
   def _acquire_slot(self, priority=PRIORITY_LIVE):
     """Admit one slot acquisition under the configured policy (module
     docstring): fast-path pop when slots are free and nobody is parked

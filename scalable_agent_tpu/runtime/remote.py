@@ -566,6 +566,67 @@ class UnrollCorrupt(RuntimeError):
     self.crc = crc
 
 
+class CrcProbation:
+  """Client-side CRC self-quarantine ladder, with a probation rung
+  (round 15). PR 9 made a double CRC refusal of the same unroll
+  terminal — the host took itself out of the fleet for good, so the
+  controller's grow-fleet move had nothing to reclaim on the remote
+  side. The rehabilitation path mirrors the fleet-slot probation:
+
+    refusal #1 of an unroll  -> RESEND (wire noise; at-least-once)
+    refusal #2 (same unroll) -> PROBE, once per run: cool down
+                                `cooldown_secs`, then re-send the
+                                SAME unroll as a single probe
+    probe refused (or a later unroll double-refused after the
+    probation was spent)     -> QUARANTINE (terminal, as before)
+    probe acked              -> recovered; the host keeps feeding
+
+  Pure decision state (no I/O) so the ladder is unit-testable; the
+  pump owns the sleep and the sends. Counters feed the
+  INTEGRITY_REPORT line chaos.py and operators grep."""
+
+  RESEND = 'resend'
+  PROBE = 'probe'
+  QUARANTINE = 'quarantine'
+
+  def __init__(self, cooldown_secs: float = 30.0):
+    self.cooldown_secs = max(float(cooldown_secs), 0.0)
+    self.crc_resends = 0
+    self.probations = 0
+    self.recoveries = 0
+    self._probation_used = False
+    self._probe_pending = False
+    self._resent = False  # current unroll already re-sent once?
+
+  def next_unroll(self):
+    """A new unroll is being sent: the per-unroll resend budget
+    resets (the probation budget is per-RUN and does not)."""
+    self._resent = False
+
+  def on_refusal(self) -> str:
+    """The learner's CRC refused the current unroll — what now?"""
+    if not self._resent:
+      self._resent = True
+      self.crc_resends += 1
+      return self.RESEND
+    if self._probe_pending or self._probation_used:
+      self._probe_pending = False  # the probe chapter is closed
+      return self.QUARANTINE
+    self._probation_used = True
+    self._probe_pending = True
+    self.probations += 1
+    return self.PROBE
+
+  def on_ack(self) -> bool:
+    """An unroll was accepted; True when it was the probation probe
+    (the host just recovered instead of quarantining)."""
+    if self._probe_pending:
+      self._probe_pending = False
+      self.recoveries += 1
+      return True
+    return False
+
+
 class ParamsCorrupt(RuntimeError):
   """A fetched param snapshot failed its content digest: the blob the
   learner published is not the tree the learner digested at publish
@@ -2848,6 +2909,10 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
     log.warning('%s', warning)
   for warning in config_lib.validate_integrity(config):
     log.warning('%s', warning)
+  # Round 15: the probation cool-down vs idle-reaping cross-link (the
+  # CRC probation sleep happens on THIS host's pump).
+  for warning in config_lib.validate_controller(config):
+    log.warning('%s', warning)
   # Client-side I/O deadline: the idle window doubles as "how long do
   # I wait on a silent learner" — symmetric with the server's reaping
   # of silent clients. Busy keepalives keep a backpressured-but-alive
@@ -2866,9 +2931,11 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
                              wire_crc=wire_crc)
   unrolls_sent = 0
   # Integrity ledger across reconnects (client objects are replaced):
-  # CRC refusals of our unrolls, digest-refused publishes, and
-  # whether this host took itself out of the fleet.
-  crc_resends = 0
+  # CRC refusals of our unrolls (with the round-15 probation rung),
+  # digest-refused publishes, and whether this host took itself out
+  # of the fleet.
+  probation = CrcProbation(
+      cooldown_secs=getattr(config, 'fleet_probation_secs', 30.0))
   digest_rejections = 0
   self_quarantined = False
   try:
@@ -3046,12 +3113,11 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
     try:
       unroll = None  # a drop mid-send must not lose the unroll
       unroll_trace = None  # its trace context rides every (re)send
-      corrupt_resent = False  # current unroll already re-sent once?
       last_io = time.monotonic()
       while (stop_after_unrolls is None or
              unrolls_sent < stop_after_unrolls):
         if unroll is None:
-          corrupt_resent = False
+          probation.next_unroll()
           try:
             # With heartbeats negotiated, wake often enough to ping an
             # idle trajectory lane inside the learner's reaping window.
@@ -3092,18 +3158,48 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
           # re-send the SAME unroll (at-least-once, like any lost
           # ack). Twice for the same unroll means the corruption is
           # on THIS host's path (NIC/RAM — the learner verified
-          # against the trailer WE computed): stop feeding garbage
-          # and take the host out of the fleet (docs/RUNBOOK.md §9).
+          # against the trailer WE computed). Round 15: before the
+          # terminal self-quarantine, ONE probation rung — cool down,
+          # re-send the same unroll as a single probe, and only
+          # quarantine on repeat failure (docs/RUNBOOK.md §9) — so a
+          # transient (an overheated NIC, a since-replaced DIMM)
+          # doesn't cost the fleet this host forever.
           last_io = time.monotonic()
-          if corrupt_resent:
+          verdict = probation.on_refusal()
+          if verdict == CrcProbation.QUARANTINE:
             self_quarantined = True
             log.error(
                 'remote actor task=%d SELF-QUARANTINED: the same '
                 'unroll failed the learner CRC twice (%s) — suspect '
                 'NIC/memory on this host; exiting the fleet', task, e)
             break
-          corrupt_resent = True
-          crc_resends += 1
+          if verdict == CrcProbation.PROBE:
+            log.error(
+                'remote actor task=%d: CRC PROBATION — the same '
+                'unroll failed the learner CRC twice (%s); cooling '
+                'down %.1fs then sending ONE probe (repeat failure '
+                'quarantines this host)', task, e,
+                probation.cooldown_secs)
+            # Cool down WITHOUT going silent: a cool-down longer than
+            # the learner's idle window would otherwise get this conn
+            # reaped as half-open mid-probation — ping at the
+            # heartbeat cadence (best-effort; a reap/drop surfaces on
+            # the probe send, which owns the reconnect path).
+            cool_end = time.monotonic() + probation.cooldown_secs
+            while True:
+              remaining = cool_end - time.monotonic()
+              if remaining <= 0:
+                break
+              time.sleep(min(remaining, heartbeat_secs)
+                         if heartbeat_secs > 0 else remaining)
+              if heartbeat_secs > 0 and \
+                 time.monotonic() < cool_end:
+                try:
+                  client.ping()
+                except OSError:
+                  break  # dropped mid-cool-down: probe send handles it
+            last_io = time.monotonic()
+            continue
           log.warning('remote actor task=%d: unroll failed the '
                       'learner CRC (%s); re-sending once', task, e)
           continue
@@ -3119,6 +3215,10 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
             continue  # resend the SAME unroll on the new connection
           break
         last_io = time.monotonic()
+        if probation.on_ack():
+          log.warning(
+              'remote actor task=%d: CRC probation probe ACCEPTED — '
+              'host recovered; staying in the fleet', task)
         unroll = None
         unroll_trace = None
         unrolls_sent += 1
@@ -3148,12 +3248,15 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
     client.close()
   log.info('remote actor task=%d shipped %d unrolls', task,
            unrolls_sent)
-  if crc_resends or digest_rejections or self_quarantined:
+  if (probation.crc_resends or probation.probations
+      or digest_rejections or self_quarantined):
     # Greppable one-liner for harnesses (chaos.py) and operators: the
     # client-side half of the integrity ledger (the learner's stats
     # carry the server-side half).
     log.warning(
         'INTEGRITY_REPORT task=%d crc_resends=%d digest_rejections=%d '
-        'self_quarantined=%s', task, crc_resends, digest_rejections,
+        'crc_probations=%d crc_probation_recoveries=%d '
+        'self_quarantined=%s', task, probation.crc_resends,
+        digest_rejections, probation.probations, probation.recoveries,
         self_quarantined)
   return unrolls_sent

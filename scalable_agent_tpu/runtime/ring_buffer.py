@@ -44,6 +44,7 @@ actor/env plane stays the rate limiter it measures as.
 """
 
 import collections
+import logging
 import threading
 import time
 from typing import Callable, List, Optional, Tuple
@@ -52,6 +53,8 @@ from scalable_agent_tpu import integrity
 from scalable_agent_tpu import telemetry
 from scalable_agent_tpu.runtime.actor import batch_unrolls
 from scalable_agent_tpu.structs import ActorOutput
+
+log = logging.getLogger('scalable_agent_tpu')
 
 
 class Closed(Exception):
@@ -826,15 +829,20 @@ class BatchPrefetcher:
             self._space.wait()
           if self._closed:
             return
-          # [staged, serves_remaining, n_fresh]: the entry leaves the
-          # deque — freeing its depth slot AND its device arrays —
-          # only after the replay_k-th serve. n_fresh is credited to
-          # `fresh_slots_served` at FIRST serve, so the fresh-vs-serve
-          # accounting is attributed at consumption time (a batch
-          # staged ahead by the prefetcher but never served counts
-          # nothing — the lookahead-free invariant bench.py's
-          # composition rows rely on).
-          self._out.append([staged, self._replay_k, n_fresh])
+          # [staged, serves_remaining, n_fresh, staged_k]: the entry
+          # leaves the deque — freeing its depth slot AND its device
+          # arrays — only after the replay_k-th serve. n_fresh is
+          # credited to `fresh_slots_served` at FIRST serve, so the
+          # fresh-vs-serve accounting is attributed at consumption
+          # time (a batch staged ahead by the prefetcher but never
+          # served counts nothing — the lookahead-free invariant
+          # bench.py's composition rows rely on). staged_k pins the K
+          # this entry was staged under: set_replay_k (round 15, the
+          # controller's actuator) changes only FUTURE entries, and
+          # first-serve detection compares against the entry's own K,
+          # never the live knob.
+          k = self._replay_k
+          self._out.append([staged, k, n_fresh, k])
           self._staged += 1
           self._ready.notify()
     except Closed:
@@ -874,7 +882,7 @@ class BatchPrefetcher:
         raise Closed()
       entry = self._out[0]
       item = entry[0]
-      first_serve = entry[1] == self._replay_k
+      first_serve = entry[1] == entry[3]
       entry[1] -= 1
       if entry[1] <= 0:  # Kth serve: release the slot + the arrays
         self._out.popleft()
@@ -892,6 +900,28 @@ class BatchPrefetcher:
         if self._reserve_fn is not None:
           item = self._reserve_fn(item)
       return item
+
+  @property
+  def replay_k(self) -> int:
+    """The live re-serve count (GIL-atomic read; the controller's
+    actuator get path)."""
+    return self._replay_k
+
+  def set_replay_k(self, k: int):
+    """Thread-safe live replay_k change (round 15: the controller's
+    sample-reuse actuator). Applies to batches staged AFTER the call;
+    entries already staged finish out the K they were staged under
+    (their first-serve accounting compares against that pinned K, so
+    fresh-frame attribution can never double- or under-count across a
+    change)."""
+    k = int(k)
+    if k < 1:
+      raise ValueError('replay_k must be >= 1')
+    with self._lock:
+      if k != self._replay_k:
+        log.warning('prefetcher replay_k: %d -> %d',
+                    self._replay_k, k)
+      self._replay_k = k
 
   def fresh_slots_served(self) -> int:
     """Cumulative fresh unroll slots of FIRST-served batches — the

@@ -220,6 +220,22 @@ DEFAULT_OBJECTIVES = (
               kind='rate', comparison='==', target=0.0,
               severity='ticket',
               description='automatic checkpoint rollbacks'),
+    # Plane-balance leading indicator (round 15, controller.py): the
+    # learner mostly parked on the feed = the env plane is the bound —
+    # the controller's raise-replay_k trigger (IMPACT,
+    # arXiv 1912.00167). Advisory: env-bound is a CAPACITY shape, not
+    # an incident, so burning this must never fail a verdict.
+    Objective(name='learner_plane_utilization',
+              metric='driver/learner_plane_utilization',
+              comparison='>=', target=0.05, severity='info',
+              description='learner not starved by the env plane'),
+    # Transport-pressure leading indicator (round 15, controller.py):
+    # ack service time is the end-to-end backpressure remote pumps
+    # feel — the controller's stretch-publish-cadence trigger.
+    Objective(name='ingest_ack_p99_ms', metric='ingest/ack_ms',
+              field='p99', comparison='<=', target=5000.0,
+              severity='info',
+              description='ingest ack service time p99 (ms)'),
     # Telemetry self-health (PR 10 satellites): advisory only.
     Objective(name='dropped_writes_zero',
               metric='observability/dropped_writes',
@@ -502,6 +518,14 @@ class SloEvaluator:
     return [n for n, e in self._state.items()
             if e['state'] == BURNING]
 
+  def states(self) -> Dict[str, Dict]:
+    """Deep-copied per-objective judged state ({name: {state, value,
+    target, margin, severity, burns, ...}}). Each entry's fields were
+    written by ONE `entry.update(...)` call, so a copy is internally
+    consistent; callers needing consistency ACROSS objectives must
+    hold the owning engine's lock (SloEngine.control_snapshot does)."""
+    return {n: dict(e) for n, e in self._state.items()}
+
   def verdict(self) -> Dict:
     """The per-run verdict: overall pass/fail + every objective's
     final state and burn count. `pass` fails on any ticket/page
@@ -658,6 +682,27 @@ class SloEngine:
       logging.getLogger('scalable_agent_tpu').exception(
           'SLO violation emission failed')
     return newly
+
+  # --- the control surface (round 15, controller.py) ---
+
+  def burning(self) -> List[str]:
+    """The currently-burning objective names, read under the engine
+    lock (stable against a concurrent observe() — the controller
+    thread's read API)."""
+    with self._lock:
+      return self._evaluator.burning()
+
+  def control_snapshot(self) -> Dict[str, Dict]:
+    """A locked, self-consistent copy of every objective's judged
+    state ({name: {state, value, target, margin, severity, burns,
+    ...}}) — the round-15 controller's control input. The lock
+    guarantees the copy describes ONE evaluation pass: two objectives
+    over the same metric can never disagree about its value inside a
+    single snapshot (regression-pinned by
+    tests/test_slo.py::test_control_snapshot_consistent_mid_evaluation).
+    """
+    with self._lock:
+      return self._evaluator.states()
 
   def flush_captures(self):
     """Write queued capture artifacts (engine thread per tick;

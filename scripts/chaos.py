@@ -110,6 +110,29 @@ overload storm's SIGTERM is gated on the quarantine incident ledger
 (with a hard deadline) instead of a wall-clock guess — the
 slots_quarantined SLO used to race the full-jitter respawn backoff.
 
+Round 15 adds the CONTROLLER storm (`run_controller_storm`): the
+load-surge drill for the self-healing control plane
+(scalable_agent_tpu/controller.py). Real training starts with half
+its actor fleet parked; mid-run the harness DOUBLES the offered load
+(unparks the other half, whose first spawn is a scripted env flake so
+the new slots deterministically quarantine — a surge arriving on a
+flaky plane). Under `--controller=act` the controller must heal it
+with zero human knob-turning:
+
+  * the tightened fleet-quorum SLO's margin thins → the controller
+    escalates (admission flip + grow-fleet moves that REHABILITATE
+    the quarantined slots through the probation ladder),
+  * the objective never burns → SLO_VERDICT.json stays GREEN,
+  * recovery clears the hysteresis band → every move is REVERTED,
+  * CONTROLLER_LOG.json shows the escalations and the later reverts,
+    all applied, with `controller_action` incidents + counters,
+  * `slots_rehabilitated` counts the reclaimed slots.
+
+The SAME storm re-runs under `--controller=observe` (the dry run):
+the controller logs the moves it WOULD have made (applied: false)
+and touches nothing — the quorum objective burns and the verdict
+FAILS, recording exactly the violation the actuated run avoided.
+
 Writes CHAOS_OUT (default CHAOS.json at the repo root). Invocation:
 
     python scripts/chaos.py               # all storms, ~4-6 min CPU
@@ -118,6 +141,7 @@ Writes CHAOS_OUT (default CHAOS.json at the repo root). Invocation:
     CHAOS_STORM=overload  python scripts/chaos.py  # just the overload
     CHAOS_STORM=partition python scripts/chaos.py  # just the partition
     CHAOS_STORM=corruption python scripts/chaos.py # just the integrity
+    CHAOS_STORM=controller python scripts/chaos.py # just the controller
     CHAOS_SEED=7 python scripts/chaos.py  # different garbage bytes
 
 The fault schedule is a pure function of the arguments (the seed only
@@ -1233,6 +1257,316 @@ def run_corruption_storm(logdir: str, smoke: bool = SMOKE,
   return results, errors
 
 
+def _run_controller_phase(logdir, mode, spec_path, policy_path,
+                          smoke, seed, max_seconds):
+  """One controller-storm run (mode = 'act' | 'observe'): fleet of 4
+  starts with 2 slots parked; a watcher doubles the offered load
+  mid-run by unparking them — their first spawn is a scripted env
+  flake, so both new slots deterministically quarantine and only a
+  rehabilitation path can reclaim them. Returns (results, errors)."""
+  import threading
+
+  from scalable_agent_tpu import controller as controller_lib
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu import slo as slo_lib
+  from scalable_agent_tpu.config import Config
+
+  fleet_size = 4
+  initial_size = 2
+  surge_warm_secs = 3.0
+  cfg = Config(
+      logdir=logdir,
+      env_backend='bandit',
+      num_actors=fleet_size,
+      batch_size=2,
+      unroll_length=5,
+      num_action_repeats=1,
+      episode_length=4,
+      height=24, width=32,
+      torso='shallow',
+      use_py_process=False,
+      use_instruction=False,
+      total_environment_frames=10 ** 9,
+      inference_timeout_ms=5,
+      checkpoint_secs=0,
+      summary_secs=0,
+      # The surge slots must give up FAST (first respawn is the
+      # scripted flake; the second attempt quarantines) so the
+      # controller's rehabilitation move is the only way back.
+      fleet_quarantine_after=1,
+      fleet_probation_secs=0.2,
+      controller=mode,
+      controller_policy=policy_path,
+      controller_interval_secs=0.25,
+      slo_spec=spec_path,
+      slo_capture=False,        # the verdict is the record here
+      seed=seed)
+
+  fleet_holder = []
+  flakes = {i: 1 for i in range(initial_size, fleet_size)}
+
+  def fleet_factory(cfg2, agent, policy, buffer, levels):
+    fleet = driver.make_fleet(cfg2, agent, policy, buffer, levels)
+    orig_make = fleet._make_actor
+
+    def flaky_make(i):
+      if flakes.get(i, 0) > 0:
+        flakes[i] -= 1
+        raise RuntimeError(f'storm surge: scripted env flake on '
+                           f'slot {i}')
+      return orig_make(i)
+
+    fleet._make_actor = flaky_make
+    fleet.set_target_size(initial_size)  # spin up at half load
+    fleet_holder.append(fleet)
+    return fleet
+
+  watcher_stop = threading.Event()
+  surge_wall = [None]
+
+  def _surge(t_start):
+    # Wait for real training traffic (first summary row) so the slow
+    # burn window holds healthy samples before the surge lands, then
+    # double the offered load.
+    deadline = t_start + 60.0
+    while not watcher_stop.is_set() and time.monotonic() < deadline:
+      try:
+        rows = _read_jsonl(os.path.join(logdir, 'summaries.jsonl'))
+      except ValueError:
+        rows = []
+      if fleet_holder and any(r.get('tag') == 'env_frames_per_sec'
+                              for r in rows):
+        break
+      watcher_stop.wait(0.2)
+    if watcher_stop.is_set() or not fleet_holder:
+      return
+    watcher_stop.wait(surge_warm_secs)
+    if watcher_stop.is_set():
+      return
+    surge_wall[0] = round(time.monotonic() - t_start, 2)
+    fleet_holder[0].set_target_size(fleet_size)
+
+  t0 = time.monotonic()
+  watcher = threading.Thread(target=_surge, args=(t0,), daemon=True)
+  crash = None
+  run = None
+  try:
+    watcher.start()
+    run = driver.train(cfg, max_seconds=max_seconds,
+                       stall_timeout_secs=5.0,
+                       fleet_factory=fleet_factory)
+  except BaseException as e:  # SLO: zero learner crashes
+    crash = f'{type(e).__name__}: {e}'
+  finally:
+    watcher_stop.set()
+    watcher.join(timeout=5.0)
+
+  errors = []
+  results = {
+      'mode': mode,
+      'fleet_size': fleet_size,
+      'initial_size': initial_size,
+      'surge_wall_secs': surge_wall[0],
+      'wall_secs': round(time.monotonic() - t0, 2),
+      'crash': crash,
+  }
+  if crash is not None:
+    errors.append(f'[{mode}] learner crashed under the load surge: '
+                  f'{crash}')
+    return results, errors
+  if surge_wall[0] is None:
+    errors.append(f'[{mode}] the load surge never fired (no training '
+                  'traffic within 60s?)')
+    return results, errors
+
+  verdict = slo_lib.read_verdict(logdir)
+  clog = controller_lib.read_log(logdir)
+  fleet_stats = run.fleet.stats()
+  results.update({
+      'slo_verdict': None if verdict is None else {
+          'pass': verdict.get('pass'),
+          'violations': verdict.get('violations')},
+      'controller_counts': None if clog is None else clog['counts'],
+      'slots_quarantined': fleet_stats['slots_quarantined'],
+      'slots_rehabilitated': fleet_stats['slots_rehabilitated'],
+      'admission': run.server.admission,
+  })
+  if verdict is None:
+    errors.append(f'[{mode}] no SLO_VERDICT.json')
+    return results, errors
+  if clog is None:
+    errors.append(f'[{mode}] no CONTROLLER_LOG.json')
+    return results, errors
+  actions = clog.get('actions') or []
+  escalations = [a for a in actions if a['kind'] == 'escalate']
+  reverts = [a for a in actions if a['kind'] == 'revert']
+  results['actions'] = [
+      {k: a.get(k) for k in ('kind', 'actuator', 'from', 'to',
+                             'applied')} for a in actions]
+
+  if mode == 'act':
+    # --- The headline SLO: the verdict stays GREEN with zero human
+    # knob-turning — the quorum objective's margin triggered the
+    # controller BEFORE it could burn.
+    if not verdict.get('pass'):
+      errors.append(f"[act] SLO verdict FAILED despite the "
+                    f"controller: {verdict.get('violations')}")
+    quorum = (verdict.get('objectives') or {}).get(
+        'fleet_healthy_fraction') or {}
+    if quorum.get('burns', 0) != 0:
+      errors.append(f"[act] fleet_healthy_fraction burned "
+                    f"{quorum.get('burns')}x — the controller acted "
+                    'too late (the storm gives it the slow-window '
+                    'confirmation as its reaction budget)')
+    # --- Escalation and the later revert, all applied.
+    if len(escalations) < 2:
+      errors.append(f'[act] expected >= 2 escalations (admission + '
+                    f'fleet grow), got {len(escalations)}')
+    if len(reverts) < 2:
+      errors.append(f'[act] expected >= 2 reverts, got '
+                    f'{len(reverts)}')
+    if not all(a['applied'] for a in actions):
+      errors.append('[act] an action failed to apply: '
+                    f'{[a for a in actions if not a["applied"]]}')
+    if escalations and reverts:
+      if min(a['wall_time'] for a in reverts) <= \
+         min(a['wall_time'] for a in escalations):
+        errors.append('[act] a revert preceded the first escalation')
+    grew = [a for a in escalations if a['actuator'] == 'fleet_size']
+    if not grew:
+      errors.append('[act] no fleet_size escalation — the '
+                    'quarantined surge slots were never reclaimed')
+    # --- The grow move reclaimed the quarantined slots through
+    # probation, and the reverts put every knob back.
+    if fleet_stats['slots_rehabilitated'] != fleet_size - initial_size:
+      errors.append(
+          f"[act] slots_rehabilitated="
+          f"{fleet_stats['slots_rehabilitated']}, expected "
+          f'{fleet_size - initial_size}')
+    if fleet_stats['slots_quarantined'] != 0:
+      errors.append(f"[act] slots_quarantined="
+                    f"{fleet_stats['slots_quarantined']} at exit — "
+                    'rehabilitation did not reclaim the surge slots')
+    if run.server.admission != 'block':
+      errors.append(f'[act] admission not reverted to block '
+                    f'(got {run.server.admission!r})')
+    # --- The audit trail: fsync'd incidents + summary scalars.
+    incidents = _read_jsonl(os.path.join(logdir, 'incidents.jsonl'))
+    kinds = {e['kind'] for e in incidents}
+    if 'controller_action' not in kinds:
+      errors.append('[act] no controller_action incident recorded')
+    summaries = _read_jsonl(os.path.join(logdir, 'summaries.jsonl'))
+    tags = {e['tag'] for e in summaries if 'tag' in e}
+    for tag in ('controller_actions', 'controller_reverts'):
+      if tag not in tags:
+        errors.append(f'[act] summary tag {tag!r} missing')
+  else:
+    # --- The dry run records the violation the actuated run avoided:
+    # same surge, nothing actuated, the quorum objective burns and
+    # fails the verdict; the intended moves are logged unapplied.
+    if verdict.get('pass'):
+      errors.append('[observe] SLO verdict PASSED — the surge did '
+                    'not produce the violation the actuated run is '
+                    'credited with avoiding')
+    if 'fleet_healthy_fraction' not in (
+        verdict.get('violations') or []):
+      errors.append('[observe] fleet_healthy_fraction not among the '
+                    f"violations: {verdict.get('violations')}")
+    if not actions:
+      errors.append('[observe] the dry-run controller logged no '
+                    'intended actions')
+    if any(a['applied'] for a in actions):
+      errors.append('[observe] an observe-mode action was APPLIED: '
+                    f'{[a for a in actions if a["applied"]]}')
+    if fleet_stats['slots_rehabilitated'] != 0:
+      errors.append('[observe] slots were rehabilitated in observe '
+                    'mode')
+    if fleet_stats['slots_quarantined'] != fleet_size - initial_size:
+      errors.append(
+          f"[observe] slots_quarantined="
+          f"{fleet_stats['slots_quarantined']}, expected the surge's "
+          f'{fleet_size - initial_size} to stay quarantined')
+  return results, errors
+
+
+def run_controller_storm(logdir: str, smoke: bool = SMOKE,
+                         seed: int = SEED):
+  """The self-healing control-plane drill (round 15); returns
+  (results, hard-assert errors). Two phases on sibling logdirs: the
+  ACTUATED run (controller=act — the verdict must stay green, the
+  action log must show the escalation and the later revert, the
+  quarantined surge slots must be rehabilitated) and the OBSERVE run
+  (same storm, dry-run controller — the verdict must FAIL on the
+  quorum objective, recording the violation the actuated run
+  avoided)."""
+  # The storm's tightened objective set: the shipped
+  # fleet_healthy_fraction objective with a per-deployment target
+  # (the --slo_spec mechanism — this 4-slot toy fleet's quorum floor
+  # is 0.6, where the production default 0.25 fits thousand-slot
+  # fleets), plus the rollbacks pin. Windows sized so the multi-window
+  # burn semantics give the controller its documented reaction budget:
+  # the fast window catches the surge in ~1.5 s; the slow window
+  # confirms only after seconds of sustained violation — the
+  # controller must heal inside that confirmation window or the
+  # verdict goes red exactly like the observe run's.
+  spec = [
+      # Target 0.7 on a 4-slot fleet: the quorum steps through 0.5
+      # (surge) -> 0.75 (first rehabilitation) -> 1.0 (healed), and
+      # the trigger band must COVER the 0.75 intermediate — a
+      # trigger_margin smaller than the largest single-step recovery
+      # increment wedges the escalation inside the hysteresis band
+      # with one slot still quarantined (docs/RUNBOOK.md §12 sizing
+      # rule, learned the hard way by this storm's first cut).
+      dict(name='fleet_healthy_fraction',
+           metric='driver/fleet_healthy_fraction',
+           comparison='>=', target=0.7, severity='page',
+           fast_window_secs=1.5, slow_window_secs=30.0,
+           description='storm-tightened fleet quorum'),
+      dict(name='rollbacks_zero', metric='health/rollbacks',
+           kind='rate', comparison='==', target=0.0,
+           severity='ticket', fast_window_secs=1.5,
+           slow_window_secs=30.0,
+           description='no rollbacks under the surge'),
+  ]
+  policy = [
+      dict(objective='fleet_healthy_fraction', actuator='admission',
+           to='shed', revert_to='block', trigger_margin=0.1,
+           clear_margin=0.25, cooldown_secs=1.0,
+           description='quorum thinning under surge: stop parking '
+                       'admissions'),
+      dict(objective='fleet_healthy_fraction', actuator='fleet_size',
+           direction='up', step=1, trigger_margin=0.1,
+           clear_margin=0.25, cooldown_secs=0.4,
+           description='quorum thinning: grow the fleet '
+                       '(rehabilitate quarantined slots)'),
+  ]
+  os.makedirs(logdir, exist_ok=True)
+  spec_path = os.path.join(logdir, 'storm_slo_spec.json')
+  policy_path = os.path.join(logdir, 'storm_controller_policy.json')
+  with open(spec_path, 'w') as f:
+    json.dump(spec, f, indent=2)
+  with open(policy_path, 'w') as f:
+    json.dump(policy, f, indent=2)
+
+  t0 = time.monotonic()
+  errors = []
+  results = {'smoke': smoke, 'seed': seed}
+  act_dir = os.path.join(logdir, 'act')
+  obs_dir = os.path.join(logdir, 'observe')
+  os.makedirs(act_dir)
+  os.makedirs(obs_dir)
+  results['act'], act_errors = _run_controller_phase(
+      act_dir, 'act', spec_path, policy_path, smoke, seed,
+      max_seconds=14.0 if smoke else 22.0)
+  errors += act_errors
+  results['observe'], obs_errors = _run_controller_phase(
+      obs_dir, 'observe', spec_path, policy_path, smoke, seed,
+      max_seconds=12.0 if smoke else 18.0)
+  errors += obs_errors
+  results['wall_secs'] = round(time.monotonic() - t0, 2)
+  return results, errors
+
+
 def _run_corruption_subprocess():
   """CHAOS_STORM=all path: the corruption storm needs its own process
   (XLA device-count flags must precede the jax import, and the other
@@ -1275,6 +1609,11 @@ def main():
       results['partition'], partition_errors = \
           run_partition_storm(logdir)
     errors += [f'partition: {e}' for e in partition_errors]
+  if which in ('all', 'controller'):
+    with tempfile.TemporaryDirectory(prefix='chaos_ctrl_') as logdir:
+      results['controller'], controller_errors = \
+          run_controller_storm(logdir)
+    errors += [f'controller: {e}' for e in controller_errors]
   if which == 'corruption':
     with tempfile.TemporaryDirectory(prefix='chaos_corr_') as logdir:
       results['corruption'], corruption_errors = \
@@ -1298,6 +1637,8 @@ def main():
                         results.get('overload', {}).get('wall_secs'),
                     'partition_wall_secs':
                         results.get('partition', {}).get('wall_secs'),
+                    'controller_wall_secs':
+                        results.get('controller', {}).get('wall_secs'),
                     'corruption_wall_secs':
                         results.get('corruption', {}).get('wall_secs'),
                     'violations': errors,

@@ -111,6 +111,17 @@ if orphaned:
           'scalable_agent_tpu/):')
     for n in orphaned:
         print(f'  {n}')
+# Round 15: the controller's policy table rides the contract too —
+# every DEFAULT rule's objective must be a shipped DEFAULT objective
+# (a rule watching an objective nobody evaluates never fires), and
+# every rule's actuator must be a KNOWN_ACTUATORS name.
+ctrl_src = pathlib.Path('scalable_agent_tpu/controller.py').read_text()
+ctrl_objectives = set(re.findall(r"objective='([a-z0-9_]+)'",
+                                 ctrl_src))
+ctrl_actuators = set(re.findall(r"actuator='([a-z0-9_]+)'", ctrl_src))
+known = set(re.findall(r"'([a-z0-9_]+)'",
+                       re.search(r'KNOWN_ACTUATORS = \(([^)]*)\)',
+                                 ctrl_src).group(1)))
 # Round 14: the SLO layer rides the same static contract. Every
 # DEFAULT objective's metric must be a REGISTERED name (an objective
 # judging a metric nobody registers silently evaluates as no_data
@@ -142,11 +153,24 @@ if orphan_slo:
           'slo.DEFAULT_OBJECTIVES):')
     for n in orphan_slo:
         print(f'  {n}')
-if undocumented or orphaned or unregistered or undoc_slo or orphan_slo:
+bad_rule_objectives = sorted(ctrl_objectives - slo_names)
+bad_rule_actuators = sorted(ctrl_actuators - known)
+if bad_rule_objectives:
+    print('controller DEFAULT_RULES over objectives not in '
+          'slo.DEFAULT_OBJECTIVES:')
+    for n in bad_rule_objectives:
+        print(f'  {n}')
+if bad_rule_actuators:
+    print('controller DEFAULT_RULES over unknown actuators:')
+    for n in bad_rule_actuators:
+        print(f'  {n}')
+if (undocumented or orphaned or unregistered or undoc_slo
+        or orphan_slo or bad_rule_objectives or bad_rule_actuators):
     sys.exit(1)
 print(f'metric-name lint OK: {len(registered)} registered names all '
       f'documented, none orphaned; {len(slo_names)} SLO objectives '
-      'over registered metrics, inventory in sync')
+      'over registered metrics, inventory in sync; '
+      f'{len(ctrl_objectives)} controller rule objectives resolved')
 LINT_EOF
 
 echo '== slo lane (round 14: declarative objectives over the registry,'
@@ -180,7 +204,11 @@ expected = {o.name for o in slo.DEFAULT_OBJECTIVES}
 got = set(verdict['objectives'])
 assert got == expected, f'verdict objectives {got ^ expected} out of sync'
 for name, e in verdict['objectives'].items():
-    assert e['state'] in ('ok', 'no_data', 'no_baseline'), (name, e)
+    # info objectives are advisory leading indicators (round 15) — a
+    # toy env-bound run may burn learner_plane_utilization without
+    # failing anything.
+    assert (e['state'] in ('ok', 'no_data', 'no_baseline')
+            or e['severity'] == 'info'), (name, e)
 # The go/no-go gate agrees: slo_report exits 0 on the passing verdict.
 rc = subprocess.run([sys.executable, 'scripts/slo_report.py', logdir],
                     stdout=subprocess.DEVNULL).returncode
@@ -189,6 +217,25 @@ print(f'slo lane OK: {len(got)} objectives evaluated, verdict PASS, '
       'zero captures, slo_report gate green')
 SLO_EOF
 BENCH_SMOKE=1 BENCH_ONLY=slo python bench.py
+
+echo '== controller lane (round 15: the self-healing control plane —'
+echo '   policy-table determinism, bounded escalate/revert with'
+echo '   hysteresis, fleet elasticity + quarantine rehabilitation,'
+echo '   then the load-surge storm: offered load doubles mid-run, the'
+echo '   actuated run keeps SLO_VERDICT.json green with the'
+echo '   escalation+revert in CONTROLLER_LOG.json while the observe'
+echo '   run records the violation it avoided; plus the tiny'
+echo '   tick-cost bench rows — <90 s CPU) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_controller.py -q \
+  -p no:cacheprovider
+JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py \
+  tests/test_replay.py tests/test_overload.py tests/test_slo.py \
+  tests/test_remote.py -q \
+  -k 'target_size or rehabilitat or probation or set_replay_k or '\
+'set_admission or control_snapshot' \
+  -p no:cacheprovider
+CHAOS_SMOKE=1 CHAOS_STORM=controller python scripts/chaos.py
+BENCH_SMOKE=1 BENCH_ONLY=controller python bench.py
 
 echo '== telemetry smoke (trace spans end to end: registry semantics,'
 echo '   tracer pipeline, v8 negotiation + remote stamping,'

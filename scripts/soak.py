@@ -511,6 +511,30 @@ def main():
         problems.append(
             'SLO verdict FAILED over the soak window: '
             + ', '.join(slo_verdict.get('violations') or ['?']))
+  # Round 15: the controller's action log rides the soak artifact —
+  # a long run that moved its own knobs must say so (and in act mode
+  # an apply error over the window is a soak finding).
+  from scalable_agent_tpu import controller as controller_lib
+  controller_log = controller_lib.read_log(logdir)
+  controller_block = None
+  if cfg.controller != 'off':
+    if controller_log is None:
+      problems.append('controller=%s but the run wrote no '
+                      'CONTROLLER_LOG.json' % cfg.controller)
+    else:
+      counts = controller_log.get('counts') or {}
+      controller_block = {
+          'mode': controller_log.get('mode'),
+          'counts': counts,
+          'last_actions': [
+              {k: a.get(k) for k in ('kind', 'objective', 'actuator',
+                                     'from', 'to', 'applied')}
+              for a in (controller_log.get('actions') or [])[-8:]],
+      }
+      if counts.get('apply_errors'):
+        problems.append(
+            'controller recorded %d actuator apply error(s) over the '
+            'soak window' % counts['apply_errors'])
   if steps < (20 if not smoke else 2):
     problems.append(f'only {steps} learner steps in {seconds:.0f}s')
   if not losses or not np.all(np.isfinite(losses)):
@@ -617,6 +641,7 @@ def main():
       'integrity': integrity_final,
       'telemetry': telemetry_block,
       'slo': slo_block,
+      'controller': controller_block,
       'churn': churn_artifact,
       'stack': {
           'torso': cfg.torso, 'compute_dtype': cfg.compute_dtype,

@@ -316,3 +316,177 @@ def test_stats_wedged_counts_silent_alive_threads():
     assert stats_tight['wedged'] == stats_tight['alive']
   finally:
     fleet.stop()
+
+
+# --------------------------------------------------------------------
+# Elastic fleet size + quarantine rehabilitation (round 15): the
+# controller's fleet_size actuator and the probation ladder.
+# --------------------------------------------------------------------
+
+
+def _wait(predicate, timeout=15.0, interval=0.02):
+  deadline = time.monotonic() + timeout
+  while time.monotonic() < deadline:
+    if predicate():
+      return True
+    time.sleep(interval)
+  return predicate()
+
+
+def _pumped(fleet, cond, buffer=None):
+  """Predicate that drives the respawn machinery (check_health runs
+  on the learner thread in production), drains `buffer` like a
+  learner would (a full buffer blocks every producer's put), and then
+  evaluates `cond`."""
+  def p():
+    fleet.check_health()
+    if buffer is not None:
+      try:
+        while True:
+          buffer.get(timeout=0)
+      except (TimeoutError, ring_buffer.Closed):
+        pass
+    return cond()
+  return p
+
+
+def test_set_target_size_parks_unparks_and_quorum_denominator():
+  buffer = ring_buffer.TrajectoryBuffer(64)
+  fleet = ActorFleet(
+      _make_actor_factory(lambda i: FakeEnv(height=H, width=W,
+                                            num_actions=A, seed=i)),
+      buffer, num_actors=4)
+  try:
+    fleet.start()
+    assert _wait(lambda: fleet.stats()['healthy'] == 4)
+    # Shrink: the two highest-index slots park; each actor exits
+    # cleanly after its current unroll, and the quorum DENOMINATOR
+    # shrinks with the fleet — a deliberate shed must not read as a
+    # dying plane.
+    report = fleet.set_target_size(2)
+    assert sorted(report['parked']) == [2, 3]
+    assert fleet.target_size() == 2
+    assert _wait(lambda: fleet.stats()['healthy'] == 2)
+    stats = fleet.stats()
+    assert stats['parked'] == 2
+    assert stats['healthy_fraction'] == 1.0
+    # Parked slots are skipped by health checks (no respawn).
+    fleet.check_health()
+    assert fleet.stats()['parked'] == 2
+    # Grow: unpark first — the slots respawn and produce again.
+    report = fleet.set_target_size(4)
+    assert sorted(report['unparked']) == [2, 3]
+    assert report['rehabilitated'] == []
+    assert _wait(_pumped(fleet,
+                         lambda: fleet.stats()['healthy'] == 4))
+  finally:
+    fleet.stop()
+
+
+def test_rehabilitation_probation_success_counts():
+  """A quarantined slot reclaimed through probation: cool-down,
+  probe spawn, ONE completed unroll clears it (slots_rehabilitated)."""
+  buffer = ring_buffer.TrajectoryBuffer(64)
+  fails = {1: 1}  # slot 1: the first (pre-quarantine) spawn raises
+
+  def make_actor(i):
+    if fails.get(i, 0) > 0:
+      fails[i] -= 1
+      raise RuntimeError(f'flaky env on slot {i}')
+    env = FakeEnv(height=H, width=W, num_actions=A, seed=i)
+    actor = Actor(env, _dummy_policy,
+                  (np.zeros((1, 4), np.float32),) * 2,
+                  unroll_length=4)
+    return env, None, actor
+
+  fleet = ActorFleet(make_actor, buffer, num_actors=2,
+                     quarantine_after=1, probation_secs=0.05)
+  # Zero-jitter backoff so the quarantine ladder is check-driven.
+  for slot in fleet._slots:
+    slot.backoff._rng = type('R', (), {'uniform':
+                                       staticmethod(lambda a, b: 0.0)})
+  try:
+    # Slot 1's start-time spawn raises a non-admission error: start()
+    # would raise it — spawn slot 0 only, then drive slot 1 through
+    # the respawn ladder (thread-None counts as dead since round 15).
+    fleet._slots[1].error = RuntimeError('seed: never spawned')
+    fleet._spawn(fleet._slots[0])
+    assert _wait(_pumped(
+        fleet, lambda: fleet.stats()['slots_quarantined'] == 1))
+    assert fleet.target_size() == 1
+    # Before the cool-down elapses nothing is reclaimable.
+    fleet._slots[1].quarantined_at = time.monotonic()
+    assert fleet.set_target_size(2)['rehabilitated'] == []
+    time.sleep(0.08)
+    report = fleet.set_target_size(2)
+    assert report['rehabilitated'] == [1]
+    assert fleet.stats()['rehabilitations'] == 1
+    # The quarantine-era error is a closed incident: it must not
+    # surface as live through errors() mid-probation (review fix).
+    assert fleet.errors() == []
+    # The flake budget is spent: the probe spawn succeeds, the first
+    # unroll completes, and the probation clears.
+    assert _wait(_pumped(
+        fleet, lambda: fleet.stats()['slots_rehabilitated'] == 1,
+        buffer=buffer))
+    stats = fleet.stats()
+    assert stats['slots_quarantined'] == 0
+    assert stats['slots_rehabilitated'] == 1
+  finally:
+    fleet.stop()
+
+
+def test_probation_requarantines_on_repeat_failure():
+  buffer = ring_buffer.TrajectoryBuffer(64)
+  fails = {0: 100}  # slot 0 never spawns successfully
+
+  def make_actor(i):
+    if fails.get(i, 0) > 0:
+      fails[i] -= 1
+      raise RuntimeError(f'permanently broken env on slot {i}')
+    env = FakeEnv(height=H, width=W, num_actions=A, seed=i)
+    actor = Actor(env, _dummy_policy,
+                  (np.zeros((1, 4), np.float32),) * 2,
+                  unroll_length=4)
+    return env, None, actor
+
+  fleet = ActorFleet(make_actor, buffer, num_actors=1,
+                     quarantine_after=1, probation_secs=0.0)
+  for slot in fleet._slots:
+    slot.backoff._rng = type('R', (), {'uniform':
+                                       staticmethod(lambda a, b: 0.0)})
+  try:
+    fleet._slots[0].error = RuntimeError('seed: never spawned')
+    assert _wait(_pumped(
+        fleet, lambda: fleet.stats()['slots_quarantined'] == 1))
+    respawns_before = fleet.stats()['respawns']
+    assert fleet.set_target_size(1)['rehabilitated'] == [0]
+    # The probe spawn fails -> the SECOND respawn re-quarantines
+    # immediately (probation is one probe, not a fresh ladder).
+    assert _wait(_pumped(
+        fleet, lambda: fleet.stats()['slots_quarantined'] == 1))
+    stats = fleet.stats()
+    assert stats['slots_quarantined'] == 1
+    assert stats['slots_rehabilitated'] == 0
+    # The probation cost at most 2 respawn attempts (probe + give-up).
+    assert stats['respawns'] - respawns_before <= 2
+  finally:
+    fleet.stop()
+
+
+def test_parked_slot_errors_do_not_surface():
+  buffer = ring_buffer.TrajectoryBuffer(64)
+  fleet = ActorFleet(
+      _make_actor_factory(lambda i: FakeEnv(height=H, width=W,
+                                            num_actions=A, seed=i)),
+      buffer, num_actors=2)
+  try:
+    fleet.start()
+    assert _wait(lambda: fleet.stats()['healthy'] == 2)
+    fleet.set_target_size(1)
+    # A stale error on the parked slot is a closed incident, not the
+    # cause of some later stall.
+    fleet._slots[1].error = RuntimeError('stale, pre-park')
+    assert fleet.errors() == []
+  finally:
+    fleet.stop()

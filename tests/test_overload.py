@@ -480,3 +480,37 @@ def test_overload_counters_reach_summaries(tmp_path):
               'buffer_high_water', 'buffer_put_waits',
               'remote_stale_rejected'):
     assert tag in tags, f'summary tag {tag!r} missing'
+
+
+def test_set_admission_flips_live_policy_and_counters():
+  """Round 15: the controller's admission actuator — a live
+  block->shed flip changes how the NEXT deadline rejection is
+  counted, and ->grow lets the next exhausted acquire grow the arena
+  instead of parking."""
+  server = _mk_server(inference_state_slots=2,
+                      inference_admission='block',
+                      inference_admission_timeout_secs=0.2)
+  try:
+    assert server.admission == 'block'
+    held = [server.initial_core_state() for _ in range(2)]
+    with pytest.raises(SlotUnavailable):
+      server.initial_core_state()
+    assert server.stats()['admission_timeouts'] == 1
+    assert server.stats()['sheds'] == 0
+    # Flip to shed: the same exhaustion now counts as a shed.
+    assert server.set_admission('shed') == 'block'
+    assert server.admission == 'shed'
+    with pytest.raises(SlotUnavailable):
+      server.initial_core_state()
+    assert server.stats()['sheds'] == 1
+    # Flip to grow: the arena doubles instead of rejecting.
+    server.set_admission('grow')
+    handle = server.initial_core_state()
+    assert server.stats()['arena_grows'] == 1
+    handle.release()
+    with pytest.raises(ValueError):
+      server.set_admission('banana')
+    for h in held:
+      h.release()
+  finally:
+    server.close()

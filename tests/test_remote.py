@@ -1618,3 +1618,34 @@ def test_validate_integrity_cross_links():
   warnings = validate_integrity(Config(wire_crc=False,
                                        replay_ratio=0.5))
   assert any('already-rotten' in w for w in warnings)
+
+
+def test_crc_probation_ladder():
+  """Round 15: the client-side CRC self-quarantine grew a probation
+  rung — resend, then ONE cooled-down probe, then terminal
+  quarantine; a later double-refusal after the probation is spent is
+  terminal immediately."""
+  p = remote.CrcProbation(cooldown_secs=0.0)
+  # Unroll A: refusal -> resend; second refusal -> the probation probe.
+  assert p.on_refusal() == remote.CrcProbation.RESEND
+  assert p.on_refusal() == remote.CrcProbation.PROBE
+  assert (p.crc_resends, p.probations) == (1, 1)
+  # The probe is ACKED: recovered, the host stays in the fleet.
+  assert p.on_ack() is True
+  assert p.recoveries == 1
+  # Unroll B: the resend budget is per-unroll (resets)...
+  p.next_unroll()
+  assert p.on_refusal() == remote.CrcProbation.RESEND
+  # ...but the probation budget is per-run: terminal this time.
+  assert p.on_refusal() == remote.CrcProbation.QUARANTINE
+
+
+def test_crc_probation_probe_failure_is_terminal():
+  p = remote.CrcProbation(cooldown_secs=0.0)
+  assert p.on_refusal() == remote.CrcProbation.RESEND
+  assert p.on_refusal() == remote.CrcProbation.PROBE
+  # The probe itself is refused: re-quarantine on repeat failure.
+  assert p.on_refusal() == remote.CrcProbation.QUARANTINE
+  assert p.recoveries == 0
+  # An ordinary ack after quarantine-verdict changes nothing.
+  assert p.on_ack() is False

@@ -19,6 +19,7 @@ The two contracts everything here pins:
 import dataclasses
 import json
 import os
+import time
 
 import numpy as np
 import pytest
@@ -670,3 +671,64 @@ def test_replay_tier_crc_evicts_rotted_entry():
   off.add(rotten)
   assert off.sample(1) == [rotten]
   assert off.evictions_crc == 0
+
+
+class TestDynamicReplayK:
+  """Round 15: the controller's set_replay_k actuator — live changes
+  apply to batches staged AFTER the call; in-flight entries finish
+  the K they were staged under, with first-serve accounting pinned
+  to that K (never the live knob)."""
+
+  def test_set_replay_k_applies_to_new_batches_only(self):
+    buf = ring_buffer.TrajectoryBuffer(8)
+    pf = ring_buffer.BatchPrefetcher(buf, batch_size=2,
+                                     place_fn=lambda b: b, depth=1,
+                                     replay_k=1)
+    try:
+      for i in range(2):
+        buf.put(_unroll(i))
+      deadline = time.monotonic() + 10
+      while pf.stats()['staged_batches'] < 1 and \
+          time.monotonic() < deadline:
+        time.sleep(0.01)
+      assert pf.replay_k == 1
+      pf.set_replay_k(2)
+      assert pf.replay_k == 2
+      for i in range(2):
+        buf.put(_unroll(10 + i))
+      # Batch 1 was staged under k=1: exactly one serve.
+      b1 = pf.get(timeout=10)
+      # Batch 2 (staged under k=2): first serve + one bit-identical
+      # re-serve of the SAME staged object.
+      b2a = pf.get(timeout=10)
+      b2b = pf.get(timeout=10)
+      assert b2a is b2b and b1 is not b2a
+      # Fresh accounting: 2 batches x 2 fresh slots, credited at
+      # first serve only — the re-serve added nothing.
+      assert pf.fresh_slots_served() == 4
+      stats = pf.stats()
+      assert stats['serves'] == 3
+      assert stats['batch_reserves'] == 1
+      with pytest.raises(TimeoutError):
+        pf.get(timeout=0.1)
+      # Stepping back down: the next staged batch serves once again.
+      pf.set_replay_k(1)
+      for i in range(2):
+        buf.put(_unroll(20 + i))
+      b3 = pf.get(timeout=10)
+      assert b3 is not b2a
+      with pytest.raises(TimeoutError):
+        pf.get(timeout=0.1)
+      assert pf.fresh_slots_served() == 6
+    finally:
+      pf.close()
+
+  def test_set_replay_k_validates(self):
+    buf = ring_buffer.TrajectoryBuffer(2)
+    pf = ring_buffer.BatchPrefetcher(buf, batch_size=2,
+                                     place_fn=lambda b: b, depth=1)
+    try:
+      with pytest.raises(ValueError):
+        pf.set_replay_k(0)
+    finally:
+      pf.close()

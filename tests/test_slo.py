@@ -582,8 +582,11 @@ def test_clean_driver_run_all_pass_verdict_zero_captures(tmp_path):
   assert set(verdict['objectives']) == {
       o.name for o in slo.DEFAULT_OBJECTIVES}
   for name, e in verdict['objectives'].items():
-    assert e['state'] in (slo.OK, slo.NO_DATA, slo.NO_BASELINE), \
-        (name, e)
+    # info objectives are ADVISORY leading indicators (round 15: the
+    # controller's triggers) — a toy env-bound run legitimately burns
+    # learner_plane_utilization without failing anything.
+    assert (e['state'] in (slo.OK, slo.NO_DATA, slo.NO_BASELINE)
+            or e['severity'] == 'info'), (name, e)
   assert verdict['clean_exit'] is True
   # Zero captures = an empty diagnostics footprint.
   diag = tmp_path / 'diagnostics'
@@ -634,3 +637,70 @@ def test_slo_engine_off_writes_no_verdict(tmp_path):
                       **dict(_DRIVER_BASE, slo_engine=False)),
                max_steps=3, stall_timeout_secs=60)
   assert slo.read_verdict(str(tmp_path)) is None
+
+
+# --------------------------------------------------------------------
+# Round 15: the controller's locked snapshot API — burning()/margins
+# read from a second thread must be self-consistent mid-evaluation.
+# --------------------------------------------------------------------
+
+
+def test_control_snapshot_consistent_mid_evaluation(tmp_path):
+  """Two objectives judge the SAME gauge with opposite comparisons;
+  a torn (unlocked) read could catch one objective re-judged against
+  the new value while the other still carries the old one — the
+  locked control_snapshot must never show that."""
+  import threading
+
+  from scalable_agent_tpu import telemetry
+
+  reg = telemetry.MetricsRegistry()
+  gauge = reg.gauge('ctl/x')
+  objectives = [
+      slo.Objective(name='low', metric='ctl/x', comparison='<=',
+                    target=1.0, fast_window_secs=1.0,
+                    slow_window_secs=2.0),
+      slo.Objective(name='high', metric='ctl/x', comparison='>=',
+                    target=1.0, fast_window_secs=1.0,
+                    slow_window_secs=2.0),
+  ]
+  engine = slo.SloEngine(objectives, str(tmp_path), registry=reg,
+                         capture=False, min_samples=2)
+  stop = threading.Event()
+  torn = []
+
+  def reader():
+    while not stop.is_set():
+      snap = engine.control_snapshot()
+      low, high = snap['low'], snap['high']
+      # The one invariant a torn read would break: inside ONE
+      # snapshot both objectives were judged against the SAME sample.
+      if (low['value'] is not None and high['value'] is not None
+          and low['value'] != high['value']):
+        torn.append((low['value'], high['value']))
+      if (low['state'] == slo.BURNING
+          and high['state'] == slo.BURNING):
+        torn.append(('both-burning', low['value'], high['value']))
+      engine.burning()  # the locked list API must not deadlock
+
+  t = threading.Thread(target=reader)
+  t.start()
+  try:
+    now = 1000.0
+    for phase in range(60):
+      value = 5.0 if phase % 2 == 0 else 0.0
+      gauge.set(value)
+      for _ in range(8):
+        now += 0.3
+        engine.observe(now=now)
+  finally:
+    stop.set()
+    t.join(timeout=10)
+    engine.stop()
+  assert torn == []
+  # And the snapshot carries the control fields the policy table
+  # reads.
+  snap = engine.control_snapshot()
+  for entry in snap.values():
+    for key in ('state', 'value', 'margin', 'severity', 'burns'):
+      assert key in entry
