@@ -1762,38 +1762,186 @@ def bench_param_fanout(smoke):
   return results
 
 
+class _ThrottledFleet(_SyntheticFleet):
+  """Rate-limited synthetic producer: one unroll per `period` seconds
+  across the fleet — the ENV-BOUND regime (BENCH r9: ~150 fps feed vs
+  ~300k fps learner capacity) the hybrid filler exists for. Single
+  producer thread so the offered rate is the period, not its
+  multiple."""
+
+  def __init__(self, buffer, unroll, period):
+    super().__init__(buffer, unroll, num_threads=1)
+    self._period = period
+
+  def _produce(self):
+    import time as _time
+    from scalable_agent_tpu.runtime import ring_buffer
+    while not self._stop.is_set():
+      _time.sleep(self._period)
+      try:
+        self._buffer.put(self._unroll, timeout=0.2)
+      except (TimeoutError, ring_buffer.Closed):
+        continue
+
+
 def bench_anakin(smoke):
-  """Anakin research mode (parallel/anakin.py): the whole act+learn
-  loop fused on-device for the jittable bandit env. Reported alongside
-  the learner headline — it is a different (host-free, small-model)
-  operating point, not a replacement: the flagship model is
-  acting-latency-bound in this mode (docs/PARALLELISM.md)."""
+  """The Anakin runtime axis (round 16; parallel/anakin.py,
+  driver.train_anakin, docs/PARALLELISM.md):
+
+  1. Fused-loop fps rows over the jittable env family ({bandit,
+     cue_memory, gridworld} × {1 device, all local devices}) — the
+     all-device rows shard the env batch over the data mesh axis per
+     the `test_anakin_shards_over_the_mesh` discipline.
+  2. TWO references at the SAME model/shape, batch size, and device
+     set as the anakin bandit row (driver.choose_mesh shards the fed
+     learner over all local devices exactly like the all-device
+     anakin row):
+     - `fleet_reference` — the REAL fleet path (actors -> inference
+       server -> buffer -> learner), acting cost included:
+       `anakin_vs_fleet` is the end-to-end fusion win the >=3x
+       acceptance gate reads (the r4 chip artifact: 1.25M fused vs
+       the fed flagship's ~300k).
+     - `fed_reference` — a full-rate SYNTHETIC feed through the same
+       driver loop: the learner-loop ceiling with acting excluded.
+       `anakin_vs_fed` can legitimately read < 1 on a CPU build host
+       (synthetic data is free there and the fused loop still pays
+       its T sequential acting passes); on the chip the fed path's
+       transport/H2D terms return and the ratio shows the fusion win.
+       Reported so the two effects (acting amortization vs transport
+       deletion) stay separable.
+  3. The HYBRID row: driver.train under an env-THROTTLED synthetic
+     feed with --anakin_filler off vs on — learner-plane utilization
+     must be strictly higher with the filler ON while fleet
+     fresh-frame accounting (frame budget, fps) is unchanged at
+     filler-OFF parity. This is the accept/reject evidence for the
+     filler default (docs/PERF.md r13)."""
+  import dataclasses
   import numpy as np
+  import jax
+  from scalable_agent_tpu import driver
   from scalable_agent_tpu.config import Config
   from scalable_agent_tpu.parallel import anakin
+  from scalable_agent_tpu.parallel import mesh as mesh_lib
 
-  cfg = Config(
-      env_backend='bandit',
-      batch_size=256 if not smoke else 4,
-      unroll_length=20 if not smoke else 3,
-      num_action_repeats=1, episode_length=5,
+  n_dev = len(jax.devices())
+  steps = 200 if not smoke else 3
+  t = 20 if not smoke else 3
+  base = dict(
+      unroll_length=t, num_action_repeats=1,
       height=24, width=32, torso='shallow',
       compute_dtype='bfloat16' if not smoke else 'float32',
       use_instruction=False, use_py_process=False,
-      learning_rate=2e-3, entropy_cost=3e-3, discounting=0.0,
+      learning_rate=2e-3, entropy_cost=3e-3,
       total_environment_frames=10**9, seed=0)
-  steps = 200 if not smoke else 3
-  _, history, fps = anakin.run(cfg, steps)
-  rewards = [float(h['mean_reward']) for h in history]
-  tail = max(len(rewards) // 10, 1)
-  return {
-      'env_frames_per_sec': round(fps, 1),
-      'config': ('shallow, %dx%d, B=%d, T=%d, bandit' %
-                 (cfg.height, cfg.width, cfg.batch_size,
-                  cfg.unroll_length)),
-      'mean_reward_first': round(float(np.mean(rewards[:tail])), 3),
-      'mean_reward_last': round(float(np.mean(rewards[-tail:])), 3),
+  episode_lengths = {'bandit': 5, 'cue_memory': 2, 'gridworld': 12}
+
+  out = {'devices': n_dev}
+  for backend in ('bandit', 'cue_memory', 'gridworld'):
+    for devices in sorted({1, n_dev}):
+      b = 256 if not smoke else 8
+      b = max(b - b % devices, devices)  # shardable batch
+      cfg = Config(env_backend=backend, batch_size=b,
+                   episode_length=episode_lengths[backend],
+                   discounting=0.0 if backend == 'bandit' else 0.9,
+                   **base)
+      mesh = (mesh_lib.make_mesh() if devices > 1 else None)
+      _, history, fps = anakin.run(cfg, steps, mesh=mesh)
+      rewards = [float(h['mean_reward']) for h in history]
+      tail = max(len(rewards) // 10, 1)
+      out[f'{backend}_{devices}dev'] = {
+          'env_frames_per_sec': round(fps, 1),
+          'batch_size': b,
+          'mean_reward_first': round(float(np.mean(rewards[:tail])),
+                                     3),
+          'mean_reward_last': round(float(np.mean(rewards[-tail:])),
+                                    3),
+      }
+  out['config'] = ('shallow, 24x32, T=%d, %d step(s)' % (t, steps))
+
+  # --- Fed-fleet reference + hybrid filler rows: driver.train's REAL
+  # loop at the SAME model/shape, fed synthetically. ---
+  unroll = _transport_unroll(t + 1, 24, 32, num_actions=3)
+
+  def run_fed(tag, filler, period, seconds, batch_size,
+              real_fleet=False):
+    cfg = Config(env_backend='bandit', level_name='bandit',
+                 num_actors=4 if real_fleet else 0,
+                 batch_size=batch_size,
+                 episode_length=5, discounting=0.0,
+                 logdir=tempfile.mkdtemp(prefix=f'bench_anakin_{tag}_'),
+                 anakin_filler=filler,
+                 inference_timeout_ms=5,
+                 queue_capacity_batches=2, summary_secs=0,
+                 checkpoint_secs=10**6, slo_engine=False,
+                 controller='off',
+                 **{k: v for k, v in base.items()
+                    if k not in ('seed',)}, seed=13)
+
+    def fleet_factory(config, agent, policy, buffer, levels):
+      if period is None:
+        return _SyntheticFleet(buffer, unroll)
+      return _ThrottledFleet(buffer, unroll, period)
+
+    run = driver.train(cfg, max_seconds=seconds,
+                       stall_timeout_secs=120,
+                       fleet_factory=(None if real_fleet
+                                      else fleet_factory))
+    fps, _, last = _read_window_summaries(cfg.logdir,
+                                          cfg.frames_per_step)
+    return {
+        'fps': round(fps, 1),
+        'frames': int(run.frames),
+        'learner_plane_utilization': round(
+            last.get('learner_plane_utilization', 0.0), 3),
+        'filler_updates': int(last.get('filler_updates', 0)),
+        'filler_frames': int(last.get('filler_frames', 0)),
+    }
+
+  seconds = 20 if not smoke else 6
+  # Apples to apples: both references run the SAME batch as the
+  # anakin bandit rows, and choose_mesh shards them over all local
+  # devices — so the ratios' numerator is the matching-device anakin
+  # row, never a B-or-device artifact. The fleet reference uses the
+  # 4-actor CI-scale local fleet (acting through the real batcher).
+  anakin_ref = (out.get(f'bandit_{n_dev}dev')
+                or out['bandit_1dev'])
+  fleet_ref = run_fed('fleet', filler=False, period=None,
+                      seconds=seconds,
+                      batch_size=anakin_ref['batch_size'],
+                      real_fleet=True)
+  out['fleet_reference'] = dict(fleet_ref,
+                                batch_size=anakin_ref['batch_size'],
+                                num_actors=4)
+  if fleet_ref['fps'] > 0:
+    out['anakin_vs_fleet'] = round(
+        anakin_ref['env_frames_per_sec'] / fleet_ref['fps'], 2)
+  fed = run_fed('fed', filler=False, period=None, seconds=seconds,
+                batch_size=anakin_ref['batch_size'])
+  out['fed_reference'] = dict(fed, batch_size=anakin_ref['batch_size'])
+  if fed['fps'] > 0:
+    out['anakin_vs_fed'] = round(
+        anakin_ref['env_frames_per_sec'] / fed['fps'], 2)
+
+  # Hybrid: the SAME throttled env-bound feed, filler off vs on. The
+  # off row is the parity baseline (fresh-frame fps/frames must match
+  # the on row's fresh accounting — filler frames ride a separate
+  # ledger). Small batch on purpose: the rows measure utilization
+  # under a trickle feed, not throughput.
+  period = 0.25 if not smoke else 0.4
+  hybrid_b = 8 if not smoke else 2
+  hybrid_off = run_fed('off', filler=False, period=period,
+                       seconds=seconds, batch_size=hybrid_b)
+  hybrid_on = run_fed('on', filler=True, period=period,
+                      seconds=seconds, batch_size=hybrid_b)
+  out['hybrid'] = {
+      'feed_period_secs': period,
+      'filler_off': hybrid_off,
+      'filler_on': hybrid_on,
+      'utilization_lift': round(
+          hybrid_on['learner_plane_utilization'] -
+          hybrid_off['learner_plane_utilization'], 3),
   }
+  return out
 
 
 def bench_telemetry(smoke):
@@ -2189,6 +2337,22 @@ def main():
     })
     return
 
+  # BENCH_ONLY=anakin: just the runtime-axis rows (the scripts/ci.sh
+  # anakin lane — fused-loop fps over the jittable env family, the
+  # fed-fleet reference ratio, and the hybrid filler off/on
+  # utilization rows).
+  if os.environ.get('BENCH_ONLY') == 'anakin':
+    anakin_rows = bench_anakin(smoke)
+    _emit({
+        'metric': 'anakin_env_frames_per_sec',
+        'value': (anakin_rows.get('bandit_1dev') or {}).get(
+            'env_frames_per_sec'),
+        'unit': ('env-frames/sec, fused act+learn, bandit, 1 device%s'
+                 % (' (SMOKE)' if smoke else '')),
+        'anakin': anakin_rows,
+    })
+    return
+
   # BENCH_ONLY=telemetry: just the tracing/registry overhead rows
   # (the scripts/ci.sh telemetry smoke — the on/off accept gate).
   if os.environ.get('BENCH_ONLY') == 'telemetry':
@@ -2435,6 +2599,26 @@ def _headline(out):
     if curves.get('reuse_k2'):
       head['replay']['cue_memory_updates_per_env_frame'] = (
           curves['reuse_k2'].get('updates_per_env_frame'))
+  # The runtime-axis rows (round 16): single-device fused fps, the
+  # real-fleet ratio the >=3x acceptance gate reads (vs_fed is the
+  # acting-free learner ceiling, documented in docs/PERF.md r13), and
+  # the hybrid filler's utilization lift — the clip-safe record the
+  # --anakin_filler default flip is judged on.
+  anakin_rows = out.get('anakin')
+  if anakin_rows:
+    hybrid = anakin_rows.get('hybrid') or {}
+    head['anakin'] = {
+        'fps_1dev': (anakin_rows.get('bandit_1dev') or {}).get(
+            'env_frames_per_sec'),
+        'vs_fleet': anakin_rows.get('anakin_vs_fleet'),
+        'vs_fed': anakin_rows.get('anakin_vs_fed'),
+        'hybrid_utilization': {
+            'off': (hybrid.get('filler_off') or {}).get(
+                'learner_plane_utilization'),
+            'on': (hybrid.get('filler_on') or {}).get(
+                'learner_plane_utilization'),
+            'lift': hybrid.get('utilization_lift')},
+    }
   # The telemetry-plane cost (round 13): the on/off feed overhead the
   # always-on tracing default is accepted/rejected on (docs/PERF.md
   # r11) — clip-safe like every other default-flip record.

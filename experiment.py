@@ -26,8 +26,11 @@ _DEFAULTS = Config()
 
 flags.DEFINE_string('logdir', _DEFAULTS.logdir, 'Experiment directory.')
 flags.DEFINE_enum('mode', 'train', ['train', 'test', 'anakin'],
-                  'Run mode. anakin = fused on-device acting+learning '
-                  '(jittable CI envs only — parallel/anakin.py).')
+                  'Run mode. mode=anakin is the LEGACY research loop '
+                  '(parallel/anakin.train: summaries + checkpoint '
+                  'only); production Anakin runs are '
+                  '--mode=train --runtime=anakin, which adds the full '
+                  'lifecycle (health ladder, SLO verdict, incidents).')
 flags.DEFINE_integer('test_num_episodes', _DEFAULTS.test_num_episodes,
                      'Episodes per level in test mode.')
 flags.DEFINE_integer('task', _DEFAULTS.task,
@@ -124,9 +127,38 @@ flags.DEFINE_float('epsilon', _DEFAULTS.epsilon, 'RMSProp epsilon.')
 
 # --- TPU-build additions (not in the reference). ---
 flags.DEFINE_enum('env_backend', _DEFAULTS.env_backend,
-                  ['dmlab', 'atari', 'fake', 'bandit', 'cue_memory'],
+                  ['dmlab', 'atari', 'fake', 'bandit', 'cue_memory',
+                   'gridworld', 'procgen'],
                   'Environment backend (fake/bandit/cue_memory are '
-                  'simulator-free smoke tasks).')
+                  'simulator-free smoke tasks; gridworld/procgen are '
+                  'the pure-JAX family of envs/jittable.py — the same '
+                  'task runs under both --runtime values).')
+flags.DEFINE_enum('runtime', _DEFAULTS.runtime, ['fleet', 'anakin'],
+                  'Training runtime: fleet (host envs -> inference -> '
+                  'buffer -> learner, the production pipeline) or '
+                  'anakin (act+learn fused into one jitted device '
+                  'step for jittable env backends — Podracer '
+                  'arXiv:2104.06272 — under the same run lifecycle: '
+                  'checkpoints, health ladder, SLO verdict, JSONL '
+                  'streams; docs/PARALLELISM.md, RUNBOOK §13).')
+flags.DEFINE_bool('anakin_filler', _DEFAULTS.anakin_filler,
+                  'Hybrid filler fleets (fleet runtime): run one '
+                  'bounded Anakin self-play step on the learner chips '
+                  'whenever the prefetcher has no staged batch ready '
+                  '(a staged batch is never delayed by more than one '
+                  'filler step); fresh-frame clocks unchanged, filler '
+                  'work accounted separately. Default OFF pending the '
+                  'docs/PERF.md r13 accept/reject call.')
+flags.DEFINE_string('filler_backend', _DEFAULTS.filler_backend,
+                    "Filler env core ('' = auto: the run's backend "
+                    "when jittable, else 'bandit').")
+flags.DEFINE_integer('filler_batch_size', _DEFAULTS.filler_batch_size,
+                     'Filler rollout batch (0 = auto: batch_size).')
+flags.DEFINE_integer('filler_unroll_length',
+                     _DEFAULTS.filler_unroll_length,
+                     'Filler rollout length (0 = auto: '
+                     'min(unroll_length, 16) — short slices keep the '
+                     'yield bound tight).')
 flags.DEFINE_float('sticky_action_prob', _DEFAULTS.sticky_action_prob,
                    'Atari: per-frame previous-action repeat '
                    'probability (0.25 = Machado et al. evaluation '
@@ -563,6 +595,10 @@ def main(argv):
     return
   from scalable_agent_tpu import driver
   if cfg.mode == 'train':
+    # Both runtimes consume the drain event: the fleet loop drains
+    # (flush + verified checkpoint + resume manifest); the anakin loop
+    # stops cleanly at the next fused-step boundary with its tail
+    # checkpoint + SLO verdict (driver.train dispatches on --runtime).
     drain_supported.set()
     run = driver.train(cfg, drain_event=drain_event)
     logging.info('training done at %d frames', run.frames)

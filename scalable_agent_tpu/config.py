@@ -462,6 +462,40 @@ class Config:
   # re-quarantine on repeat failure. The controller's grow-fleet move
   # reclaims slots through this ladder (slots_rehabilitated).
   fleet_probation_secs: float = 30.0
+  # --- Runtime axis (round 16; docs/PARALLELISM.md, RUNBOOK §13).
+  # 'fleet' is the production Sebulba pipeline (host envs → inference
+  # → buffer → learner). 'anakin' fuses act+learn into ONE jitted
+  # device step (Podracer arXiv:2104.06272) for jittable env backends
+  # (JITTABLE_BACKENDS below) — the r4 bench measured it 4x the fed
+  # fleet path on the CI tasks — under the SAME run lifecycle:
+  # checkpoint ladder, health watchdog, metrics registry, SLO engine
+  # + verdict, summaries/incidents JSONL (driver.train dispatches on
+  # this axis; driver.train_anakin is the loop). ---
+  runtime: str = 'fleet'                  # fleet | anakin
+  # Hybrid filler fleets (fleet runtime only): whenever the
+  # prefetcher has NO staged batch ready, the driver runs ONE bounded
+  # Anakin self-play step on the learner chips instead of parking on
+  # the feed — learner-plane utilization is lifted by construction in
+  # env-bound regimes (the BENCH r9 shape: ~150 fps feed vs ~300k fps
+  # learner capacity) while a staged batch is never delayed by more
+  # than one filler step. Filler updates ride the IMPACT staleness
+  # argument (arXiv 1912.00167 — validate_runtime cross-links
+  # --surrogate); the frame budget, LR schedule, and fps meter stay
+  # on the fleet's fresh-frame clock (filler work is accounted
+  # separately: filler_updates/filler_frames summaries + the
+  # driver/filler_updates registry counter). DEFAULT OFF per the
+  # measured accept/reject discipline: bench.py's `anakin` stage
+  # measures the hybrid row every round and docs/PERF.md r13 records
+  # the call.
+  anakin_filler: bool = False
+  # Filler env core: '' = auto (env_backend itself when jittable,
+  # else 'bandit' — which accepts the main task's action-space width).
+  filler_backend: str = ''
+  # Filler rollout shape (0 = auto: the fleet's batch_size, and
+  # min(unroll_length, 16) — short slices keep the one-filler-step
+  # yield bound tight).
+  filler_batch_size: int = 0
+  filler_unroll_length: int = 0
   # --- Learner failure domain (health.py, round 7). ---
   # Training-health watchdog: the train step skips non-finite updates
   # on device (params carry over unchanged) and the driver escalates
@@ -526,6 +560,31 @@ class Config:
     if self.replay_max_staleness > 0:
       return self.replay_max_staleness
     return self.max_unroll_staleness
+
+  @property
+  def resolved_filler_backend(self) -> str:
+    """The hybrid filler's env core: the explicit knob, else the run's
+    own backend when it is jittable (the filler then self-plays the
+    REAL task), else 'bandit' (which accepts any policy-head width —
+    the filler must run under the main task's action space)."""
+    if self.filler_backend:
+      return self.filler_backend
+    if self.env_backend in JITTABLE_BACKENDS:
+      return self.env_backend
+    return 'bandit'
+
+  @property
+  def resolved_filler_batch_size(self) -> int:
+    return (self.filler_batch_size if self.filler_batch_size > 0
+            else self.batch_size)
+
+  @property
+  def resolved_filler_unroll_length(self) -> int:
+    """Filler rollout length (0-auto: min(T, 16)) — short slices keep
+    the one-filler-step yield bound tight at flagship T=100."""
+    if self.filler_unroll_length > 0:
+      return self.filler_unroll_length
+    return min(self.unroll_length, 16)
 
   @property
   def resolved_use_instruction(self) -> bool:
@@ -809,6 +868,81 @@ def validate_controller(config: Config) -> List[str]:
         'reused data (IMPACT, arXiv 1912.00167) — consider '
         '--surrogate=impact, or cap --controller_replay_k_max=1'
         % config.controller_replay_k_max)
+  return warnings
+
+
+# Env backends whose dynamics exist as jittable device cores
+# (parallel/anakin.ENV_CORES) — the backends --runtime=anakin and the
+# hybrid filler can run. Literal here because config.py must not
+# import jax-importing modules; tests/test_anakin.py pins this tuple
+# against the live ENV_CORES registry.
+JITTABLE_BACKENDS = ('bandit', 'cue_memory', 'gridworld', 'procgen')
+
+
+def validate_runtime(config: Config) -> List[str]:
+  """Validate the runtime-axis knob group (round 16); raises
+  ValueError on hard errors, returns warnings (same contract as the
+  other validate_* groups — driver.train calls it before spin-up for
+  BOTH runtimes).
+
+  The filler/SLO cross-link: the hybrid filler lifts
+  `learner_plane_utilization` to ~1.0 BY CONSTRUCTION, so that curve
+  can no longer signal an env-bound (or dead) env plane —
+  `env_plane_utilization` stays the dead-plane signal either way
+  (docs/OBSERVABILITY.md; the SLO engine's env-plane objective is the
+  page path filler must never mask)."""
+  warnings = []
+  if config.runtime not in ('fleet', 'anakin'):
+    raise ValueError(f'runtime must be fleet|anakin, got '
+                     f'{config.runtime!r}')
+  if config.filler_batch_size < 0:
+    raise ValueError(f'filler_batch_size must be >= 0, got '
+                     f'{config.filler_batch_size}')
+  if config.filler_unroll_length < 0:
+    raise ValueError(f'filler_unroll_length must be >= 0, got '
+                     f'{config.filler_unroll_length}')
+  if config.runtime == 'anakin':
+    if config.env_backend not in JITTABLE_BACKENDS:
+      raise ValueError(
+          f'--runtime=anakin needs a jittable env backend '
+          f'({", ".join(JITTABLE_BACKENDS)}), got '
+          f'{config.env_backend!r}; real simulators use the fleet '
+          'runtime')
+    if config.remote_actor_port:
+      warnings.append(
+          'runtime=anakin with remote_actor_port=%d: the fused '
+          'device loop has no ingest plane — the port will not be '
+          'bound' % config.remote_actor_port)
+    if config.anakin_filler:
+      warnings.append(
+          'anakin_filler=True under runtime=anakin is a no-op: the '
+          'whole run IS the on-device loop (the filler is the fleet '
+          "runtime's idle-slice workload)")
+    return warnings
+  if not config.anakin_filler:
+    if config.filler_backend:
+      warnings.append(
+          'filler_backend=%r with anakin_filler=False: nothing will '
+          'run it' % config.filler_backend)
+    return warnings
+  if config.resolved_filler_backend not in JITTABLE_BACKENDS:
+    raise ValueError(
+        f'filler_backend must be jittable '
+        f'({", ".join(JITTABLE_BACKENDS)}), got '
+        f'{config.filler_backend!r}')
+  if config.surrogate == 'vtrace':
+    warnings.append(
+        'anakin_filler=True with surrogate=vtrace: filler updates are '
+        'off-cadence relative to the fleet stream and plain V-trace '
+        'has no clipped-target anchor against them (IMPACT, '
+        'arXiv 1912.00167) — consider --surrogate=impact')
+  if not config.slo_engine:
+    warnings.append(
+        'anakin_filler=True with slo_engine=False: the filler lifts '
+        'learner_plane_utilization to ~1.0 by construction, and with '
+        'the engine off nothing watches env_plane_utilization — the '
+        'dead-env-plane signal the filler could otherwise mask '
+        '(docs/OBSERVABILITY.md)')
   return warnings
 
 

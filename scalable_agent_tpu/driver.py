@@ -48,7 +48,8 @@ from scalable_agent_tpu import slo as slo_lib
 from scalable_agent_tpu import telemetry
 from scalable_agent_tpu.config import (Config, validate_controller,
                                        validate_integrity,
-                                       validate_replay, validate_slo,
+                                       validate_replay,
+                                       validate_runtime, validate_slo,
                                        validate_transport)
 from scalable_agent_tpu.envs import factory, suites
 from scalable_agent_tpu.models import ImpalaAgent, init_params
@@ -301,6 +302,18 @@ def train(config: Config, max_steps: Optional[int] = None,
 
   Returns the TrainRun with the final state (all machinery shut down).
   """
+  # --- Runtime axis (round 16): --runtime=anakin runs the fused
+  # on-device act+learn loop under the SAME lifecycle contract this
+  # function provides the fleet (checkpoint ladder, health ladder,
+  # SLO verdict, summaries/incidents). One entry point, two operating
+  # points — callers never branch. ---
+  if config.runtime == 'anakin':
+    if fleet_factory is not None:
+      raise ValueError('fleet_factory is a fleet-runtime seam; '
+                       '--runtime=anakin has no fleet')
+    return train_anakin(config, max_steps=max_steps,
+                        max_seconds=max_seconds,
+                        drain_event=drain_event)
   if max_seconds is not None and jax.process_count() > 1:
     # Wall clocks differ per host: a time-based exit is NOT a
     # deterministic function of the shared step count, so hosts would
@@ -356,6 +369,11 @@ def train(config: Config, max_steps: Optional[int] = None,
   # cross-links (controller without the SLO engine, act-mode replay
   # escalation without the IMPACT anchor) log.
   for warning in validate_controller(config):
+    log.warning('%s', warning)
+  # Runtime-axis knob group (round 16): a non-jittable filler backend
+  # fails here before any env/checkpoint spin-up; cross-links (filler
+  # without the IMPACT anchor, filler with the SLO engine off) log.
+  for warning in validate_runtime(config):
     log.warning('%s', warning)
   # NOTE round 8: the fused Pallas V-trace is no longer rejected under
   # a mesh — the sharded step runs it shard_map'ped over the data axis
@@ -487,6 +505,7 @@ def train(config: Config, max_steps: Optional[int] = None,
   tracer = None
   slo_engine = None
   ctrl = None
+  filler = None
   # The remote-publish cadence as a mutable cell (round 15): the loop
   # below reads publish_cadence['secs'] instead of the frozen config
   # field, so the controller's publish_secs actuator can stretch it
@@ -522,9 +541,13 @@ def train(config: Config, max_steps: Optional[int] = None,
     # acting controller could raise replay_k mid-run (round 15): the
     # steps-derived arithmetic would overcount env frames the moment
     # the knob moves, and the serve-time counter is exact at
-    # replay_k=1 too.
+    # replay_k=1 too. The hybrid filler (round 16) arms it for the
+    # same reason from the other side: filler steps are learner
+    # updates that consume ZERO fresh env frames, so only the
+    # serve-time counter keeps the frame budget / LR clock / fps on
+    # the fleet's fresh-frame clock.
     reuse_on = (config.replay_k > 1 or config.replay_ratio > 0
-                or config.controller == 'act')
+                or config.controller == 'act' or config.anakin_filler)
     # ONE localization for both the ingest snapshot and the inference
     # server, UNCONDITIONALLY before the ingest branch: actor_params
     # is a cross-host collective in multi-host-TP mode, and
@@ -837,6 +860,34 @@ def train(config: Config, max_steps: Optional[int] = None,
       log.warning('controller=%s ignored: the SLO engine is off and '
                   'the controller has no other input',
                   config.controller)
+    # --- Hybrid filler (round 16, anakin.HybridFiller): idle feed
+    # slices run ONE bounded Anakin self-play step on the learner
+    # chips instead of parking — the loop below consults
+    # prefetcher.ready() (the ready-without-dequeue probe) so a
+    # staged batch is never delayed by more than one filler step.
+    # validate_runtime already rejected non-jittable backends; an
+    # unsupported TOPOLOGY (model-axis mesh, indivisible filler
+    # batch) degrades to plain parking with a warning like the
+    # staging-mode fallback — but a genuinely bad knob combination
+    # (e.g. a filler core that cannot honor the main task's
+    # action-space width) RAISES here, at spin-up, like every other
+    # validate_* error: an explicitly requested feature must never be
+    # silently off for the whole run.
+    if config.anakin_filler:
+      from scalable_agent_tpu.parallel import anakin as anakin_lib
+      filler_ok, filler_reason = anakin_lib.supports_filler(config,
+                                                            mesh)
+      if not filler_ok:
+        log.warning('anakin_filler disabled on this topology: %s',
+                    filler_reason)
+      else:
+        filler = anakin_lib.HybridFiller(agent, config, num_actions,
+                                         mesh=mesh)
+        log.info(
+            'hybrid filler armed: %r self-play (B=%d, T=%d) fills '
+            'idle learner slices; fresh-frame clocks unchanged',
+            filler.backend, filler.stats()['batch_size'],
+            filler.stats()['unroll_length'])
   except BaseException:
     # Best-effort bounded teardown, most-critical-first: the ingest
     # port release leads (a second interrupt landing mid-cleanup must
@@ -871,6 +922,8 @@ def train(config: Config, max_steps: Optional[int] = None,
       _try(ctrl.stop)  # no log finalize: the run never started
     if slo_engine is not None:
       _try(slo_engine.stop)  # no verdict: the run never started
+    if filler is not None:
+      _try(filler.close)
     _try(checkpointer.close)
     raise
 
@@ -958,6 +1011,11 @@ def train(config: Config, max_steps: Optional[int] = None,
   loop_start = time.monotonic()
   last_summary = time.monotonic()
   last_batch_time = time.monotonic()
+  # Hybrid-filler loop state (round 16): liveness-check gate for the
+  # filling regime + the incident edge detector for withheld
+  # (non-finite) filler updates.
+  last_filler_check = time.monotonic()
+  last_filler_skipped = 0
   poll_secs = 10.0 if stall_timeout_secs is None else min(
       10.0, stall_timeout_secs)
   try:
@@ -1006,6 +1064,38 @@ def train(config: Config, max_steps: Optional[int] = None,
       if (max_seconds is not None and
           time.monotonic() - loop_start > max_seconds):
         break
+      # --- Hybrid filler slice (round 16): nothing staged right now,
+      # so the learner chips run ONE bounded Anakin self-play step
+      # instead of parking in prefetcher.get. fill_one BLOCKS on the
+      # step's completion, so a batch staged meanwhile waits at most
+      # one filler step (the yield-determinism contract,
+      # tests/test_filler.py); the next iteration re-probes. Filler
+      # updates mutate params but never advance update_steps — the
+      # frame budget, LR schedule, and fps meter stay on the fleet's
+      # fresh-frame clock (serve-time accounting, armed above). ---
+      if (filler is not None and not draining
+          and not prefetcher.ready()):
+        run.state = filler.fill_one(run.state)
+        state = run.state
+        now_fill = time.monotonic()
+        if now_fill - last_filler_check > poll_secs:
+          # The starved branch's liveness duties, time-gated so a
+          # microsecond filler step doesn't health-check every slice:
+          # a dead fleet must still surface through the filler regime
+          # (filler frames must not mask a dead env plane — the
+          # env_plane_utilization objective pages, and the stall raise
+          # below still fires).
+          last_filler_check = now_fill
+          errors = fleet.errors() or errors
+          fleet.check_health(stall_timeout_secs=stall_timeout_secs)
+          if (stall_timeout_secs is not None and
+              now_fill - last_batch_time >
+              max(3 * stall_timeout_secs, 30.0)):
+            raise errors[0] if errors else TimeoutError(
+                'no trajectory batch despite healthy actors (hybrid '
+                'filler kept the learner busy; the env plane is the '
+                'incident)')
+        continue
       try:
         stats_view, action_counts, batch_device = prefetcher.get(
             timeout=0.5 if draining else poll_secs)
@@ -1674,6 +1764,28 @@ def train(config: Config, max_steps: Optional[int] = None,
         if tracer is not None:
           writer.scalar('trace_flight_records', len(tracer.flight),
                         step_now)
+        # Hybrid-filler surface (round 16): filler work is a SEPARATE
+        # ledger from the fresh-frame clock — updates/frames say how
+        # much idle learner capacity the filler reclaimed (the
+        # learner_plane_utilization lift is the headline), skipped
+        # counts non-finite filler updates the in-graph guard
+        # withheld (an incident on increase: a filler stream must
+        # never be able to poison params silently, and a climbing
+        # count means the self-play task itself is diverging).
+        if filler is not None:
+          fstats = filler.stats()
+          writer.scalar('filler_updates', fstats['updates'], step_now)
+          writer.scalar('filler_frames', fstats['frames'], step_now)
+          writer.scalar('filler_skipped_updates', fstats['skipped'],
+                        step_now)
+          if fstats['skipped'] > last_filler_skipped:
+            incidents.event('filler_skipped_updates', step=step_now,
+                            total=fstats['skipped'],
+                            delta=(fstats['skipped'] -
+                                   last_filler_skipped))
+            if health is not None:
+              health.note_external('filler_skipped_updates')
+            last_filler_skipped = fstats['skipped']
         # Controller surface (round 15): the action/revert counts and
         # the live actuator state, so a knob the controller moved is
         # visible in the same stream the objectives are judged from.
@@ -1781,6 +1893,10 @@ def train(config: Config, max_steps: Optional[int] = None,
           # entries.
           'controller': (dict(ctrl.counts(), mode=ctrl.mode)
                          if ctrl is not None else None),
+          # Hybrid-filler ledger (round 16): how much idle learner
+          # capacity self-play reclaimed — explicitly OUTSIDE the
+          # 'frames' fresh-frame figure above.
+          'filler': filler.stats() if filler is not None else None,
           'drain_source': drain_source,
           'drain_latency_secs': round(drain_latency, 3),
           'wall_time': round(time.time(), 3),
@@ -1869,6 +1985,13 @@ def train(config: Config, max_steps: Optional[int] = None,
     fleet.stop()
     prefetcher.close()
     server.close()
+    if filler is not None:
+      # Unregister the filler's per-run counter (identity-checked, so
+      # this can never evict a newer run's registration).
+      try:
+        filler.close()
+      except Exception:
+        log.exception('filler close failed')
     if ingest is not None:
       # Clean end → 'bye' frame (remote actors exit immediately);
       # exception unwind → crash semantics (actors keep their
@@ -1908,6 +2031,348 @@ def train(config: Config, max_steps: Optional[int] = None,
       if tracer is not None:
         telemetry.set_tracer(None)
         tracer.close()
+  return run
+
+
+def train_anakin(config: Config, max_steps: Optional[int] = None,
+                 max_seconds: Optional[float] = None,
+                 drain_event: Optional[threading.Event] = None
+                 ) -> TrainRun:
+  """The Anakin runtime (round 16, ROADMAP item 3): act+learn fused
+  into one jitted device step (parallel/anakin.py, Podracer
+  arXiv:2104.06272), run as a PRODUCTION run — the full lifecycle the
+  fleet runtime gets, not the bench curiosity the r4 artifact
+  measured at 1,250,181 fps:
+
+  - checkpoint ladder (PR 2/9): verified saves with content digests,
+    restore_latest at spin-up, LAST_GOOD rollback on health
+    escalation, structure-mismatch refusal without overwriting;
+  - health watchdog (PR 2): the in-graph non-finite guard is already
+    inside the fused step (learner.make_train_step_fn); here the host
+    monitor reads the one-step-delayed sentinels and escalates
+    skip → rollback → halt-with-bundle exactly like the fleet loop;
+  - metrics registry + SLO engine + verdict (PRs 10–11): the same
+    literal gauge names, the same default objective set, the same
+    SLO_VERDICT.json on every exit path, slo_violation incidents, and
+    the triggered jax.profiler capture served by this loop;
+  - summaries/incidents JSONL, config.json, FpsMeter — the artifact
+    contract every script (chaos/soak/slo_report) already reads.
+
+  Sharding: the mesh path shards the env batch over the data axis per
+  the `test_anakin_shards_over_the_mesh` discipline (params
+  replicate; jit inserts the gradient psum). Data-parallel and
+  single-host only — the fused loop has no cross-host batch
+  transport.
+
+  Pipeline-plane machinery (fleet, inference server, prefetcher,
+  ingest, tracer, controller) intentionally absent: there are no hops
+  to trace and no actuators to drive; the SLO objectives over those
+  planes evaluate no_data, which never violates. `drain_event`
+  (SIGTERM via experiment.py) stops the loop at the next fused-step
+  boundary — the finally's tail checkpoint + verdict are the drain.
+
+  Returns a TrainRun whose fleet/prefetcher/server/stats are None.
+  """
+  from scalable_agent_tpu.parallel import anakin as anakin_lib
+  if jax.process_count() > 1:
+    raise ValueError('runtime=anakin is single-host: the fused loop '
+                     'has no cross-host batch transport — each '
+                     'process would train an unsynchronized replica')
+  if config.model_parallelism > 1:
+    raise ValueError('runtime=anakin is data-parallel only; drop '
+                     '--model_parallelism')
+  # Knob-group validation, same contract as train(): hard errors
+  # raise before any spin-up cost; cross-links log.
+  for validate in (validate_runtime, validate_slo):
+    for warning in validate(config):
+      log.warning('%s', warning)
+  if config.controller != 'off':
+    log.info('controller=%s is a fleet-runtime feature: the anakin '
+             'runtime has no actuators (no prefetcher/admission/'
+             'publish/fleet knobs) — running without it',
+             config.controller)
+
+  mesh = choose_mesh(config)
+  env_core, agent, step, carry = anakin_lib.build_run(config,
+                                                      mesh=mesh)
+  del env_core
+  os.makedirs(config.logdir, exist_ok=True)
+
+  checkpointer = checkpoint_lib.Checkpointer(
+      config.logdir + '/checkpoints',
+      save_interval_secs=config.checkpoint_secs,
+      verify_digests=config.ckpt_digests)
+  restore_ok = False
+  try:
+    restored = checkpointer.restore_latest(carry.train_state)
+    restore_ok = True
+  except BaseException:
+    # A structure-mismatch raise must not leak the manager (its
+    # background threads survive a same-process retry) — and the
+    # finally below must NOT tail-save a fresh state into a logdir
+    # holding an incompatible checkpoint (restore_ok gates it).
+    checkpointer.close()
+    raise
+  if restored is not None:
+    carry = carry._replace(train_state=restored)
+    log.info('restored checkpoint at step %d',
+             int(jax.device_get(restored.update_steps)))
+  _initial_steps = int(jax.device_get(carry.train_state.update_steps))
+
+  writer = None
+  incidents = None
+  slo_engine = None
+  health = None
+  try:
+    writer = observability.SummaryWriter(config.logdir)
+    incidents = observability.EventLog(config.logdir)
+    with open(os.path.join(config.logdir, 'config.json'), 'w') as f:
+      json.dump(dataclasses.asdict(config), f, indent=2,
+                sort_keys=True)
+    fps_meter = observability.FpsMeter()
+    health = (health_lib.monitor_from_config(config)
+              if config.health_watchdog else None)
+    if config.slo_engine:
+      slo_objectives = slo_lib.load_objectives(
+          config.slo_spec,
+          fast_window_secs=config.slo_fast_window_secs,
+          slow_window_secs=config.slo_slow_window_secs)
+      slo_interval = (config.slo_interval_secs
+                      if config.slo_interval_secs > 0 else
+                      min(max(float(config.summary_secs), 1.0), 30.0,
+                          config.slo_fast_window_secs / 4.0))
+      slo_engine = slo_lib.SloEngine(
+          slo_objectives, config.logdir, writer=writer,
+          incidents=incidents, flight=None, health=health,
+          capture=config.slo_capture, interval_secs=slo_interval,
+          baseline=slo_lib.load_baseline(config.slo_fps_baseline))
+      slo_engine.start()
+  except BaseException:
+    if writer is not None:
+      writer.close()
+    if incidents is not None:
+      incidents.close()
+    if slo_engine is not None:
+      slo_engine.stop()
+    checkpointer.close()
+    raise
+
+  run = TrainRun(config, agent, carry.train_state, None, None, None,
+                 checkpointer, writer, None, fps_meter, health=health)
+  steps_done = 0
+  # Registry view of the loop (the same literal names train()
+  # registers — the SLO engine and the name lint see ONE inventory).
+  # The plane split is a fleet concept; in the fused runtime env and
+  # learner are the same XLA program, busy whenever the loop is, so
+  # both gauges pin 1.0 — fps_floor is the objective that catches a
+  # wedged loop. fleet_healthy_fraction stays unregistered (no fleet:
+  # no_data, never a violation).
+  _loop_gauges = [
+      telemetry.gauge('driver/update_steps',
+                      fn=lambda: steps_done + _initial_steps),
+      telemetry.gauge('driver/env_frames',
+                      fn=lambda: (steps_done + _initial_steps) *
+                      config.frames_per_step),
+      telemetry.gauge('driver/env_plane_utilization', fn=lambda: 1.0),
+      telemetry.gauge('driver/learner_plane_utilization',
+                      fn=lambda: 1.0),
+  ]
+  sync_every = anakin_lib._cpu_mesh_sync_every(mesh)
+  pending_metrics = None
+  prev_metrics = None
+  pending_sentinel = None
+  bad_count_in_burst = 0
+  slo_profile = None
+  loop_start = time.monotonic()
+  last_summary = loop_start
+  try:
+    while True:
+      if drain_event is not None and drain_event.is_set():
+        # SIGTERM: the fused loop quiesces at a step boundary — the
+        # finally's tail checkpoint + SLO verdict ARE the drain (no
+        # buffers to flush, no fleet to join).
+        incidents.event('anakin_stop_requested',
+                        step=_initial_steps + steps_done)
+        log.warning('stop requested (SIGTERM): finalizing at step %d',
+                    _initial_steps + steps_done)
+        break
+      frames = (_initial_steps + steps_done) * config.frames_per_step
+      if frames >= config.total_environment_frames:
+        break
+      if max_steps is not None and steps_done >= max_steps:
+        break
+      if (max_seconds is not None and
+          time.monotonic() - loop_start > max_seconds):
+        break
+      carry, metrics = step(carry)
+      run.state = carry.train_state
+      steps_done += 1
+      step_now = _initial_steps + steps_done
+      fps_meter.update(config.frames_per_step)
+      if sync_every is not None and steps_done % sync_every == 0:
+        jax.block_until_ready(metrics['total_loss'])
+      # One-step-delayed stacked metrics (the train() discipline): the
+      # summary read transfers already-computed values, never syncing
+      # the async dispatch chain.
+      prev_metrics = pending_metrics
+      pending_metrics = (step_now, observability.stack_metrics(metrics))
+
+      # SLO-triggered profiler capture (round 14): the engine thread
+      # already dumped what it could; the bounded jax.profiler window
+      # must ride the loop that dispatches device work.
+      if slo_engine is not None:
+        if slo_profile is not None:
+          name, end_step = slo_profile
+          if steps_done >= end_step:
+            jax.profiler.stop_trace()
+            slo_profile = None
+            log.info('SLO diagnostic profile for %r complete', name)
+        else:
+          req = slo_engine.take_profile_request()
+          if req is not None:
+            slo_prof_dir = os.path.join(config.logdir, 'diagnostics',
+                                        f'slo_profile_{req}')
+            os.makedirs(slo_prof_dir, exist_ok=True)
+            try:
+              jax.profiler.start_trace(slo_prof_dir)
+            except Exception:
+              log.exception('SLO profiler capture failed to start')
+              slo_engine.note_profile(req, None)
+            else:
+              slo_profile = (req,
+                             steps_done + config.slo_capture_steps)
+              slo_engine.note_profile(req, slo_prof_dir)
+
+      # --- Health ladder (PR 2), one-step delayed exactly like
+      # train(): skip-and-count → rollback to LAST_GOOD after K
+      # consecutive bad steps → halt with the diagnostic bundle. The
+      # fused step's in-graph guard already withheld any non-finite
+      # update on device. ---
+      if health is not None:
+        prev_sentinel = pending_sentinel
+        pending_sentinel = None
+        if steps_done % config.health_check_every_steps == 0:
+          pending_sentinel = (step_now,
+                              health_lib.stack_sentinels(metrics))
+        if prev_sentinel is not None:
+          obs_step, handle = prev_sentinel
+          verdict = health.observe_values(
+              obs_step, health_lib.read_handle(handle))
+          bad_count_in_burst += (verdict != health_lib.OK)
+          if verdict != health_lib.OK and bad_count_in_burst == 1:
+            incidents.event('health_bad_burst_start', step=obs_step,
+                            reason=health.last_reason)
+            log.warning('unhealthy training step %d: %s', obs_step,
+                        health.last_reason)
+          elif verdict == health_lib.OK and bad_count_in_burst > 0:
+            incidents.event('health_recovered', step=obs_step,
+                            bad_steps=bad_count_in_burst)
+            bad_count_in_burst = 0
+          if verdict == health_lib.ROLLBACK:
+            rolled = checkpointer.restore_last_good(carry.train_state)
+            if rolled is None:
+              verdict = health_lib.HALT
+              health.rollbacks -= 1  # granted but not honorable
+              health.last_reason = (f'{health.last_reason}; rollback '
+                                    'requested but no restorable '
+                                    'checkpoint exists')
+            else:
+              restored_step = int(jax.device_get(rolled.update_steps))
+              # Step counter stays monotone through a rollback (only
+              # params/opt/popart revert) — the train() contract.
+              carry = carry._replace(train_state=rolled._replace(
+                  update_steps=carry.train_state.update_steps))
+              run.state = carry.train_state
+              incidents.event('rollback', step=step_now,
+                              restored_checkpoint_step=restored_step,
+                              reason=health.last_reason, flight=None)
+              log.warning(
+                  'health rollback at step %d: restored checkpoint '
+                  'step %d', step_now, restored_step)
+          if verdict == health_lib.HALT:
+            bundle = health.write_halt_bundle(
+                config.logdir, config, step_now,
+                reason=health.last_reason, flight=None)
+            incidents.event('health_halt', step=step_now,
+                            reason=health.last_reason, bundle=bundle)
+            raise health_lib.TrainingDivergence(
+                f'training halted at step {step_now} after '
+                f'{health.rollbacks} rollback escalation(s): '
+                f'{health.last_reason}. Diagnostic bundle: {bundle}',
+                bundle_path=bundle)
+
+      now = time.monotonic()
+      if now - last_summary >= config.summary_secs:
+        last_summary = now
+        _, handle = (prev_metrics if prev_metrics is not None
+                     else pending_metrics)
+        writer.scalars(observability.read_stacked_metrics(handle),
+                       step_now)
+        writer.scalar('env_frames_per_sec', fps_meter.fps(), step_now)
+        if health is not None:
+          hs = health.stats()
+          writer.scalar('skipped_steps', hs['skipped_steps'],
+                        step_now)
+          writer.scalar('flagged_steps', hs['flagged_steps'],
+                        step_now)
+          writer.scalar('rollbacks', hs['rollbacks'], step_now)
+        writer.scalar('checkpoint_save_errors',
+                      checkpointer.save_errors, step_now)
+        writer.scalar('checkpoint_restore_fallbacks',
+                      checkpointer.restore_fallbacks, step_now)
+        writer.scalar('ckpt_digest_fallbacks',
+                      checkpointer.digest_fallbacks, step_now)
+        # Step-synchronous SLO evaluation (the chaos/summary_secs=0
+        # determinism contract, same as train()).
+        if slo_engine is not None:
+          slo_engine.observe()
+      healthy_now = health is None or bad_count_in_burst == 0
+      if healthy_now:
+        checkpointer.maybe_save(carry.train_state)
+  finally:
+    exiting_clean = sys.exc_info()[0] is None
+    if slo_engine is not None:
+      try:
+        slo_engine.stop()
+        verdict = slo_engine.finalize(
+            os.path.join(config.logdir, 'SLO_VERDICT.json'),
+            extra={'clean_exit': exiting_clean,
+                   'update_steps': _initial_steps + steps_done,
+                   'runtime': 'anakin'})
+        (log.info if verdict['pass'] else log.warning)(
+            'SLO verdict: %s (%d objective(s), violations: %s)',
+            'PASS' if verdict['pass'] else 'FAIL',
+            len(verdict['objectives']),
+            verdict['violations'] or 'none')
+      except Exception:
+        log.exception('SLO verdict write failed')
+    if slo_profile is not None:
+      jax.profiler.stop_trace()
+    try:
+      # Final summary flush: short runs end inside one window and
+      # would otherwise ship empty curves (anakin.train's contract).
+      if steps_done and pending_metrics is not None:
+        step_final, handle = pending_metrics
+        try:
+          writer.scalars(observability.read_stacked_metrics(handle),
+                         step_final)
+          writer.scalar('env_frames_per_sec', fps_meter.fps(),
+                        step_final)
+        except Exception:
+          log.exception('final summary flush failed')
+      unhealthy_exit = health is not None and bad_count_in_burst > 0
+      if unhealthy_exit:
+        log.warning('skipping final checkpoint: training was '
+                    'unhealthy at exit (the retained last-known-good '
+                    'checkpoint covers the resume)')
+      elif restore_ok:
+        checkpointer.save(run.state, force=True)
+    finally:
+      checkpointer.close()
+      writer.close()
+      incidents.close()
+      for gauge in _loop_gauges:
+        telemetry.registry().unregister(gauge.name, gauge)
   return run
 
 

@@ -70,6 +70,21 @@ def make_env_spec(config: Config, level_name: str, seed: int,
                   seed=seed, level_name=level_name,
                   num_action_repeats=config.num_action_repeats)
     frame_shape = (config.height, config.width, 3)
+  elif backend in ('gridworld', 'procgen'):
+    # Pure-JAX env family (round 16, envs/jittable.py): the host
+    # wrapper runs the SAME jittable core the Anakin runtime scans on
+    # device at batch=1 — the dual registration the runtime-axis
+    # parity gate rides on (one task definition, both runtimes).
+    from scalable_agent_tpu.envs import jittable
+    env_class = jittable.HOST_ENVS[backend]
+    num_actions = (config.num_actions or
+                   jittable.DEFAULT_NUM_ACTIONS[backend])
+    kwargs = dict(height=config.height, width=config.width,
+                  num_actions=num_actions,
+                  episode_length=config.episode_length,
+                  seed=seed, level_name=level_name,
+                  num_action_repeats=config.num_action_repeats)
+    frame_shape = (config.height, config.width, 3)
   elif backend == 'dmlab':
     from scalable_agent_tpu.envs import dmlab
     env_class = dmlab.DmLabEnv

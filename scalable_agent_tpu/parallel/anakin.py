@@ -38,6 +38,17 @@ every batch-leading leaf over the mesh's data axis — each device steps
 its slice of the environments and the learner locally, params
 replicate, and jit inserts the gradient psum over ICI (same placement
 discipline as train_parallel.py; `test_anakin_shards_over_the_mesh`).
+
+Round 16 promoted this module to a FIRST-CLASS RUNTIME
+(`--runtime=anakin` → driver.train_anakin: the fused loop under the
+full production lifecycle — checkpoint ladder, health ladder, SLO
+verdict, summaries/incidents), widened the jittable env family
+(envs/jittable.py gridworld + procgen cores, registered in ENV_CORES
+below AND as host envs so the same task runs under both runtimes),
+and added the HYBRID FILLER (`HybridFiller` at the bottom: Anakin
+self-play on the fleet runtime's idle learner slices, bounded to one
+step per feed probe, with every fleet clock left on the fresh-frame
+count). docs/PARALLELISM.md and RUNBOOK §13 carry the operator story.
 """
 
 from typing import Any, NamedTuple, Optional, Tuple
@@ -80,26 +91,38 @@ class BanditCore:
   """Jittable ContextualBanditEnv (envs/fake.py): the frame's dominant
   color channel is the rewarded action; `episode_length` steps per
   context. Same rewards, episode shape, and stats semantics as the
-  host version — property-tested side by side."""
+  host version — property-tested side by side.
 
-  num_actions = 3
+  `num_actions` widens the policy head exactly like the host env does
+  (the target stays `randint(num_actions) % 3`, the host's own draw):
+  the hybrid filler (HybridFiller) runs this core under the MAIN
+  task's action space, so a dmlab fleet's idle learner slices can
+  self-play without a second policy head."""
 
   def __init__(self, height=24, width=32, episode_length=5,
-               num_action_repeats=1):
+               num_action_repeats=1, num_actions=3):
+    if num_actions < 1:
+      raise ValueError(f'num_actions must be >= 1, got {num_actions}')
     self.height, self.width = height, width
     self.episode_length = episode_length
     self.num_action_repeats = num_action_repeats
+    self.num_actions = num_actions
 
   def _observation(self, state, visible=None):
     frame = _frame_from_channel(state.context, state.context.shape[0],
                                 self.height, self.width, visible)
     return (frame, _zero_instr(state.context.shape[0]))
 
+  def _sample_context(self, rng, shape):
+    # Mirrors the host env exactly: randint(num_actions) % 3 — the
+    # rewarded channel is always 0..2 regardless of head width.
+    return jax.random.randint(rng, shape, 0, self.num_actions) % 3
+
   def init(self, rng, batch) -> Tuple[EnvCoreState, StepOutput]:
     rng, sub = jax.random.split(rng)
     state = EnvCoreState(
         rng=rng,
-        context=jax.random.randint(sub, (batch,), 0, self.num_actions),
+        context=self._sample_context(sub, (batch,)),
         step_in_episode=jnp.zeros((batch,), jnp.int32),
         episode_return=jnp.zeros((batch,), jnp.float32),
         episode_frames=jnp.zeros((batch,), jnp.int32))
@@ -126,7 +149,7 @@ class BanditCore:
     zero_i = jnp.zeros_like(ep_frames)
 
     rng, sub = jax.random.split(state.rng)
-    fresh = jax.random.randint(sub, action.shape, 0, self.num_actions)
+    fresh = self._sample_context(sub, action.shape)
     new_state = EnvCoreState(
         rng=rng,
         context=jnp.where(done, fresh, state.context),
@@ -143,13 +166,17 @@ class CueMemoryCore:
   visible only on the first frame, fixed-action-0 bonus on the first
   step (relay-proof), match-the-cue reward on the second."""
 
-  num_actions = 3
-
   def __init__(self, height=16, width=16, episode_length=2,
-               num_action_repeats=1):
+               num_action_repeats=1, num_actions=3):
     del episode_length  # fixed two-step episodes, like the host env
+    if num_actions != 3:
+      # Mirrors the host CueMemoryEnv: one action per RGB cue channel.
+      raise ValueError('CueMemoryCore is a 3-action task (one action '
+                       'per RGB cue channel); got num_actions='
+                       f'{num_actions}')
     self.height, self.width = height, width
     self.num_action_repeats = num_action_repeats
+    self.num_actions = 3
 
   def _observation(self, state):
     visible = state.step_in_episode == 0  # cue only pre-first-action
@@ -201,7 +228,37 @@ class CueMemoryCore:
     return new_state, output
 
 
-ENV_CORES = {'bandit': BanditCore, 'cue_memory': CueMemoryCore}
+# The jittable env registry: the two CI cores above plus the round-16
+# pure-JAX family (gridworld + the procgen-style parameterized
+# generator — envs/jittable.py, which also registers the SAME cores as
+# host environments through envs/factory.py: the dual registration the
+# runtime-axis parity gate rides on). config.JITTABLE_BACKENDS mirrors
+# these keys as literals (config.py cannot import this module);
+# tests/test_anakin.py pins the two in sync.
+from scalable_agent_tpu.envs import jittable as _jittable  # noqa: E402
+
+ENV_CORES = {'bandit': BanditCore, 'cue_memory': CueMemoryCore,
+             **_jittable.JITTABLE_CORES}
+
+
+def make_env_core(config: Config, num_actions: Optional[int] = None):
+  """Construct the jittable core a config names. `num_actions`
+  overrides the head width (the hybrid filler passes the MAIN task's);
+  falls back to config.num_actions, then the core's default. A core
+  that cannot honor the width raises (CueMemoryCore is fixed at 3)."""
+  if config.env_backend not in ENV_CORES:
+    raise ValueError(
+        f'anakin needs a jittable env core, got '
+        f'{config.env_backend!r} (available: {sorted(ENV_CORES)}); '
+        'real simulators use the host pipeline (driver.train)')
+  core_cls = ENV_CORES[config.env_backend]
+  kwargs = dict(height=config.height, width=config.width,
+                episode_length=config.episode_length,
+                num_action_repeats=config.num_action_repeats)
+  width = num_actions if num_actions is not None else config.num_actions
+  if width is not None:
+    kwargs['num_actions'] = width
+  return core_cls(**kwargs)
 
 
 class AnakinCarry(NamedTuple):
@@ -212,6 +269,72 @@ class AnakinCarry(NamedTuple):
   agent_output: Any  # AgentOutput [B] — ditto
   core_state: Any    # LSTM carry (c, h) [B, hidden]
   rng: Any
+
+
+class EnvCarry(NamedTuple):
+  """The non-learner half of AnakinCarry: everything the fused loop
+  threads BESIDES the train state. Split out (round 16) so the hybrid
+  filler can persist its env-side state across fill slices while
+  borrowing the LIVE fleet TrainState at each slice."""
+  env_state: Any
+  env_output: Any
+  agent_output: Any
+  core_state: Any
+  rng: Any
+
+
+def init_env_carry(agent, env_core, config: Config, rng,
+                   mesh=None) -> EnvCarry:
+  """Initial env/agent-side carry for `make_anakin_step` (no params —
+  see `init_carry` for the composed whole).
+
+  With `mesh`, every [B]-leading leaf (env state, pending outputs,
+  LSTM carry) shards over the data axis. Core states are NamedTuples
+  whose `rng` field is the one replicated-by-name leaf ([2]u32 —
+  shape-sniffing would misplace it at b=2); every other leaf is
+  [B]-leading by the ENV_CORES protocol."""
+  b = config.batch_size
+  if mesh is not None:
+    from scalable_agent_tpu.parallel import mesh as mesh_lib
+    if b % mesh.shape[mesh_lib.DATA_AXIS] != 0:
+      # Before any init work — a full env init would be wasted.
+      raise ValueError(
+          f'batch_size={b} not divisible by the data axis '
+          f'({mesh.shape[mesh_lib.DATA_AXIS]} devices)')
+  rng, env_rng = jax.random.split(rng)
+  env_state, env_output = env_core.init(env_rng, b)
+  agent_output = AgentOutput(  # actor.py's priming output
+      action=jnp.zeros((b,), jnp.int32),
+      policy_logits=jnp.zeros((b, env_core.num_actions), jnp.float32),
+      baseline=jnp.zeros((b,), jnp.float32))
+  core_state = agent.initial_state(b)
+  if mesh is None:
+    return EnvCarry(env_state, env_output, agent_output, core_state,
+                    rng)
+
+  from jax.sharding import NamedSharding, PartitionSpec as P
+  from scalable_agent_tpu.parallel import mesh as mesh_lib
+  data = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+  replicated = NamedSharding(mesh, P())
+
+  def place(x):
+    x = jnp.asarray(x)
+    batch_leading = x.ndim >= 1 and x.shape[0] == b
+    return jax.device_put(x, data if batch_leading else replicated)
+
+  # The core's PRNG key is pinned replicated BY NAME (the ENV_CORES
+  # state protocol — every jittable core's state is a NamedTuple with
+  # an `rng` field; gridworld/procgen ride the same rule). Captured
+  # BEFORE the shape-sniffing placement, which would mis-shard the
+  # [2]u32 key whenever b == 2.
+  core_rng = env_state.rng
+  env_state = jax.tree_util.tree_map(place, env_state)
+  env_state = env_state._replace(
+      rng=jax.device_put(core_rng, replicated))
+  env_output, agent_output, core_state = jax.tree_util.tree_map(
+      place, (env_output, agent_output, core_state))
+  return EnvCarry(env_state, env_output, agent_output, core_state,
+                  jax.device_put(rng, replicated))
 
 
 def init_carry(agent, env_core, config: Config, rng,
@@ -225,62 +348,44 @@ def init_carry(agent, env_core, config: Config, rng,
   crosses ICI (inserted by jit from these placements, exactly like
   parallel/train_parallel.py)."""
   from scalable_agent_tpu.models import init_params
-  b = config.batch_size
-  if mesh is not None:
-    from scalable_agent_tpu.parallel import mesh as mesh_lib
-    if b % mesh.shape[mesh_lib.DATA_AXIS] != 0:
-      # Before any init work — a full param init would be wasted.
-      raise ValueError(
-          f'batch_size={b} not divisible by the data axis '
-          f'({mesh.shape[mesh_lib.DATA_AXIS]} devices)')
-  rng, params_rng, env_rng = jax.random.split(rng, 3)
+  rng, params_rng = jax.random.split(rng)
+  env = init_env_carry(agent, env_core, config, rng, mesh=mesh)
   obs_spec = {'frame': (env_core.height, env_core.width, 3),
               'instr_len': MAX_INSTRUCTION_LEN}
   params = init_params(agent, params_rng, obs_spec)
-  env_state, env_output = env_core.init(env_rng, b)
-  agent_output = AgentOutput(  # actor.py's priming output
-      action=jnp.zeros((b,), jnp.int32),
-      policy_logits=jnp.zeros((b, env_core.num_actions), jnp.float32),
-      baseline=jnp.zeros((b,), jnp.float32))
-  core_state = agent.initial_state(b)
-
   if mesh is None:
     train_state = learner.make_train_state(params, config)
-    return AnakinCarry(train_state, env_state, env_output,
-                       agent_output, core_state, rng)
-
-  from jax.sharding import NamedSharding, PartitionSpec as P
-  from scalable_agent_tpu.parallel import train_parallel
-  train_state = train_parallel.make_sharded_train_state(
-      params, config, mesh)
-  data = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
-  replicated = NamedSharding(mesh, P())
-
-  def place(x):
-    x = jnp.asarray(x)
-    batch_leading = x.ndim >= 1 and x.shape[0] == b
-    return jax.device_put(x, data if batch_leading else replicated)
-
-  # The env core's PRNG key is [2]u32 — shape-sniffing would misplace
-  # it at b=2, so it is pinned replicated by name.
-  env_state = EnvCoreState(
-      rng=jax.device_put(env_state.rng, replicated),
-      **{f: place(getattr(env_state, f))
-         for f in EnvCoreState._fields if f != 'rng'})
-  env_output, agent_output, core_state = jax.tree_util.tree_map(
-      place, (env_output, agent_output, core_state))
-  return AnakinCarry(train_state, env_state, env_output, agent_output,
-                     core_state, jax.device_put(rng, replicated))
+  else:
+    from scalable_agent_tpu.parallel import train_parallel
+    train_state = train_parallel.make_sharded_train_state(
+        params, config, mesh)
+  return AnakinCarry(train_state, *env)
 
 
 def make_anakin_step(agent, env_core, config: Config,
-                     return_batch: bool = False):
+                     return_batch: bool = False,
+                     train_step_fn=None,
+                     advance_steps: bool = True):
   """One fused device step: scan T acting steps, then the SGD update.
 
   Returns jitted `f(carry) -> (carry, metrics)` (donating the carry);
   with `return_batch` the assembled [T+1, B] ActorOutput is added to
-  the metrics dict under 'batch' (alignment tests)."""
-  train_step_fn = learner.make_train_step_fn(agent, config)
+  the metrics dict under 'batch' (alignment tests).
+
+  `train_step_fn` (round 16, the hybrid filler): an externally built
+  raw train step — the filler passes the FLEET config's, so the loss
+  hyperparameters, the in-graph health guard, and the LR schedule all
+  stay exactly the fleet's while this `config` only shapes the
+  on-device rollout (filler backend / batch / unroll).
+
+  `advance_steps=False` pins `update_steps` across the fused step (the
+  filler contract: filler updates must not advance the frame budget,
+  the LR clock, or the checkpoint step numbering — every clock the
+  run exposes stays on the fleet's fresh-frame count; IMPACT's
+  staleness tolerance, arXiv 1912.00167, is why an off-cadence update
+  against the frozen clock is a legal move)."""
+  if train_step_fn is None:
+    train_step_fn = learner.make_train_step_fn(agent, config)
   t = config.unroll_length
 
   def anakin_step(carry: AnakinCarry):
@@ -320,6 +425,9 @@ def make_anakin_step(agent, env_core, config: Config,
             lambda first, rest: jnp.concatenate([first[None], rest]),
             carry.agent_output, tail[1]))
     new_train_state, metrics = train_step_fn(carry.train_state, batch)
+    if not advance_steps:
+      new_train_state = new_train_state._replace(
+          update_steps=carry.train_state.update_steps)
     metrics['mean_reward'] = jnp.mean(batch.env_outputs.reward[1:])
     if return_batch:
       metrics['batch'] = batch
@@ -330,28 +438,18 @@ def make_anakin_step(agent, env_core, config: Config,
   return jax.jit(anakin_step, donate_argnums=(0,))
 
 
-def _build(config: Config, mesh=None, rng_seed: Optional[int] = None):
-  """Shared construction for run()/train(): validated env core, agent,
-  jitted fused step, initial carry."""
+def build_run(config: Config, mesh=None,
+              rng_seed: Optional[int] = None):
+  """Shared construction for run()/train()/driver.train_anakin():
+  validated env core, agent, jitted fused step, initial carry."""
   from scalable_agent_tpu import driver
-  if config.env_backend not in ENV_CORES:
-    raise ValueError(
-        f'anakin needs a jittable env core, got '
-        f'{config.env_backend!r} (available: {sorted(ENV_CORES)}); '
-        'real simulators use the host pipeline (driver.train)')
-  core_cls = ENV_CORES[config.env_backend]
-  env_core = core_cls(height=config.height, width=config.width,
-                      episode_length=config.episode_length,
-                      num_action_repeats=config.num_action_repeats)
-  if (config.num_actions is not None
-      and config.num_actions != env_core.num_actions):
-    # Fail fast: silently building a differently-shaped policy head
-    # than driver.train would for the same Config would make params/
-    # checkpoints incompatible between the two paths.
-    raise ValueError(
-        f'config.num_actions={config.num_actions} but the '
-        f'{config.env_backend!r} anakin core is a fixed '
-        f'{env_core.num_actions}-action task')
+  # The core honors config.num_actions the way the host factory does
+  # (wider heads are legal where the host env accepts them: bandit,
+  # gridworld, procgen); a core that cannot (CueMemoryCore is a fixed
+  # 3-action task) raises here — silently building a differently-
+  # shaped policy head than driver.train would for the same Config
+  # would make params/checkpoints incompatible between the runtimes.
+  env_core = make_env_core(config)
   agent = driver.build_agent(config, env_core.num_actions)
   step = make_anakin_step(agent, env_core, config)
   seed = config.seed if rng_seed is None else rng_seed
@@ -389,7 +487,7 @@ def train(config: Config, max_steps: Optional[int] = None, mesh=None):
   from scalable_agent_tpu import checkpoint as checkpoint_lib
   from scalable_agent_tpu import observability
 
-  _, _, step, carry = _build(config, mesh=mesh)
+  _, _, step, carry = build_run(config, mesh=mesh)
   os.makedirs(config.logdir, exist_ok=True)
   with open(os.path.join(config.logdir, 'config.json'), 'w') as f:
     json_lib.dump(dataclasses.asdict(config), f, indent=2,
@@ -470,7 +568,7 @@ def run(config: Config, num_steps: int, rng_seed: int = 0,
     raise ValueError(f'num_steps must be >= 1, got {num_steps}')
   if env_backend is not None and env_backend != config.env_backend:
     config = dataclasses.replace(config, env_backend=env_backend)
-  _, _, step, carry = _build(config, mesh=mesh, rng_seed=rng_seed)
+  _, _, step, carry = build_run(config, mesh=mesh, rng_seed=rng_seed)
 
   carry, metrics = step(carry)  # compile + step 1
   history = [metrics]
@@ -491,3 +589,148 @@ def run(config: Config, num_steps: int, rng_seed: int = 0,
   frames = (num_steps - 1) * config.frames_per_step
   fps = frames / dt if num_steps > 1 and dt > 0 else float('nan')
   return carry, [jax.device_get(m) for m in history], fps
+
+
+def supports_filler(config: Config, mesh=None) -> Tuple[bool, str]:
+  """Whether THIS topology can run the hybrid filler: (ok, reason).
+
+  Topology limits degrade to plain parking with a warning (the
+  staging-mode fallback pattern — the run is still correct, just
+  unfilled); everything else about the knob group (a non-jittable
+  backend, a filler core that cannot honor the main task's
+  action-space width) is a CONFIG error and fails at spin-up instead:
+  the driver only consults this gate, it never swallows construction
+  errors."""
+  if mesh is None:
+    return True, ''
+  from scalable_agent_tpu.parallel import mesh as mesh_lib
+  if mesh.shape[mesh_lib.MODEL_AXIS] > 1:
+    return False, 'the anakin filler is data-parallel only (model-' \
+                  'axis mesh in use)'
+  data = mesh.shape[mesh_lib.DATA_AXIS]
+  if config.resolved_filler_batch_size % data != 0:
+    return False, (f'filler batch {config.resolved_filler_batch_size} '
+                   f'not divisible by the data axis ({data} devices)')
+  return True, ''
+
+
+class HybridFiller:
+  """Anakin self-play as a FILLER workload on the learner chips
+  (round 16, ROADMAP item 3's creative step).
+
+  The regime: BENCH r9 measured an env-bound feed at ~150 fps against
+  ~300k fps of learner capacity — >99% of the learner plane idles
+  whenever the env plane is the bound. The driver's fleet loop
+  (driver.train) consults `fill_one` exactly when the prefetcher has
+  NO staged batch ready (the ready-without-dequeue probe): one fused
+  Anakin self-play step runs on the learner chips, then the feed is
+  re-probed — so a staged batch is never delayed by more than one
+  filler step (`fill_one` BLOCKS on the step's completion; the bound
+  is structural, not statistical). IMPACT's staleness tolerance
+  (arXiv 1912.00167) is why interleaving off-cadence updates from a
+  different data stream is a legal move — and why
+  config.validate_runtime cross-links the knob with
+  `--surrogate=impact`.
+
+  Clock discipline (the PR 7 serve-time attribution, extended): the
+  filler's train step is built from the FLEET config
+  (`make_anakin_step(train_step_fn=...)`) and runs with
+  `advance_steps=False`, so the frame budget, the LR schedule, the
+  checkpoint step numbering, and the fps meter all stay on the
+  fleet's fresh-frame clock; filler work is accounted SEPARATELY
+  (`updates`/`frames` here, the `driver/filler_updates` registry
+  counter, and the driver's filler_updates/filler_frames summary
+  scalars).
+
+  Pure-DP only: the fused step shards the env batch over the data
+  axis exactly like init_env_carry; a model-axis mesh raises and the
+  driver falls back to plain parking with a warning.
+  """
+
+  def __init__(self, agent, config: Config, num_actions: int,
+               mesh=None):
+    import dataclasses
+    from scalable_agent_tpu import telemetry
+    backend = config.resolved_filler_backend
+    if backend not in ENV_CORES:
+      raise ValueError(
+          f'filler backend {backend!r} is not a jittable env core '
+          f'(available: {sorted(ENV_CORES)})')
+    if mesh is not None:
+      from scalable_agent_tpu.parallel import mesh as mesh_lib
+      if mesh.shape[mesh_lib.MODEL_AXIS] > 1:
+        raise ValueError('the anakin filler is data-parallel only '
+                         '(model-axis mesh in use)')
+    self._config = dataclasses.replace(
+        config,
+        env_backend=backend,
+        batch_size=config.resolved_filler_batch_size,
+        unroll_length=config.resolved_filler_unroll_length,
+        num_actions=None)
+    core = make_env_core(self._config, num_actions=num_actions)
+    # The FLEET config's raw train step: loss hyperparameters, the
+    # in-graph non-finite guard, and the LR schedule stay the fleet's
+    # (the schedule reads update_steps, which advance_steps=False
+    # freezes at the fleet's count — filler updates apply at the LR
+    # the fleet is currently training at).
+    train_fn = learner.make_train_step_fn(agent, config)
+    self._step = make_anakin_step(agent, core, self._config,
+                                  train_step_fn=train_fn,
+                                  advance_steps=False)
+    self._env = init_env_carry(
+        agent, core, self._config,
+        jax.random.PRNGKey(config.seed + 7777), mesh=mesh)
+    self.backend = backend
+    self.updates = 0
+    self.skipped = 0
+    self.frames_per_update = (self._config.batch_size *
+                              self._config.unroll_length *
+                              config.num_action_repeats)
+    self._counter = telemetry.counter('driver/filler_updates')
+
+  @property
+  def frames(self) -> int:
+    """Cumulative FILLER env frames — never mixed into the fleet's
+    fresh-frame budget/fps; the separate summary curve."""
+    return self.updates * self.frames_per_update
+
+  def fill_one(self, train_state):
+    """One bounded self-play slice: run a fused Anakin step on the
+    live train state and BLOCK until it completes (the one-filler-step
+    delay bound a just-staged batch sees). Returns the updated train
+    state; env-side carry persists here across slices."""
+    carry = AnakinCarry(train_state, *self._env)
+    carry, metrics = self._step(carry)
+    # The completion barrier IS the yield bound: a staged batch that
+    # landed while this step ran is picked up immediately after.
+    step_ok = metrics.get('step_ok')
+    if step_ok is not None:
+      loss_ok = jax.device_get(step_ok)
+      if float(loss_ok) < 0.5:
+        # The in-graph guard already withheld the non-finite update
+        # (params carried over); count it — a filler stream must
+        # never be able to poison the fleet's params silently.
+        self.skipped += 1
+    else:
+      jax.block_until_ready(metrics['total_loss'])
+    self.updates += 1
+    self._counter.inc()
+    self._env = EnvCarry(carry.env_state, carry.env_output,
+                         carry.agent_output, carry.core_state,
+                         carry.rng)
+    return carry.train_state
+
+  def stats(self):
+    return {'updates': self.updates, 'frames': self.frames,
+            'skipped': self.skipped, 'backend': self.backend,
+            'batch_size': self._config.batch_size,
+            'unroll_length': self._config.unroll_length}
+
+  def close(self):
+    """Unregister the per-run counter (the registry teardown contract
+    every driver-owned metric follows): a later run in the same
+    process must not snapshot a dead run's filler tally. Identity-
+    checked, so closing an old filler never evicts a newer one's
+    registration."""
+    from scalable_agent_tpu import telemetry
+    telemetry.registry().unregister(self._counter.name, self._counter)

@@ -859,6 +859,16 @@ class BatchPrefetcher:
         self._closed = True
         self._ready.notify_all()
 
+  def ready(self) -> bool:
+    """Ready-without-dequeue probe (round 16, the hybrid filler's
+    yield check): True when a `get()` right now would NOT block — a
+    batch is staged, or the prefetcher is closed/errored (then get()
+    raises immediately, which is the caller's signal to take its
+    normal error path instead of filling forever). Never consumes,
+    never counts toward the wait telemetry."""
+    with self._lock:
+      return bool(self._out) or self._closed
+
   def get(self, timeout: Optional[float] = None):
     deadline = None if timeout is None else time.monotonic() + timeout
     t0 = time.monotonic()

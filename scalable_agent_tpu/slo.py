@@ -229,6 +229,22 @@ DEFAULT_OBJECTIVES = (
               metric='driver/learner_plane_utilization',
               comparison='>=', target=0.05, severity='info',
               description='learner not starved by the env plane'),
+    # Filler-aware variant (round 16, the hybrid filler /
+    # --runtime=anakin): with the filler ON — or under the fused
+    # anakin runtime — the learner plane is lifted to ~1.0 BY
+    # CONSTRUCTION (idle feed slices run Anakin self-play), so this
+    # stricter floor burning on such a run means the filler itself is
+    # failing to fill. On a plain env-bound fleet run it burns
+    # benignly (info can never fail a verdict) — that burn IS the
+    # capacity-headroom signal the filler knob exists for. Filler
+    # frames must NOT mask a dead env plane: env_plane_utilization
+    # above stays the dead-plane signal either way
+    # (config.validate_runtime cross-links the knobs).
+    Objective(name='learner_plane_utilization_filler',
+              metric='driver/learner_plane_utilization',
+              comparison='>=', target=0.9, severity='info',
+              description='hybrid filler keeps the learner plane '
+                          '~fully busy'),
     # Transport-pressure leading indicator (round 15, controller.py):
     # ack service time is the end-to-end backpressure remote pumps
     # feel — the controller's stretch-publish-cadence trigger.

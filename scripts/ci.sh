@@ -237,6 +237,44 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py \
 CHAOS_SMOKE=1 CHAOS_STORM=controller python scripts/chaos.py
 BENCH_SMOKE=1 BENCH_ONLY=controller python bench.py
 
+echo '== anakin-runtime lane (round 16: the --runtime={fleet,anakin}'
+echo '   axis — jittable env family semantics + mesh sharding, the'
+echo '   hybrid filler (yield determinism, fresh-vs-filler frame'
+echo '   accounting), then a tiny --runtime=anakin driver run'
+echo '   asserting the full lifecycle artifacts land (SLO_VERDICT'
+echo '   green, summaries/incidents JSONL, checkpoint restore), and'
+echo '   the BENCH_ONLY=anakin smoke with the fed-reference + hybrid'
+echo '   rows — <120 s CPU) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_anakin.py \
+  tests/test_filler.py -q -p no:cacheprovider
+JAX_PLATFORMS=cpu python - <<'ANAKIN_EOF'
+import json, logging, os, sys, tempfile
+logging.basicConfig(level=logging.WARNING)
+sys.path.insert(0, os.getcwd())
+from scalable_agent_tpu import driver, slo
+from scalable_agent_tpu.config import Config
+logdir = tempfile.mkdtemp(prefix='ci_anakin_')
+cfg = Config(logdir=logdir, runtime='anakin', env_backend='cue_memory',
+             batch_size=4, unroll_length=5, num_action_repeats=1,
+             height=24, width=32, torso='shallow', use_py_process=False,
+             use_instruction=False, summary_secs=0, checkpoint_secs=0,
+             total_environment_frames=6 * 4 * 5, seed=5)
+run = driver.train(cfg)   # dispatches on --runtime
+assert run.frames == 120, run.frames
+verdict = slo.read_verdict(logdir)
+assert verdict is not None, 'no SLO_VERDICT.json from the anakin run'
+assert verdict['pass'], f"anakin verdict FAILED: {verdict['violations']}"
+for stream in ('summaries.jsonl', 'incidents.jsonl', 'config.json'):
+    assert os.path.exists(os.path.join(logdir, stream)), stream
+# Checkpoint restore: a second run on the same logdir resumes at the
+# already-met frame target instead of training from step 0.
+run2 = driver.train(cfg)
+assert run2.frames == 120, run2.frames
+print('anakin lane OK: 6 fused steps, verdict PASS, restore green')
+ANAKIN_EOF
+XLA_FLAGS='--xla_force_host_platform_device_count=8' \
+  BENCH_SMOKE=1 BENCH_ONLY=anakin python bench.py
+
 echo '== telemetry smoke (trace spans end to end: registry semantics,'
 echo '   tracer pipeline, v8 negotiation + remote stamping,'
 echo '   trace_report reconstruction; then the tiny tracing-on/off'

@@ -507,6 +507,23 @@ def main():
               for name, e in
               (slo_verdict.get('objectives') or {}).items()},
       }
+      # Round 16 (ROADMAP item 3): the learner-plane utilization SLO
+      # row, explicit in the soak artifact — the number the hybrid
+      # filler exists to lift. With --anakin_filler the filler floor
+      # objective must read ok (~1.0 by construction; burning means
+      # the filler failed to fill); without it the plain row is the
+      # env-bound capacity-headroom measurement. env stays alongside
+      # as the dead-plane signal filler frames must never mask.
+      objs = slo_verdict.get('objectives') or {}
+      slo_block['plane_utilization'] = {
+          'learner': (objs.get('learner_plane_utilization')
+                      or {}).get('value'),
+          'learner_filler_floor_state': (
+              objs.get('learner_plane_utilization_filler')
+              or {}).get('state'),
+          'env': (objs.get('env_plane_utilization') or {}).get(
+              'value'),
+      }
       if not slo_verdict.get('pass'):
         problems.append(
             'SLO verdict FAILED over the soak window: '

@@ -114,9 +114,29 @@ def test_headline_carries_inference_plane_rows(tmp_path, capsys):
 
 
 def test_anakin_bench_smoke():
+  """The round-16 stage shape: per-{backend, devices} fps rows, the
+  fed-fleet reference + ratio, and the hybrid filler off/on rows with
+  fresh-frame parity."""
   results = bench.bench_anakin(smoke=True)
-  assert results['env_frames_per_sec'] > 0
-  assert 0 <= results['mean_reward_last'] <= 1.0
+  for backend in ('bandit', 'cue_memory', 'gridworld'):
+    row = results[f'{backend}_1dev']
+    assert row['env_frames_per_sec'] > 0, (backend, row)
+  assert 0 <= results['bandit_1dev']['mean_reward_last'] <= 1.0
+  assert results['fed_reference']['fps'] > 0
+  assert results['anakin_vs_fed'] > 0
+  # The acceptance reference: the REAL fleet path (acting included)
+  # at the same shape/batch — the fused loop must beat it soundly
+  # even on the CPU build host (it deletes the batcher round trips).
+  assert results['fleet_reference']['fps'] > 0
+  assert results['anakin_vs_fleet'] > 1.0, results['anakin_vs_fleet']
+  hybrid = results['hybrid']
+  # The filler lifts learner-plane utilization under the throttled
+  # feed while the fresh-frame ledger stays the fleet's own (filler
+  # frames ride their separate counters).
+  assert (hybrid['filler_on']['learner_plane_utilization'] >
+          hybrid['filler_off']['learner_plane_utilization'])
+  assert hybrid['filler_on']['filler_updates'] > 0
+  assert hybrid['filler_off']['filler_updates'] == 0
 
 
 def test_read_window_summaries_counts_frames_over_window(tmp_path):
