@@ -2282,7 +2282,147 @@ def bench_controller(smoke):
   return results
 
 
+def _multihost_child_main():
+  """Child body of the multihost stage: one process of the 2-process
+  jax.distributed drill (or the 1-process reference when
+  BENCH_MH_NPROCS=1 — then no distributed runtime at all, the true
+  single-controller baseline). Runs the REAL driver.train and reports
+  the steady-state env-frames/sec (median of the back half of the
+  summary stream's fps curve, so compile time and ramp-up don't
+  pollute the row)."""
+  proc = int(os.environ['BENCH_MH_PROC'])
+  nprocs = int(os.environ['BENCH_MH_NPROCS'])
+  steps = int(os.environ['BENCH_MH_STEPS'])
+  batch_per = int(os.environ['BENCH_MH_BATCH_PER'])
+  logdir = os.environ['BENCH_MH_DIR']
+  os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=1'
+  import jax
+  jax.config.update('jax_platforms', 'cpu')
+  if nprocs > 1:
+    from scalable_agent_tpu.parallel import distributed
+    distributed.initialize(
+        f"localhost:{os.environ['BENCH_MH_PORT']}",
+        num_processes=nprocs, process_id=proc,
+        heartbeat_interval_secs=1, max_missing_heartbeats=8)
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu.config import Config
+  cfg = Config(
+      logdir=logdir, env_backend='bandit', level_name='bandit',
+      num_actors=2, batch_size=batch_per * nprocs,
+      unroll_length=10, num_action_repeats=1, episode_length=8,
+      height=24, width=32, torso='shallow', use_py_process=False,
+      use_instruction=False, total_environment_frames=10**9,
+      inference_timeout_ms=5, checkpoint_secs=600, summary_secs=0,
+      seed=5)
+  run = driver.train(cfg, max_steps=steps, stall_timeout_secs=120)
+  assert int(run.state.update_steps) == steps
+  fname = ('summaries.jsonl' if proc == 0
+           else f'summaries_p{proc}.jsonl')
+  fps = []
+  with open(os.path.join(logdir, fname)) as f:
+    for line in f:
+      event = json.loads(line)
+      if event['tag'] == 'env_frames_per_sec' and event['value'] > 0:
+        fps.append(event['value'])
+  back = fps[len(fps) // 2:] or [0.0]
+  back.sort()
+  print(f'BENCH_MH proc={proc} fps={back[len(back) // 2]:.1f}',
+        flush=True)
+
+
+def bench_multihost(smoke):
+  """The multi-process runtime (round 17): per-process fps through the
+  REAL spin-up path (distributed.initialize with gloo collectives,
+  per-host fleets feeding process-local shards, the cross-process
+  gradient psum) vs the single-process row at the SAME per-process
+  shape — `scaling_fraction` = multihost global fps / (nprocs x the
+  single-process fps), the weak-scaling headline ROADMAP item 1 asks
+  for as "a recorded number instead of a hope".
+
+  This host runs the drill as 2 OS processes x 1 virtual CPU device
+  (the mechanism and its overheads: gloo collectives, coordination
+  heartbeats, per-host summary streams). Real pod rows come from
+  running bench on the pod itself with the coordinator flags —
+  recorded in docs/PERF.md when chip artifacts land."""
+  import socket
+  import subprocess
+  import sys
+  nprocs = 2
+  steps = 20 if smoke else 120
+  batch_per = 4
+
+  def run_topology(n):
+    tmpdir = tempfile.mkdtemp(prefix=f'bench_mh_{n}proc_')
+    sock = socket.socket()
+    sock.bind(('localhost', 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ('XLA_FLAGS', 'JAX_PLATFORMS')}
+    env.update(BENCH_MH_CHILD='1', BENCH_MH_NPROCS=str(n),
+               BENCH_MH_PORT=str(port), BENCH_MH_DIR=tmpdir,
+               BENCH_MH_STEPS=str(steps),
+               BENCH_MH_BATCH_PER=str(batch_per))
+    import shutil
+    procs = []
+    fps = {}
+    try:
+      for i in range(n):
+        env_i = dict(env, BENCH_MH_PROC=str(i))
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env_i, text=True))
+      for i, p in enumerate(procs):
+        out, _ = p.communicate(timeout=600)
+        assert p.returncode == 0, (
+            f'multihost bench child {i}/{n} failed:\n{out[-2000:]}')
+        for line in out.splitlines():
+          if line.startswith('BENCH_MH '):
+            parts = dict(kv.split('=') for kv in line.split()[1:])
+            fps[int(parts['proc'])] = float(parts['fps'])
+    finally:
+      # One child failing (or timing out) must not orphan its
+      # siblings holding CPU and the coordinator port, nor leak the
+      # scratch dir.
+      for p in procs:
+        if p.poll() is None:
+          p.kill()
+        p.communicate()
+      shutil.rmtree(tmpdir, ignore_errors=True)
+    return fps
+
+  single = run_topology(1)[0]
+  multi = run_topology(nprocs)
+  # Every process reports the GLOBAL frame rate (frames_per_step is
+  # the global batch); the honest aggregate is the minimum — the
+  # slowest host paces the collective step.
+  mh_fps = min(multi.values())
+  results = {
+      'nprocs': nprocs,
+      'steps': steps,
+      'global_batch': batch_per * nprocs,
+      'single_1proc': {'env_frames_per_sec': round(single, 1)},
+      f'multihost_{nprocs}proc': {
+          'env_frames_per_sec': round(mh_fps, 1),
+          'per_process': round(mh_fps / nprocs, 1),
+          'per_process_fps': {str(k): round(v, 1)
+                              for k, v in multi.items()},
+      },
+      # Weak scaling: n processes each carry the single-process
+      # per-host load; 1.0 = the runtime added zero overhead.
+      'scaling_fraction': (round(mh_fps / (nprocs * single), 3)
+                           if single > 0 else None),
+  }
+  return results
+
+
 def main():
+  # Child half of the multihost stage: a fresh interpreter dispatched
+  # by bench_multihost — must run before any jax/backend setup below.
+  if os.environ.get('BENCH_MH_CHILD'):
+    _multihost_child_main()
+    return
   # BENCH_SMOKE=1: tiny shapes on CPU — validates bench mechanics in CI
   # without the chip. The driver runs the real thing (no env var, TPU).
   smoke = os.environ.get('BENCH_SMOKE') == '1'
@@ -2379,6 +2519,21 @@ def main():
     })
     return
 
+  # BENCH_ONLY=multihost: just the 2-process runtime rows (the
+  # scripts/ci.sh multihost lane — per-process fps + the scaling
+  # fraction vs the single-process row).
+  if os.environ.get('BENCH_ONLY') == 'multihost':
+    mh = bench_multihost(smoke)
+    _emit({
+        'metric': 'multihost_scaling_fraction',
+        'value': mh.get('scaling_fraction'),
+        'unit': ('multihost global fps / (nprocs x single-process '
+                 'fps), 2 procs x 1 CPU device%s'
+                 % (' (SMOKE)' if smoke else '')),
+        'multihost': mh,
+    })
+    return
+
   # BENCH_ONLY=controller: just the controller-loop rows (the
   # scripts/ci.sh controller lane — idle/acting tick + cycle cost).
   if os.environ.get('BENCH_ONLY') == 'controller':
@@ -2446,6 +2601,9 @@ def main():
   ctrl_rows = None
   if os.environ.get('BENCH_SKIP_CONTROLLER') != '1':
     ctrl_rows = bench_controller(smoke)
+  mh_rows = None
+  if os.environ.get('BENCH_SKIP_MULTIHOST') != '1':
+    mh_rows = bench_multihost(smoke)
 
   baseline_per_chip = 200_000.0 / 16.0  # north star / v5e-16 chips
   out = {
@@ -2493,6 +2651,8 @@ def main():
     out['slo'] = slo_rows
   if ctrl_rows is not None:
     out['controller'] = ctrl_rows
+  if mh_rows is not None:
+    out['multihost'] = mh_rows
   _emit(out)
 
 
@@ -2649,6 +2809,19 @@ def _headline(out):
         'idle_tick_us': ctrl_rows.get('idle_tick_us'),
         'acting_tick_us': ctrl_rows.get('acting_tick_us'),
         'cycle_wall_ms': ctrl_rows.get('cycle_wall_ms')}
+  # The multi-process runtime (round 17): per-process fps + the weak-
+  # scaling fraction vs the single-process row — ROADMAP item 1's
+  # "recorded number instead of a hope", clip-safe.
+  mh = out.get('multihost')
+  if mh:
+    nprocs = mh.get('nprocs')
+    mh_row = mh.get(f'multihost_{nprocs}proc') or {}
+    head['multihost'] = {
+        'scaling_fraction': mh.get('scaling_fraction'),
+        'fps': mh_row.get('env_frames_per_sec'),
+        'fps_per_process': mh_row.get('per_process'),
+        'single_fps': (mh.get('single_1proc') or {}).get(
+            'env_frames_per_sec')}
   return head
 
 

@@ -396,6 +396,17 @@ flags.DEFINE_bool('sdc_check', _DEFAULTS.sdc_check,
                   '(pure-DP meshes with >= 2 data replicas): replica '
                   'disagreement escalates through the health ladder '
                   '(docs/ROBUSTNESS.md, docs/RUNBOOK.md §9).')
+flags.DEFINE_bool('sdc_allgather', _DEFAULTS.sdc_allgather,
+                  'All-gather the per-replica SDC fingerprints '
+                  'in-graph so the sentinel runs on multi-process '
+                  'meshes too (round 17); false restores the '
+                  'single-controller gate.')
+flags.DEFINE_string('tp_compute', _DEFAULTS.tp_compute,
+                    'How TP matmuls execute: auto (sharded on '
+                    'TPU/GPU, the gathered workaround on CPU — this '
+                    'jaxlib mis-computes differentiated programs '
+                    'over model-sharded leaves), sharded, or '
+                    'gathered (docs/PARALLELISM.md).')
 flags.DEFINE_bool('replay_crc', _DEFAULTS.replay_crc,
                   'Verify replay-tier entries against their '
                   'insert-time CRC at every serve; rot evicts '
@@ -520,11 +531,15 @@ flags.DEFINE_integer('profile_start_step', _DEFAULTS.profile_start_step,
                      'Learner step at which the trace starts.')
 flags.DEFINE_integer('profile_num_steps', _DEFAULTS.profile_num_steps,
                      'Learner steps the trace covers.')
-flags.DEFINE_string('coordinator_address', '',
+flags.DEFINE_string('coordinator_address', _DEFAULTS.coordinator_address,
                     'jax.distributed coordinator (host:port); empty '
                     'for single-host.')
-flags.DEFINE_integer('num_processes', 1,
+flags.DEFINE_integer('num_processes', _DEFAULTS.num_processes,
                      'Total process count for jax.distributed.')
+flags.DEFINE_integer('process_id', _DEFAULTS.process_id,
+                     "This process's index in [0, num_processes); -1 "
+                     'defers to max(--task, 0) (the reference\'s '
+                     '--task spelling).')
 
 FLAGS = flags.FLAGS
 
@@ -574,12 +589,27 @@ def main(argv):
     raise KeyboardInterrupt(f'signal {signum}')
 
   signal.signal(signal.SIGTERM, _terminate)
-  if FLAGS.coordinator_address:
-    from scalable_agent_tpu.parallel import distributed
-    distributed.initialize(FLAGS.coordinator_address,
-                           num_processes=FLAGS.num_processes,
-                           process_id=max(FLAGS.task, 0))
+  # Multi-process spin-up (round 17): driver.train/evaluate own the
+  # join (distributed.maybe_initialize from the config's coordinator
+  # fields — idempotent, enables CPU gloo collectives before the
+  # backend exists). Actor hosts deliberately DON'T join: they feed
+  # the learner over TCP ingest, and joining would put their devices
+  # into the training mesh.
   cfg = config_from_flags()
+  if cfg.coordinator_address and cfg.job_name == 'actor':
+    raise app.UsageError(
+        '--job_name=actor does not join jax.distributed (actor hosts '
+        'feed over --learner_address TCP ingest); drop '
+        '--coordinator_address on actor hosts')
+  if cfg.coordinator_address and cfg.mode == 'anakin':
+    # The legacy research loop never calls driver.train, so the
+    # coordinator flags would be silently ignored and every host
+    # would train an independent replica — the process_count guard
+    # below can't catch it because nothing ever joins.
+    raise app.UsageError(
+        '--mode=anakin is the single-host legacy loop and does not '
+        'join jax.distributed; drop the coordinator flags (multi-host '
+        'runs use --mode=train)')
   if cfg.job_name == 'actor':
     # Actor-only host: no TPU, no learner — stream unrolls to the
     # learner's ingest server (reference ≈L625 actor loop).

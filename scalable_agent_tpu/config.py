@@ -22,6 +22,17 @@ class Config:
   task: int = -1
   job_name: str = 'learner'
   num_actors: int = 4
+  # Multi-process spin-up (round 17): driver.train joins the
+  # jax.distributed runtime itself when a coordinator is named —
+  # 'host:port' of process 0 (the reference's learner-address role,
+  # minus the parameter server). Empty = single-host, or the caller
+  # already initialized (the launcher / test harness path); both are
+  # no-ops here. num_processes is the total host-process count;
+  # process_id is this process's index (-1 = defer to max(task, 0),
+  # the reference's --task spelling).
+  coordinator_address: str = ''
+  num_processes: int = 1
+  process_id: int = -1
 
   # Training.
   total_environment_frames: int = int(1e9)
@@ -65,6 +76,14 @@ class Config:
   use_py_process: bool = True             # host each env in its own process
   publish_params_every: int = 1           # actor weight-snapshot cadence
   model_parallelism: int = 1              # TP width of the mesh
+  # How TP matmuls execute (round 17): 'auto' = true sharded compute
+  # on TPU/GPU, the 'gathered' workaround on CPU (this jaxlib's
+  # partitioner mis-computes DIFFERENTIATED programs over model-
+  # sharded leaves — params stay TP-sharded at rest, each step runs
+  # gather -> replicated compute -> scatter; parity-gated in
+  # tests/test_parallel.py and the tp4 multihost child).
+  # 'sharded' | 'gathered' force either path.
+  tp_compute: str = 'auto'
   torso: str = 'deep'                     # deep | deep_fast | shallow
   scan_unroll: int = 10                   # LSTM time-scan unroll factor
                                           # (v5e sweep at T=100, B=32:
@@ -372,6 +391,14 @@ class Config:
   # non-finite skips). Pure-DP meshes with >= 2 data replicas only;
   # a no-op elsewhere.
   sdc_check: bool = True
+  # Multi-host SDC (round 17): all-gather the per-replica fingerprints
+  # IN-GRAPH so the host readback touches only a fully-replicated
+  # [replicas] array — the device_get of a P('data')-sharded array
+  # across processes is illegal (non-addressable shards), which is
+  # why the PR 9 gate kept the sentinel single-controller. False
+  # restores the old gate (the sentinel silently stays off on
+  # multi-process meshes — validate_distributed warns).
+  sdc_allgather: bool = True
   # Replay-tier entries keep their insert-time content CRC and are
   # re-verified at every serve (reuse must not multiply host-memory
   # rot into K batches); mismatches evict (replay_evictions_crc).
@@ -943,6 +970,103 @@ def validate_runtime(config: Config) -> List[str]:
         'the engine off nothing watches env_plane_utilization — the '
         'dead-env-plane signal the filler could otherwise mask '
         '(docs/OBSERVABILITY.md)')
+  return warnings
+
+
+def resolve_process_id(config: Config) -> int:
+  """The ONE resolution of this process's declared index:
+  config.process_id when set, else the reference's --task spelling
+  (floored at 0). Shared by validate_distributed and
+  distributed.maybe_initialize so the id the validator checks is the
+  id the join actually uses."""
+  return (config.process_id if config.process_id >= 0
+          else max(config.task, 0))
+
+
+def validate_distributed(config: Config,
+                         live_process_count: int = 1) -> List[str]:
+  """Validate the multi-process knob group (round 17); raises
+  ValueError on hard errors, returns warnings (same contract as the
+  other validate_* groups — driver.train calls it before spin-up,
+  AFTER distributed.maybe_initialize, passing the live
+  jax.process_count() so topologies initialized by a launcher rather
+  than these fields are cross-linked too).
+
+  Pure-config checks use the DECLARED topology (num_processes /
+  coordinator_address) so they are unit-testable without spawning
+  processes; the cross-links below use
+  max(declared, live_process_count)."""
+  warnings = []
+  if config.num_processes < 1:
+    raise ValueError(f'num_processes must be >= 1, got '
+                     f'{config.num_processes}')
+  if config.tp_compute not in ('auto', 'sharded', 'gathered'):
+    raise ValueError(f'tp_compute must be auto|sharded|gathered, got '
+                     f'{config.tp_compute!r}')
+  if config.coordinator_address:
+    host, sep, port = config.coordinator_address.rpartition(':')
+    if not sep or not host or not port.isdigit():
+      raise ValueError(
+          f'coordinator_address must be host:port, got '
+          f'{config.coordinator_address!r}')
+    if config.num_processes == 1:
+      warnings.append(
+          'coordinator_address=%r with num_processes=1: a one-process '
+          'jax.distributed runtime works but coordinates nothing — '
+          'drop the flag or raise the count'
+          % config.coordinator_address)
+    resolved_id = resolve_process_id(config)
+    if resolved_id >= config.num_processes:
+      raise ValueError(
+          f'process_id {resolved_id} out of range for num_processes='
+          f'{config.num_processes}')
+  elif config.num_processes > 1:
+    raise ValueError(
+        f'num_processes={config.num_processes} needs '
+        'coordinator_address (host:port of process 0)')
+  elif config.process_id >= 0:
+    warnings.append(
+        'process_id=%d without coordinator_address: nothing will '
+        'join a distributed runtime' % config.process_id)
+  procs = max(config.num_processes, live_process_count)
+  if procs <= 1:
+    return warnings
+  # --- Multi-process cross-links. ---
+  if config.runtime == 'anakin':
+    # Hard error, same verdict train_anakin reaches later — but here,
+    # before any device/env spin-up: each process would train an
+    # unsynchronized replica (the fused loop has no cross-host batch
+    # transport).
+    raise ValueError(
+        'runtime=anakin is single-host; multi-process runs use the '
+        'fleet runtime (per-host ingest + gradient psum)')
+  if config.sdc_check and not config.sdc_allgather:
+    warnings.append(
+        'sdc_check=True with sdc_allgather=False on a multi-process '
+        'topology: the per-replica fingerprint readback needs the '
+        'in-graph all-gather (a cross-process P(\'data\') device_get '
+        'is illegal), so the SDC sentinel will be silently OFF — '
+        'enable sdc_allgather or drop sdc_check')
+  if config.model_parallelism > 1:
+    # TP across hosts flips the shard_batch_over_model predicate
+    # (parallel/mesh.py): the batch shards over BOTH axes, so
+    # batch_size must divide the FULL device count, actors run on a
+    # localized param copy (a collective allgather per publish), and
+    # unroll staging falls back to batch mode. Legal, but the
+    # operator should know the shape changed.
+    warnings.append(
+        'model_parallelism=%d on a multi-process topology: the model '
+        'axis crosses hosts, so the batch shards over BOTH mesh axes '
+        '(mesh.shard_batch_over_model) — batch_size must divide the '
+        'full device count, param publishes localize via a collective '
+        'allgather, and staging_mode=unroll falls back to batch'
+        % config.model_parallelism)
+  if config.anakin_filler:
+    warnings.append(
+        'anakin_filler=True on a multi-process topology: the filler '
+        'mutates params OUTSIDE the collective train step, so hosts '
+        'with different idle patterns would diverge — the driver '
+        'disables it (supports_filler) and parks idle slices instead')
   return warnings
 
 

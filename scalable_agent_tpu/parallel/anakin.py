@@ -601,6 +601,13 @@ def supports_filler(config: Config, mesh=None) -> Tuple[bool, str]:
   action-space width) is a CONFIG error and fails at spin-up instead:
   the driver only consults this gate, it never swallows construction
   errors."""
+  if jax.process_count() > 1:
+    # Fill decisions are per-host (each host's prefetcher idles on its
+    # own schedule) but a filler step over a multi-process mesh is a
+    # COLLECTIVE — unsynchronized invocation deadlocks, synchronized
+    # invocation would stall the busy hosts. Park instead.
+    return False, ('multi-process topology: filler steps are '
+                   'collectives but idle slices are per-host')
   if mesh is None:
     return True, ''
   from scalable_agent_tpu.parallel import mesh as mesh_lib

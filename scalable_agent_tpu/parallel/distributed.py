@@ -27,9 +27,50 @@ import jax
 log = logging.getLogger('scalable_agent_tpu')
 
 
+def is_initialized() -> bool:
+  """Whether this process already joined a jax.distributed runtime.
+
+  The fallback must be SIDE-EFFECT-FREE: probing jax.process_count()
+  here would instantiate the backend, and a backend created before
+  initialize() runs is built with collectives=none — the exact
+  failure this module exists to prevent. If jax moved the seam we
+  answer False; a double-join then fails loudly in
+  jax.distributed.initialize instead of silently losing collectives."""
+  try:
+    from jax._src.distributed import global_state
+    return global_state.coordinator_address is not None
+  except Exception:
+    return False
+
+
+def _enable_cpu_collectives() -> None:
+  """Arm cross-process collectives for the CPU backend (gloo).
+
+  The CPU client is built with collectives=none by default, and every
+  cross-process computation then fails with 'Multiprocess computations
+  aren't implemented on the CPU backend' — the error the multihost
+  tests were red with since seed. The flag is consumed at backend
+  CREATION, so this must run before the first device op; once a
+  backend exists we can only log. TPU/GPU backends ignore the flag
+  (their collectives ride ICI/NCCL regardless)."""
+  try:
+    if jax.config.read('jax_cpu_collectives_implementation') != 'none':
+      return  # operator already chose (gloo or mpi) — respect it.
+    jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+    log.info('CPU backend: gloo cross-process collectives enabled')
+  except Exception:
+    # Older jaxlib without the option: multi-host CPU will fail at the
+    # first collective with the backend's own error, which names the
+    # real problem.
+    log.warning('could not enable CPU gloo collectives (jax %s)',
+                jax.__version__, exc_info=True)
+
+
 def initialize(coordinator_address: str, num_processes: int,
                process_id: int,
-               local_device_ids: Optional[list] = None) -> None:
+               local_device_ids: Optional[list] = None,
+               heartbeat_interval_secs: Optional[int] = None,
+               max_missing_heartbeats: Optional[int] = None) -> None:
   """Join the multi-host runtime (call before any device op).
 
   Args:
@@ -38,15 +79,76 @@ def initialize(coordinator_address: str, num_processes: int,
     num_processes: total host process count.
     process_id: this process's index (the reference's --task).
     local_device_ids: optionally restrict this process's devices.
+    heartbeat_interval_secs / max_missing_heartbeats: coordination-
+      service failure-detection tuning (both client and service side).
+      None keeps jax's defaults (10 s x 10 = ~100 s to declare a host
+      dead — right for production pods riding out GC pauses; the test
+      harness passes seconds so a SIGKILL drill doesn't park the
+      survivors for minutes).
   """
-  jax.distributed.initialize(
-      coordinator_address=coordinator_address,
-      num_processes=num_processes,
-      process_id=process_id,
-      local_device_ids=local_device_ids)
+  _enable_cpu_collectives()
+  kwargs = {}
+  if heartbeat_interval_secs is not None:
+    kwargs.update(
+        service_heartbeat_interval_seconds=heartbeat_interval_secs,
+        client_heartbeat_interval_seconds=heartbeat_interval_secs)
+  if max_missing_heartbeats is not None:
+    kwargs.update(service_max_missing_heartbeats=max_missing_heartbeats,
+                  client_max_missing_heartbeats=max_missing_heartbeats)
+  if kwargs:
+    # The PUBLIC initialize() does not expose failure-detection tuning
+    # (jax 0.4.x) — it forwards to global_state.initialize, which
+    # does. Replicate its one guard and call through; fall back to the
+    # public surface (default ~100 s detection) if jax moved the seam.
+    try:
+      from jax._src import distributed as jdist
+      from jax._src import xla_bridge
+      if xla_bridge.backends_are_initialized():
+        raise RuntimeError(
+            'distributed.initialize() must be called before any JAX '
+            'computation (a backend already exists)')
+      jdist.global_state.initialize(
+          coordinator_address=coordinator_address,
+          num_processes=num_processes,
+          process_id=process_id,
+          local_device_ids=local_device_ids,
+          **kwargs)
+      kwargs = None  # joined; skip the public path below
+    except (ImportError, TypeError):
+      log.warning('jax private distributed seam moved: heartbeat '
+                  'tuning ignored, joining with default detection')
+      kwargs = {}
+  if kwargs is not None:
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
   log.info('jax.distributed: process %d/%d, %d local / %d global devices',
            process_id, num_processes, jax.local_device_count(),
            jax.device_count())
+
+
+def maybe_initialize(config) -> bool:
+  """driver.train's spin-up seam (round 17): join the runtime the
+  config names, exactly once.
+
+  Returns True when this call initialized. No-ops (False) when the
+  config names no coordinator, or when the process already joined —
+  the launcher/test-harness path, where jax.distributed was
+  initialized before driver.train was called."""
+  if not config.coordinator_address:
+    return False
+  if is_initialized():
+    log.info('jax.distributed already initialized '
+             '(%d processes) — coordinator flags are a no-op',
+             jax.process_count())
+    return False
+  from scalable_agent_tpu.config import resolve_process_id
+  initialize(config.coordinator_address,
+             num_processes=config.num_processes,
+             process_id=resolve_process_id(config))
+  return True
 
 
 def global_batch_from_local(mesh, spec, local_batch):

@@ -9,6 +9,8 @@ mesh axis" — jit's partitioner emits exactly that from these
 shardings).
 """
 
+import logging
+
 import numpy as np
 
 import jax
@@ -17,6 +19,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from scalable_agent_tpu import learner as learner_lib
 from scalable_agent_tpu.config import Config
 from scalable_agent_tpu.parallel import mesh as mesh_lib
+
+log = logging.getLogger('scalable_agent_tpu')
 
 
 def make_sharded_train_state(params, config: Config, mesh: Mesh,
@@ -49,6 +53,19 @@ def make_sharded_train_state(params, config: Config, mesh: Mesh,
   return jax.tree_util.tree_map(ensure_on_mesh, state)
 
 
+def resolve_tp_compute(config) -> str:
+  """'gathered' | 'sharded' — how TP matmuls actually execute.
+
+  'auto' resolves per backend: CPU takes the gathered workaround (this
+  jaxlib's partitioner mis-computes AD graphs over model-sharded
+  leaves — see make_sharded_train_step); TPU/GPU keep true sharded
+  compute. Explicit values win either way."""
+  mode = getattr(config, 'tp_compute', 'auto')
+  if mode == 'auto':
+    return 'gathered' if jax.default_backend() == 'cpu' else 'sharded'
+  return mode
+
+
 def make_sharded_train_step(agent, config: Config, mesh: Mesh,
                             example_batch, donate: bool = True):
   """Jit the learner step with explicit in/out shardings over the mesh.
@@ -68,18 +85,117 @@ def make_sharded_train_step(agent, config: Config, mesh: Mesh,
   no SPMD partitioning rule, so under this jit it runs shard_map'ped
   over the data axis — the fused kernel is no longer single-device
   only (vtrace.py / ops/vtrace_pallas.py).
+
+  TP compute mode (round 17): with model_parallelism > 1 this jaxlib's
+  CPU backend has a SECOND defect beyond donation aliasing — the
+  partitioned program computes WRONG numerics whenever any leaf is
+  model-axis-sharded (measured: annotating a single bias changes the
+  loss by ~0.5; GSPMD and the experimental shardy partitioner both
+  produce the identical wrong value, and sharding-constraining every
+  activation does not repair it — only the differentiated (AD) graph
+  is affected, a forward pass with an in-graph all-gather is exact).
+  `resolve_tp_compute(config)` therefore selects 'gathered' on CPU:
+  params stay TP-SHARDED AT REST (the memory story and the
+  cross-process collective placement are real), but each step runs as
+  gather → replicated-compute → scatter, three separate compiled
+  programs, so the partitioner never differentiates through a
+  model-sharded leaf. Parity-gated by the tp4 multihost child and
+  tests/test_parallel.py. TPU/GPU keep true sharded TP compute
+  ('sharded'); config.tp_compute overrides either way.
   """
   train_step = learner_lib.make_train_step_fn(agent, config, mesh=mesh)
   batch_shard = mesh_lib.batch_shardings(
       example_batch, mesh,
       shard_over_model=mesh_lib.shard_batch_over_model(config))
   replicated = NamedSharding(mesh, P())
+  # None = decide on the first call from the LIVE state: TP can arrive
+  # via config.model_parallelism or via a make_sharded_train_state
+  # caller passing enable_tp out-of-band (tests do) — any model-
+  # sharded leaf in the state means the defect applies.
+  gathered_tp = (True if (config.model_parallelism > 1 and
+                          resolve_tp_compute(config) == 'gathered')
+                 else None)
 
-  jitted = jax.jit(
-      train_step,
-      in_shardings=(None, batch_shard),  # state keeps its placement
-      out_shardings=(None, replicated),
-      donate_argnums=(0,) if donate else ())
+  def jit_step(donate_now):
+    return jax.jit(
+        train_step,
+        in_shardings=(None, batch_shard),  # state keeps its placement
+        out_shardings=(None, replicated),
+        donate_argnums=(0,) if donate_now else ())
+
+  # Donation self-heal (round 17, the ring_buffer._insert pattern):
+  # this jaxlib mis-pairs donation aliases of TP-sharded leaves
+  # ("Expected aliased input ... to have the same size" — the
+  # seed-listed defect, xfail'd in tests/test_parallel.py). The first
+  # step that trips it rebuilds the jit UN-donated and retries with
+  # the same arguments (the alias check fails before any buffer is
+  # consumed — proven by the arena insert's identical retry);
+  # correctness first, the in-place HBM update is an optimization.
+  # The engaged fallback is visible as `step.donation_fallback` —
+  # multi-process callers included, which is what turns the
+  # tp-across-process tests green on this jaxlib.
+  compiled = {'fn': jit_step(donate), 'donate': donate}
+
+  # The two reshard programs of the gathered path (pure layout moves
+  # as their OWN compiled programs — exact, verified leaf-identical
+  # round trip), built ONCE on the first step: jit caches on function
+  # identity, so a fresh jit(lambda ...) per call would retrace the
+  # whole state tree twice per step. The scatter captures the at-rest
+  # placements from the FIRST live state (a restored checkpoint's
+  # placements included) and re-establishes them every step.
+  _reshard_fns = {}
+
+  def run_step(state, batch):
+    nonlocal gathered_tp
+    if gathered_tp is None:
+      gathered_tp = (resolve_tp_compute(config) == 'gathered' and any(
+          mesh_lib.MODEL_AXIS in str(getattr(x.sharding, 'spec', ''))
+          for x in jax.tree_util.tree_leaves(state)
+          if isinstance(x, jax.Array)))
+      step.tp_gathered = gathered_tp
+      if gathered_tp:
+        _log_gathered()
+    if not gathered_tp:
+      return compiled['fn'](state, batch)
+    # gather → replicated compute → scatter.
+    if 'gather' not in _reshard_fns:
+      at_rest = jax.tree_util.tree_map(lambda x: x.sharding, state)
+      rep = jax.tree_util.tree_map(lambda _: replicated, state)
+      _reshard_fns['gather'] = jax.jit(lambda t: t, out_shardings=rep)
+      _reshard_fns['scatter'] = jax.jit(lambda t: t,
+                                        out_shardings=at_rest)
+    new_state, metrics = compiled['fn'](
+        _reshard_fns['gather'](state), batch)
+    return _reshard_fns['scatter'](new_state), metrics
+
+  def step(state, batch):
+    try:
+      return run_step(state, batch)
+    except Exception as e:  # jaxlib XlaRuntimeError (INTERNAL)
+      if not compiled['donate'] or 'alias' not in str(e):
+        raise
+      log.warning(
+          'sharded train step: donation aliasing defect on this '
+          'jaxlib (%s) — rebuilding un-donated and retrying; HBM '
+          'holds one extra state copy for the rest of the run', e)
+      compiled['fn'] = jit_step(False)
+      compiled['donate'] = False
+      step.donation_fallback = True
+      return run_step(state, batch)
+
+  step.donation_fallback = False
+  step.tp_gathered = bool(gathered_tp)
+
+  def _log_gathered():
+    log.info(
+        'TP compute mode: gathered (params stay model-sharded at '
+        'rest; each step gathers, computes replicated, re-scatters) — '
+        'the %s backend mis-computes differentiated programs over '
+        'model-sharded leaves on this jaxlib (docs/PARALLELISM.md)',
+        jax.default_backend())
+
+  if gathered_tp:
+    _log_gathered()
 
   def place_batch(host_batch):
     """Host numpy → globally-sharded device arrays. Each process passes
@@ -92,7 +208,7 @@ def make_sharded_train_step(agent, config: Config, mesh: Mesh,
             s, np.asarray(x)),
         host_batch, batch_shard)
 
-  return jitted, place_batch
+  return step, place_batch
 
 
 def supports_sdc_check(config, mesh) -> bool:
@@ -108,15 +224,17 @@ def supports_sdc_check(config, mesh) -> bool:
     return False
   if mesh_lib.shard_batch_over_model(config):
     return False
-  # Single-controller only (for now): the readback device_gets a
-  # P('data')-sharded array, which jax refuses when shards live on
-  # non-addressable devices — a multi-host SDC check needs an
-  # in-graph all-gather of the fingerprints before the host read
-  # (ROADMAP multi-host item). Gating here keeps the default-on knob
-  # from crashing the first multi-host pure-DP run.
+  # Multi-process meshes need the in-graph all-gather (round 17): a
+  # raw readback device_gets a P('data')-sharded array, which jax
+  # refuses when shards live on non-addressable devices. With
+  # sdc_allgather the fingerprint vector leaves the graph REPLICATED
+  # (every host reads its local copy), so the PR 9 single-controller
+  # gate lifts; without it the sentinel stays off here
+  # (validate_distributed warns).
   if any(d.process_index != jax.process_index()
          for d in mesh.devices.flat):
-    return False
+    if not getattr(config, 'sdc_allgather', True):
+      return False
   return mesh.shape[mesh_lib.DATA_AXIS] >= 2
 
 
@@ -142,10 +260,21 @@ def make_sdc_fingerprint_fn(mesh: Mesh):
   detector's per-replica view instead, driving the identical
   detection → incident → rollback path.
 
-  check_rep=False: params enter replicated but the output is
-  deliberately per-shard — the whole point is that 'replicated' is an
-  assumption the hardware can break, which is not a claim shard_map's
-  replication checker can express."""
+  check_rep=False: params enter replicated but the per-replica
+  fingerprints are deliberately per-shard — the whole point is that
+  'replicated' is an assumption the hardware can break, which is not
+  a claim shard_map's replication checker can express.
+
+  The [replicas] vector leaves the graph REPLICATED via an in-graph
+  all-gather over the data axis (round 17): each replica computes its
+  own fingerprint from local HBM, the all-gather exchanges the one
+  uint32 per replica (bytes on the wire — noise against the step's
+  gradient psum), and the host read then touches only addressable
+  shards — which is what lifts the PR 9 single-controller gate and
+  lets the sentinel run on multi-process meshes. The collective is
+  dispatched from the lockstep driver path (per health check, every
+  host), so it is barrier-safe by the same argument as the step
+  itself."""
   from jax.experimental.shard_map import shard_map
 
   num_replicas = int(mesh.shape[mesh_lib.DATA_AXIS])
@@ -153,12 +282,17 @@ def make_sdc_fingerprint_fn(mesh: Mesh):
 
   def per_replica(params, probe):
     fp = learner_lib.param_fingerprint(params)
-    return (fp + probe.reshape(())).reshape(1)
+    # [1] per replica → all-gathered [replicas] on EVERY device. A
+    # corrupted replica's entry differs identically in every copy of
+    # the gathered vector, so any host's local read sees it.
+    return jax.lax.all_gather(
+        (fp + probe.reshape(())).reshape(()), mesh_lib.DATA_AXIS,
+        tiled=False)
 
   sharded = jax.jit(shard_map(
       per_replica, mesh=mesh,
       in_specs=(P(), P(mesh_lib.DATA_AXIS)),
-      out_specs=P(mesh_lib.DATA_AXIS), check_rep=False))
+      out_specs=P(), check_rep=False))
 
   def fingerprint_fn(params, probe_host=None):
     if probe_host is None:
@@ -193,6 +327,25 @@ def supports_unroll_staging(config, mesh) -> bool:
   return bool(local) and local_batch % len(local) == 0
 
 
+def unroll_slot_owners(local_devices, local_batch: int):
+  """Slot → owning device for this PROCESS's slice of the global batch
+  (round 17 pulls the arithmetic out of make_unroll_assembly so the
+  placement is unit-testable without spawning processes).
+
+  Slot s of the local batch lives on local_devices[s // per_dev] — the
+  contiguous data-axis shard layout batch_shardings assigns, restricted
+  to THIS process's addressable devices: unroll staging is the
+  host-local half of the trajectory transport, so slot ownership must
+  never name another host's device."""
+  n_local = len(local_devices)
+  if n_local == 0 or local_batch % n_local != 0:
+    raise ValueError(
+        f'local batch {local_batch} does not divide over '
+        f'{n_local} local device(s)')
+  per_dev = local_batch // n_local
+  return [local_devices[s // per_dev] for s in range(local_batch)]
+
+
 def make_unroll_assembly(config, mesh, example_batch):
   """Slot placement + zero-copy global assembly for the per-unroll
   staging plane (runtime/ring_buffer.UnrollBatchStager) over a pure-DP
@@ -214,12 +367,9 @@ def make_unroll_assembly(config, mesh, example_batch):
                                          shard_over_model=False)
   local_devices = [d for d in mesh.devices.flat
                    if d.process_index == jax.process_index()]
-  n_local = len(local_devices)
   data_width = mesh.shape[mesh_lib.DATA_AXIS]
   local_batch = config.batch_size // jax.process_count()
-  per_dev = local_batch // n_local
-  slot_devices = [local_devices[s // per_dev]
-                  for s in range(local_batch)]
+  slot_devices = unroll_slot_owners(local_devices, local_batch)
 
   def assemble(sub_arenas):
     """Per-device arenas (device order) → the global sharded batch."""

@@ -275,6 +275,26 @@ ANAKIN_EOF
 XLA_FLAGS='--xla_force_host_platform_device_count=8' \
   BENCH_SMOKE=1 BENCH_ONLY=anakin python bench.py
 
+echo '== multihost lane (round 17: the real multi-process runtime —'
+echo '   2 OS processes join jax.distributed over gloo CPU collectives'
+echo '   and run the FULL driver over one mesh: per-host fleets'
+echo '   feeding process-local shards, the cross-process gradient'
+echo '   psum, broadcast-gated collective checkpoints + the SIGKILL'
+echo '   drill, the SDC all-gather rollback drill, cross-host trace'
+echo '   joins, and the BENCH_ONLY=multihost scaling row; the'
+echo '   validate_distributed/slot-placement unit half runs first —'
+echo '   <240 s CPU) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_multihost_unit.py -q \
+  -p no:cacheprovider
+# Children strip JAX_PLATFORMS/XLA_FLAGS themselves and force their
+# own per-process virtual-device topology.
+python -m pytest \
+  tests/test_multihost.py::test_two_process_training \
+  tests/test_multihost.py::test_kill_one_host_then_resume \
+  tests/test_multihost_extra.py \
+  -q -p no:cacheprovider
+BENCH_SMOKE=1 BENCH_ONLY=multihost python bench.py
+
 echo '== telemetry smoke (trace spans end to end: registry semantics,'
 echo '   tracer pipeline, v8 negotiation + remote stamping,'
 echo '   trace_report reconstruction; then the tiny tracing-on/off'

@@ -57,8 +57,18 @@ def main():
       f'--xla_force_host_platform_device_count={ndev}')
   import jax
   jax.config.update('jax_platforms', 'cpu')
-  jax.distributed.initialize(f'localhost:{port}', num_processes=nprocs,
-                             process_id=proc)
+  # The runtime's own spin-up seam (round 17): enables the CPU
+  # backend's cross-process collectives (gloo) BEFORE the backend is
+  # built — a raw jax.distributed.initialize leaves collectives=none
+  # and every cross-process computation then fails with 'Multiprocess
+  # computations aren't implemented on the CPU backend'.
+  from scalable_agent_tpu.parallel import distributed
+  # Tight failure detection (1 s x 8): the SIGKILL drill's survivors
+  # must abort in seconds, not jax's production default ~100 s.
+  distributed.initialize(f'localhost:{port}', num_processes=nprocs,
+                         process_id=proc,
+                         heartbeat_interval_secs=1,
+                         max_missing_heartbeats=8)
   assert jax.device_count() == nprocs * ndev
   assert jax.local_device_count() == ndev
 
@@ -210,6 +220,30 @@ def main():
     # the rest are this process's fleet envs.
     print(f'child {proc}: eval ok '
           f'played={",".join(sorted(set(played[1:])))}', flush=True)
+  elif mode == 'sdc':
+    # Round 17 satellite: the multi-process SDC sentinel end to end.
+    # Both processes install the SAME fault plan, so the
+    # replica_divergence probe perturbs one replica's fingerprint lane
+    # at the same health check on every host (lockstep); the in-graph
+    # all-gather returns the full [replicas] vector to each host, both
+    # reach the SDC verdict together, and the broadcast-coordinated
+    # rollback restores a pre-divergence checkpoint collectively.
+    import dataclasses
+    from scalable_agent_tpu.runtime import faults as faults_lib
+    cfg = dataclasses.replace(cfg, checkpoint_check_every_steps=1,
+                              health_rollback_after=1)
+    faults_lib.install(faults_lib.FaultPlan.storm(
+        seed=11, replica_divergence_at=3, replica_divergence_len=1))
+    try:
+      run = driver.train(cfg, max_steps=8, stall_timeout_secs=120)
+    finally:
+      faults_lib.clear()
+    hs = run.health.stats()
+    assert hs.get('sdc_mismatches', 0) >= 1, hs
+    assert hs.get('rollbacks', 0) >= 1, hs
+    assert int(run.state.update_steps) == 8, run.state.update_steps
+    print(f'child {proc}: sdc ok mismatches={hs["sdc_mismatches"]} '
+          f'rollbacks={hs["rollbacks"]}', flush=True)
   elif mode == 'drill':
     # Frequent collective checkpoints; runs until the parent kills this
     # process or the runtime aborts us because the peer died.

@@ -1,0 +1,120 @@
+"""Round-17 multi-process drills beyond the seed suite: the two
+deferred debts the ROADMAP parked on "real multi-host" land here —
+
+- the SDC sentinel across processes (PR 9 gated it single-controller
+  pending an in-graph all-gather of the per-replica fingerprints;
+  train_parallel.make_sdc_fingerprint_fn now all-gathers, so a
+  perturbed replica must still drive detection → incident → collective
+  rollback when the mesh spans processes), and
+- cross-host trace spans (PR 10 wall-clock-stamped spans exactly so
+  hops could land on different hosts; a real 2-process run with a
+  remote actor host must yield trace_report joins across the wire,
+  skew-tolerant — a skewed hop renders None, never a fake latency).
+
+Same harness discipline as tests/test_multihost.py: children are real
+OS processes joining jax.distributed over gloo; every assert here
+reads child stdout or on-disk artifacts.
+"""
+
+import json
+import os
+import sys
+
+import test_multihost as mh
+import _multihost_child
+import _remote_actor_child
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), 'scripts'))
+import trace_report  # noqa: E402
+
+
+def test_sdc_mismatch_rolls_back_across_processes(tmp_path):
+  """2 processes x 2 devices, pure-DP 4-way mesh: one replica's
+  fingerprint lane is perturbed mid-run (the replica_divergence drill,
+  installed identically in both children so the probe is lockstep).
+  Both processes must see the mismatch through the all-gathered
+  fingerprint vector, count it as an SDC incident, and complete the
+  broadcast-coordinated rollback — then train on to the step budget."""
+  logdir = str(tmp_path)
+  procs = mh._spawn_children(logdir, mh._free_port(),
+                             extra_args=('sdc',))
+  outs = []
+  try:
+    for p in procs:
+      out, _ = p.communicate(timeout=280)
+      outs.append(out)
+  finally:
+    for p in procs:
+      if p.poll() is None:
+        p.kill()
+        p.communicate()
+  for i, (p, out) in enumerate(zip(procs, outs)):
+    assert p.returncode == 0, f'child {i} failed:\n{out[-3000:]}'
+    assert f'child {i}: sdc ok' in out, out[-2000:]
+
+  # The incident is on disk on BOTH processes' streams: the mismatch
+  # names the per-replica fingerprint vector, the rollback names the
+  # restored step. (Detection is global — every host read the same
+  # all-gathered vector.)
+  for fname in ('incidents.jsonl', 'incidents_p1.jsonl'):
+    with open(os.path.join(logdir, fname)) as f:
+      kinds = [json.loads(line)['kind'] for line in f]
+    assert 'sdc_replica_mismatch' in kinds, (fname, kinds)
+    assert 'rollback' in kinds, (fname, kinds)
+
+
+def test_cross_host_trace_spans_join(tmp_path):
+  """The mixed topology (remote actor host over TCP into process 0,
+  local fleet on process 1) under default-ON tracing: spans whose hops
+  were stamped on DIFFERENT hosts must reconstruct through
+  trace_report.span_hop_deltas — wire hops present with real
+  latencies, and any clock-skewed hop rendered None rather than a
+  negative/zero fake (the PR 10 wall-clock design, verified on a real
+  jax.distributed run)."""
+  logdir = str(tmp_path)
+  coord_port, ingest_port = mh._free_ports(2)
+  procs = mh._spawn_children(logdir, coord_port,
+                             extra_args=('mixed', str(ingest_port)))
+  actor = _remote_actor_child.spawn(
+      f'127.0.0.1:{ingest_port}', _multihost_child.CHILD_CONFIG)
+  outs = []
+  try:
+    for p in procs:
+      out, _ = p.communicate(timeout=280)
+      outs.append(out)
+    actor_out, _ = actor.communicate(timeout=120)
+  finally:
+    for p in procs + [actor]:
+      if p.poll() is None:
+        p.kill()
+        p.communicate()
+  for i, (p, out) in enumerate(zip(procs, outs)):
+    assert p.returncode == 0, f'child {i} failed:\n{out[-3000:]}'
+  assert actor.returncode == 0, actor_out[-2000:]
+
+  # Process 0 trained on remote unrolls only: its trace stream carries
+  # spans stamped on the actor host (send) AND on the learner host
+  # (wire/commit/staged/serve/step) — the cross-host join.
+  with open(os.path.join(logdir, 'traces.jsonl')) as f:
+    batches = [json.loads(line) for line in f]
+  assert batches, 'process 0 emitted no trace records'
+  cross_host_spans = 0
+  hop_pairs = set()
+  for batch in batches:
+    for span in batch.get('spans', []):
+      deltas, e2e = trace_report.span_hop_deltas(span)
+      names = {n for (pair, _) in deltas for n in pair}
+      if 'send' in names and ('wire' in names or 'commit' in names):
+        cross_host_spans += 1
+        for pair, ms in deltas:
+          hop_pairs.add(pair)
+          # Skew tolerance: every delta is either a real non-negative
+          # latency or None — span_hop_deltas must never emit a
+          # negative number for consumers to launder into a
+          # percentile.
+          assert ms is None or ms >= 0, (pair, ms)
+      if e2e is not None:
+        assert e2e >= 0
+  assert cross_host_spans > 0, 'no span crossed the wire'
+  assert ('send', 'wire') in hop_pairs, hop_pairs
