@@ -488,6 +488,15 @@ flags.DEFINE_float('fleet_probation_secs',
                    'Quarantine probation cool-down before a '
                    'rehabilitation attempt (fleet slots and the '
                    "remote client's CRC self-quarantine).")
+flags.DEFINE_bool('lock_order_check', _DEFAULTS.lock_order_check,
+                  'Arm runtime lock-order detection for this run: '
+                  'the threaded modules\' locks record the '
+                  'process-wide acquisition graph and a cycle (a '
+                  'latent ABBA deadlock) lands as a durable '
+                  'lock_order_inversion incident + the '
+                  'analysis/lock_cycles counter. Default off in '
+                  'production; tests/chaos run armed '
+                  '(docs/STATIC_ANALYSIS.md).')
 flags.DEFINE_bool('health_watchdog', _DEFAULTS.health_watchdog,
                   'Learner failure domain (health.py): skip '
                   'non-finite updates on device, roll back to the '

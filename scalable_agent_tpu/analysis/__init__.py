@@ -1,0 +1,170 @@
+"""Invariant analyzer (round 18): a pluggable, stdlib-`ast`-only
+contract-lint framework for the literal-string contracts and the
+threaded control plane PRs 6-17 grew.
+
+Seven modules spin threads and hold ~40 locks, coordinated by
+literal-string contracts: metric names <-> the docs/OBSERVABILITY.md
+inventory, SLO objectives <-> controller rules, config fields <->
+experiment.py flags, incident kinds <-> durable-fsync markers,
+protocol versions <-> docs/TRANSPORT.md. Until this round the only
+guard was an inline regex heredoc in scripts/ci.sh plus hand-written
+torn-read tests. This package makes those contracts (and the lock
+discipline itself) machine-checked:
+
+- `analysis.contracts` — the contract checkers (ported from the ci.sh
+  heredoc, then extended to the contracts nothing verified).
+- `analysis.concurrency` — the `guarded_by` AST pass: reads/writes of
+  annotated attributes outside a `with self.<lock>` block.
+- `analysis.runtime` — the runtime half: `OrderedLock` lock-order
+  detection and the `guarded_by` annotation helper itself.
+- `scripts/lint.py` — the CLI (`--check/--json/--fix-docs/--list`,
+  nonzero exit on findings).
+
+The framework is import-light by design: no jax, no numpy — the
+build host is air-gapped and CI runs the full suite in seconds.
+
+Extending: write `def check_<x>(ctx) -> List[Finding]`, register it
+with `@checker('name', 'description')`, add a row to
+docs/STATIC_ANALYSIS.md's inventory table (the `checker-inventory`
+check enforces that the docs and `scripts/lint.py --list` cannot
+drift), and seed one violation in tests/test_analysis.py proving the
+checker can fire. Suppressions go in `ALLOWLISTS` (contracts.py) with
+a reason — stale entries are themselves findings.
+"""
+
+import ast
+import dataclasses
+import pathlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    'Finding', 'CheckContext', 'checker', 'all_checkers',
+    'run_checks',
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+  """One violation: where, what, and the symbol an allowlist entry
+  would name to suppress it."""
+  check: str
+  path: str
+  line: int
+  symbol: str
+  message: str
+
+  def render(self) -> str:
+    return f'{self.path}:{self.line}: [{self.check}] {self.message}'
+
+
+class CheckContext:
+  """Repo handle shared by every checker: rooted paths, a parsed-AST
+  cache (each source file is parsed once per run), and text access."""
+
+  def __init__(self, root):
+    self.root = pathlib.Path(root)
+    self._trees: Dict[pathlib.Path, ast.AST] = {}
+    self._texts: Dict[pathlib.Path, str] = {}
+
+  def text(self, rel: str) -> str:
+    path = self.root / rel
+    if path not in self._texts:
+      self._texts[path] = path.read_text()
+    return self._texts[path]
+
+  def tree(self, rel: str) -> ast.AST:
+    path = self.root / rel
+    if path not in self._trees:
+      self._trees[path] = ast.parse(self.text(rel), filename=str(path))
+    return self._trees[path]
+
+  def package_sources(self, subdir: str = 'scalable_agent_tpu'
+                      ) -> List[str]:
+    """Repo-relative paths of every .py under `subdir`, sorted."""
+    base = self.root / subdir
+    return sorted(
+        str(p.relative_to(self.root))
+        for p in base.rglob('*.py'))
+
+
+# --- checker registry -------------------------------------------------
+
+_REGISTRY: List[Tuple[str, str, Callable]] = []
+
+
+def checker(name: str, description: str):
+  """Register a checker. The function takes a CheckContext and
+  returns a list of Findings."""
+  def wrap(fn):
+    _REGISTRY.append((name, description, fn))
+    return fn
+  return wrap
+
+
+def all_checkers() -> List[Tuple[str, str, Callable]]:
+  """(name, description, fn) in registration order — the inventory
+  `scripts/lint.py --list` prints and docs/STATIC_ANALYSIS.md must
+  mirror."""
+  _load()
+  return list(_REGISTRY)
+
+
+_loaded = False
+
+
+def _load():
+  """Import the checker modules exactly once (registration is an
+  import side effect, kept out of package import so `analysis.runtime`
+  users never pay for it)."""
+  global _loaded
+  if not _loaded:
+    from scalable_agent_tpu.analysis import concurrency  # noqa: F401
+    from scalable_agent_tpu.analysis import contracts  # noqa: F401
+    _loaded = True
+
+
+def run_checks(root, only: Optional[List[str]] = None
+               ) -> List[Finding]:
+  """Run the (selected) checker suite over the repo at `root`.
+
+  Allowlist semantics: a finding whose (check, symbol) appears in
+  `contracts.ALLOWLISTS` is suppressed; an allowlist entry that
+  suppressed NOTHING is stale and becomes a finding itself (check
+  `allowlist`) — suppressions must die with the violations they
+  covered.
+  """
+  _load()
+  from scalable_agent_tpu.analysis import contracts
+  ctx = CheckContext(root)
+  names = {n for n, _, _ in _REGISTRY}
+  if only:
+    unknown = sorted(set(only) - names)
+    if unknown:
+      raise ValueError(
+          f'unknown checker(s) {unknown}; known: {sorted(names)}')
+  findings: List[Finding] = []
+  used: Dict[Tuple[str, str], bool] = {
+      (check, sym): False
+      for check, entries in contracts.ALLOWLISTS.items()
+      for sym in entries}
+  selected = [e for e in _REGISTRY if not only or e[0] in only]
+  for name, _, fn in selected:
+    allow = contracts.ALLOWLISTS.get(name, {})
+    for f in fn(ctx):
+      if f.symbol in allow:
+        used[(name, f.symbol)] = True
+        continue
+      findings.append(f)
+  # Stale allowlist entries — only judged when the owning checker ran
+  # (a --check run must not misread "didn't look" as "nothing found").
+  ran = {e[0] for e in selected}
+  for (check, sym), hit in sorted(used.items()):
+    if check in ran and not hit:
+      findings.append(Finding(
+          check='allowlist', path='scalable_agent_tpu/analysis/contracts.py',
+          line=1, symbol=f'{check}:{sym}',
+          message=f'stale allowlist entry {sym!r} for check '
+                  f'{check!r}: it no longer suppresses any finding — '
+                  'remove it (allowlist etiquette: suppressions die '
+                  'with the violations they covered)'))
+  return findings

@@ -546,6 +546,18 @@ class Config:
   health_max_rollbacks: int = 3           # then halt
   health_loss_explosion_factor: float = 100.0
   health_sigma_divergence_factor: float = 10.0
+  # --- Invariant analyzer (round 18; analysis/, docs/STATIC_ANALYSIS
+  # .md). Runtime lock-order detection: the threaded modules build
+  # their locks through analysis.runtime.make_lock, which returns a
+  # plain threading.Lock unless detection is armed — True arms it for
+  # this run (driver.train arms BEFORE constructing components and
+  # wires detections into incidents.jsonl as durable
+  # lock_order_inversion events). Default OFF in production (the
+  # graph bookkeeping is cheap but not free); tests and chaos storms
+  # run armed (conftest.py sets LOCK_ORDER_CHECK=1; the fault storm
+  # passes this flag and asserts zero cycles), so every storm doubles
+  # as a race hunt. ---
+  lock_order_check: bool = False
 
   @property
   def frames_per_step(self):
@@ -896,6 +908,17 @@ def validate_controller(config: Config) -> List[str]:
         '--surrogate=impact, or cap --controller_replay_k_max=1'
         % config.controller_replay_k_max)
   return warnings
+
+
+# Fields deliberately NOT exposed as experiment.py flags — the
+# explicit allowlist the `config-flags` contract lint
+# (scripts/lint.py, round 18) checks: every Config field must either
+# have a flag of the same name or be named here with the reason a
+# flag would be wrong. Empty today — every field is operator-facing.
+# Allowlist etiquette (docs/STATIC_ANALYSIS.md): entries carry a
+# trailing comment saying WHY, and a stale entry (field gone, or flag
+# added) is itself a lint finding.
+INTERNAL_FIELDS = ()
 
 
 # Env backends whose dynamics exist as jittable device cores

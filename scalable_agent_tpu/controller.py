@@ -76,6 +76,7 @@ from typing import Callable, Dict, List, Optional
 
 from scalable_agent_tpu import slo as slo_lib
 from scalable_agent_tpu import telemetry
+from scalable_agent_tpu.analysis.runtime import guarded_by, make_lock
 
 log = logging.getLogger('scalable_agent_tpu')
 
@@ -305,6 +306,15 @@ class Controller:
     log_name: the action-log filename (multi-host runs suffix it).
   """
 
+  # Lock discipline (round 18, guarded-by lint): the action log, the
+  # per-actuator ownership table, and the drop counter mutate only
+  # under _lock (tick/finalize hold it; the *_locked helpers run
+  # inside). `_applied`/`_apply_errors` stay unannotated: counts()
+  # documents its deliberate lock-free GIL-atomic reads.
+  _actions: guarded_by('_lock')
+  _owner: guarded_by('_lock')
+  _dropped_actions: guarded_by('_lock')
+
   def __init__(self, engine, rules: List[Rule],
                actuators: List[Actuator], logdir: str,
                mode: str = 'observe', interval_secs: float = 5.0,
@@ -361,7 +371,7 @@ class Controller:
     # the same actuator (the shipped grow/shrink fleet_size pair)
     # must not see-saw it, each revert undoing the other's move.
     self._owner: Dict[str, _RuleState] = {}
-    self._lock = threading.Lock()
+    self._lock = make_lock('controller._lock')
     self._stop = threading.Event()
     self._thread: Optional[threading.Thread] = None
     self._actions: List[Dict] = []
@@ -478,7 +488,7 @@ class Controller:
             rs.baseline = cur
             self._owner[rule.actuator] = rs
           rs.escalations += 1
-          taken.append(self._do_action(now, 'escalate', rule, rs,
+          taken.append(self._do_action_locked(now, 'escalate', rule, rs,
                                        act, cur, desired, entry))
         elif rs.engaged:
           clear = (state == slo_lib.OK
@@ -493,21 +503,21 @@ class Controller:
             continue
           desired, done = self._reverted(rule, act, cur, rs.baseline)
           if desired is None:
-            self._disengage(rule, rs)
+            self._disengage_locked(rule, rs)
             continue
           rs.reverts += 1
           if done:
-            self._disengage(rule, rs)
-          taken.append(self._do_action(now, 'revert', rule, rs, act,
+            self._disengage_locked(rule, rs)
+          taken.append(self._do_action_locked(now, 'revert', rule, rs, act,
                                        cur, desired, entry))
     return taken
 
-  def _disengage(self, rule: Rule, rs: _RuleState):
+  def _disengage_locked(self, rule: Rule, rs: _RuleState):
     rs.engaged = False
     if self._owner.get(rule.actuator) is rs:
       del self._owner[rule.actuator]
 
-  def _do_action(self, now, kind, rule: Rule, rs: _RuleState,
+  def _do_action_locked(self, now, kind, rule: Rule, rs: _RuleState,
                  act: Actuator, cur, desired, entry) -> Dict:
     """Apply (act mode) + record one move. Called with the lock held;
     the actuator set and the emissions are exception-guarded — a
@@ -564,14 +574,14 @@ class Controller:
         # The external-incident ledger: controller moves ride drain
         # manifests and halt bundles exactly like slo_<name> burns.
         self._health.note_external(f'controller_{act.name}')
-      self._write_log()
+      self._write_log_locked()
     except Exception:
       log.exception('controller action emission failed')
     return action
 
   # --- the log + counters surface ---
 
-  def _write_log(self):
+  def _write_log_locked(self):
     """Atomic CONTROLLER_LOG.json rewrite (tmp + rename, the verdict
     pattern): the log is either complete or the previous complete
     version — a postmortem never reads a half-written row."""
@@ -616,7 +626,7 @@ class Controller:
     verdict)."""
     with self._lock:
       try:
-        self._write_log()
+        self._write_log_locked()
       except Exception:
         log.exception('controller log finalize failed')
       return self.counts()

@@ -46,6 +46,7 @@ from scalable_agent_tpu import learner as learner_lib
 from scalable_agent_tpu import observability
 from scalable_agent_tpu import slo as slo_lib
 from scalable_agent_tpu import telemetry
+from scalable_agent_tpu.analysis import runtime as lock_check
 from scalable_agent_tpu.config import (Config, validate_controller,
                                        validate_distributed,
                                        validate_integrity,
@@ -327,6 +328,15 @@ def train(config: Config, max_steps: Optional[int] = None,
         config, live_process_count=live_processes)
   for warning in dist_warnings:
     log.warning('%s', warning)
+  # Lock-order detection (round 18, analysis/runtime.py): arm BEFORE
+  # any component constructs its locks — make_lock reads the armed
+  # state at construction (this covers both runtimes; the anakin
+  # dispatch below constructs its own checkpoint/SLO planes).
+  # Arm-only (never disarm): tests/chaos arm via the LOCK_ORDER_CHECK
+  # env var, and a False flag here must not silently strip their
+  # instrumentation.
+  if config.lock_order_check:
+    lock_check.arm()
   if config.runtime == 'anakin':
     if fleet_factory is not None:
       raise ValueError('fleet_factory is a fleet-runtime seam; '
@@ -748,6 +758,12 @@ def train(config: Config, max_steps: Optional[int] = None,
         config.logdir,
         filename=('incidents.jsonl' if process_index == 0
                   else f'incidents_p{process_index}.jsonl'))
+    # Lock-order detections land as DURABLE lock_order_inversion
+    # incidents (round 18): a latent ABBA deadlock found by a storm
+    # must survive whatever crash follows it. Armed or not, wiring
+    # the sink is free; the finally clears it (the bound method keeps
+    # this run's incident stream referenced).
+    lock_check.set_incident_sink(incidents.event)
     # Telemetry plane (round 13, telemetry.py): the pipeline tracer
     # completes per-unroll trace spans (actor → wire → ingest →
     # staging → serve → step) into traces.jsonl and keeps the flight
@@ -934,6 +950,7 @@ def train(config: Config, max_steps: Optional[int] = None,
     if writer is not None:
       _try(writer.close)
     if incidents is not None:
+      _try(lambda: lock_check.set_incident_sink(None))
       _try(incidents.close)
     if tracer is not None:
       _try(lambda: telemetry.set_tracer(None))
@@ -2043,6 +2060,11 @@ def train(config: Config, max_steps: Optional[int] = None,
     finally:
       checkpointer.close()
       writer.close()
+      # The lock-order sink closes over THIS run's incident stream —
+      # clear it before the stream closes (a later detection in a
+      # leaked daemon thread becomes a counted log line, not a write
+      # into a closed file).
+      lock_check.set_incident_sink(None)
       incidents.close()
       for gauge in _loop_gauges:
         telemetry.registry().unregister(gauge.name, gauge)
@@ -2146,6 +2168,11 @@ def train_anakin(config: Config, max_steps: Optional[int] = None,
   try:
     writer = observability.SummaryWriter(config.logdir)
     incidents = observability.EventLog(config.logdir)
+    # Same contract as the fleet loop (round 18): a lock-order
+    # detection among the anakin checkpoint/SLO/health locks must
+    # land as a DURABLE lock_order_inversion incident, not just a
+    # counted log line. Cleared in both teardown paths.
+    lock_check.set_incident_sink(incidents.event)
     with open(os.path.join(config.logdir, 'config.json'), 'w') as f:
       json.dump(dataclasses.asdict(config), f, indent=2,
                 sort_keys=True)
@@ -2171,6 +2198,7 @@ def train_anakin(config: Config, max_steps: Optional[int] = None,
     if writer is not None:
       writer.close()
     if incidents is not None:
+      lock_check.set_incident_sink(None)
       incidents.close()
     if slo_engine is not None:
       slo_engine.stop()
@@ -2390,6 +2418,7 @@ def train_anakin(config: Config, max_steps: Optional[int] = None,
     finally:
       checkpointer.close()
       writer.close()
+      lock_check.set_incident_sink(None)
       incidents.close()
       for gauge in _loop_gauges:
         telemetry.registry().unregister(gauge.name, gauge)
@@ -2431,6 +2460,19 @@ def evaluate(config: Config,
   # the join (crisp ValueError, not a hung initialization window).
   for warning in validate_distributed(config):
     log.warning('%s', warning)
+  # Every validate_* knob group runs on the eval path too (round 18,
+  # the validate-coverage lint): a hard range/enum error must fail an
+  # eval exactly like a train — before this, a bad replay/transport/
+  # SLO knob passed eval spin-up silently and only exploded (or was
+  # silently ignored) once the same config reached train.
+  for group_warnings in (validate_replay(config),
+                         validate_transport(config),
+                         validate_integrity(config),
+                         validate_slo(config),
+                         validate_controller(config),
+                         validate_runtime(config)):
+    for warning in group_warnings:
+      log.warning('%s', warning)
   distributed.maybe_initialize(config)
   train_levels = factory.level_names(config)
   test_levels = factory.test_level_names(config)

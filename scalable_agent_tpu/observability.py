@@ -93,8 +93,14 @@ class EventLog(_JsonlAppender):
   # 'controller' (round 15): a controller_action record is the
   # self-healing audit trail — a knob the run moved on its own must
   # survive whatever crash follows it.
+  # 'lock_order' (round 18): a lock_order_inversion detection IS the
+  # latent-deadlock postmortem — it must survive the deadlock/crash
+  # it predicts. The canonical marker list is contract-linted
+  # (scripts/lint.py durable-markers) against the docs/OBSERVABILITY
+  # .md "Durable incident markers" section AND against the kinds the
+  # modules actually emit, both directions.
   _DURABLE_MARKERS = ('halt', 'rollback', 'sdc', 'quarantin', 'slo',
-                      'controller')
+                      'controller', 'lock_order')
 
   def __init__(self, logdir: str, filename: str = 'incidents.jsonl'):
     super().__init__(logdir, filename)

@@ -24,6 +24,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from scalable_agent_tpu.analysis.runtime import guarded_by, make_lock
+
 _THIS_DIR = os.path.dirname(os.path.abspath(__file__))
 _BATCHER_DIR = os.path.join(_THIS_DIR, 'batcher')
 _LIB_PATH = os.path.join(_BATCHER_DIR, 'libbatcher.so')
@@ -111,13 +113,19 @@ class Batcher:
   Most users want `batch_fn` / `batch_fn_with_options`; this class is
   the substrate (and what tests drive for out-of-order completion)."""
 
+  # Lock discipline (round 18, guarded-by lint): the dtype/shape
+  # metadata is published under _meta_lock (the C++ mutex orders the
+  # actual batch handoff).
+  _in_meta: guarded_by('_meta_lock')
+  _out_meta: guarded_by('_meta_lock')
+
   def __init__(self, num_tensors: int, minimum_batch_size: int = 1,
                maximum_batch_size: int = 1024, timeout_ms: int = 100):
     self._lib = _ensure_lib()
     self._h = self._lib.batcher_create(
         minimum_batch_size, maximum_batch_size, timeout_ms, num_tensors)
     self._num_tensors = num_tensors
-    self._meta_lock = threading.Lock()
+    self._meta_lock = make_lock('dynamic_batching.Batcher._meta_lock')
     # dtype/trailing-shape per input tensor, fixed by the first call
     # (published under the lock before compute_begin; the computation
     # thread reads after get_batch — the C++ mutex orders the two).
@@ -317,7 +325,8 @@ class _BatchedFunction:
     self._opts = (minimum_batch_size, maximum_batch_size, timeout_ms)
     self._batcher: Optional[Batcher] = None
     self._thread: Optional[threading.Thread] = None
-    self._start_lock = threading.Lock()
+    self._start_lock = make_lock(
+        'dynamic_batching._BatchedFunction._start_lock')
     self.__name__ = getattr(f, '__name__', 'batched_fn')
 
   def _loop(self):

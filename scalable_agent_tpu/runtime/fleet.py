@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from scalable_agent_tpu.analysis.runtime import guarded_by, make_lock
 from scalable_agent_tpu.runtime import ring_buffer
 from scalable_agent_tpu.runtime.actor import Actor
 from scalable_agent_tpu.runtime.remote import Backoff
@@ -90,6 +91,12 @@ class ActorFleet:
       unroll before the slot gives up and quarantines (0 = never).
   """
 
+  # Lock discipline (round 18, checked by the guarded-by lint): slot
+  # mutation and the rehabilitation counters happen under _lock; the
+  # _Slot objects themselves are reached only through _slots.
+  _slots_rehabilitated: guarded_by('_lock')
+  _rehabilitations: guarded_by('_lock')
+
   def __init__(self, make_actor: Callable, buffer, num_actors: int,
                quarantine_after: int = 5,
                probation_secs: float = 30.0):
@@ -98,7 +105,7 @@ class ActorFleet:
     self._quarantine_after = int(quarantine_after)
     self._probation_secs = float(probation_secs)
     self._stop = threading.Event()
-    self._lock = threading.Lock()
+    self._lock = make_lock('fleet._lock')
     self._slots: List[_Slot] = [_Slot(i) for i in range(num_actors)]
     self._slots_rehabilitated = 0  # probation cleared by an unroll
     self._rehabilitations = 0      # probation attempts started

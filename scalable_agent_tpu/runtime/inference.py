@@ -86,6 +86,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from scalable_agent_tpu.analysis.runtime import guarded_by, make_lock
 from scalable_agent_tpu.observability import LatencyReservoir
 from scalable_agent_tpu.ops import dynamic_batching
 from scalable_agent_tpu.runtime import faults as faults_lib
@@ -228,6 +229,40 @@ class InferenceServer:
       arena (config.inference_state_slots == 0).
   """
 
+  # Lock discipline (round 18; enforced by the guarded-by lint and,
+  # armed, by OrderedLock's inversion detector). Documented order
+  # where nested: _slot_lock -> _arena_lock and _slot_lock ->
+  # _stats_lock (the admission path), _key_lock -> _arena_lock
+  # (dispatch), _params_lock -> _stats_lock (publish-skip). Nothing
+  # takes _slot_lock after any other lock.
+  _params: guarded_by('_params_lock')
+  _published_version_key: guarded_by('_params_lock')
+  _key: guarded_by('_key_lock')
+  _arena: guarded_by('_arena_lock')
+  _free: guarded_by('_slot_lock')
+  _waiters: guarded_by('_slot_lock')
+  _waiter_seq: guarded_by('_slot_lock')
+  _closed: guarded_by('_slot_lock')
+  _admission: guarded_by('_slot_lock')
+  # The grow path swaps the arena (and its size) holding BOTH
+  # _slot_lock and _arena_lock, so readers under either are safe.
+  _num_slots: guarded_by('_slot_lock', '_arena_lock')
+  _calls: guarded_by('_stats_lock')
+  _merged_requests: guarded_by('_stats_lock')
+  _params_version: guarded_by('_stats_lock')
+  _publishes_skipped: guarded_by('_stats_lock')
+  _devices_last_call: guarded_by('_stats_lock')
+  _inflight: guarded_by('_stats_lock')
+  _inflight_peak: guarded_by('_stats_lock')
+  _acquires: guarded_by('_stats_lock')
+  _admission_waits: guarded_by('_stats_lock')
+  _sheds: guarded_by('_stats_lock')
+  _admission_timeouts: guarded_by('_stats_lock')
+  _arena_grows: guarded_by('_stats_lock')
+  _unjoined_threads: guarded_by('_stats_lock')
+  _latencies: guarded_by('_stats_lock')
+  _chain_recoveries: guarded_by('_stats_lock')
+
   def __init__(self, agent, params, config, seed=0, mesh=None,
                pad_batch_to=None, fleet_size=None):
     self._pad_floor = pad_batch_to
@@ -266,11 +301,11 @@ class InferenceServer:
     else:
       self._dp = 1
     self._params = params
-    self._params_lock = threading.Lock()
+    self._params_lock = make_lock('inference._params_lock')
     # Sentinel: never equal to any caller-supplied publish version, so
     # the first update_params always lands (see update_params).
     self._published_version_key = object()
-    self._stats_lock = threading.Lock()
+    self._stats_lock = make_lock('inference._stats_lock')
     self._calls = 0
     self._merged_requests = 0
     self._params_version = 0
@@ -294,7 +329,7 @@ class InferenceServer:
     # in-graph); the lock orders warmup (caller thread) against the
     # dispatch thread. Same split sequence as the old host-side
     # jax.random.split — numerics unchanged.
-    self._key_lock = threading.Lock()
+    self._key_lock = make_lock('inference._key_lock')
     self._key = jax.random.PRNGKey(seed)
     self._base_seed = seed
     self._chain_recoveries = 0
@@ -304,8 +339,8 @@ class InferenceServer:
     # Lock order where nested: _slot_lock -> _arena_lock (the grow
     # path swaps the arena while holding the free list); _key_lock ->
     # _arena_lock (dispatch). Nothing takes _slot_lock after either.
-    self._arena_lock = threading.Lock()
-    self._slot_lock = threading.Lock()
+    self._arena_lock = make_lock('inference._arena_lock')
+    self._slot_lock = make_lock('inference._slot_lock')
     self._waiters = []          # parked _acquire_slot callers
     self._waiter_seq = 0
     if self._state_cache:
@@ -431,9 +466,12 @@ class InferenceServer:
 
   @property
   def admission(self) -> str:
-    """The live admission policy (GIL-atomic read; the controller's
-    actuator get path)."""
-    return self._admission
+    """The live admission policy (the controller's actuator get
+    path). Round 18: read under _slot_lock like every other
+    _admission access — the bare read was GIL-atomic but violated
+    the declared guarded_by discipline (found by the lint)."""
+    with self._slot_lock:
+      return self._admission
 
   def set_admission(self, mode: str) -> str:
     """Thread-safe live admission-policy flip (round 15: the
@@ -490,7 +528,7 @@ class InferenceServer:
     self._zero_slot(slot)
     return _SlotHandle(self, slot)
 
-  def _best_waiter(self):
+  def _best_waiter_locked(self):
     """Called with _slot_lock held; waitlists are fleet-sized."""
     return min(self._waiters, key=lambda w: (w.priority, w.seq))
 
@@ -517,7 +555,7 @@ class InferenceServer:
             self._waiters.remove(waiter)
           raise InferenceClosed(
               'inference server closed while waiting for a state slot')
-        if self._free and self._best_waiter() is waiter:
+        if self._free and self._best_waiter_locked() is waiter:
           self._waiters.remove(waiter)
           slot = self._free.pop()
           break
@@ -567,7 +605,7 @@ class InferenceServer:
         # Direct handoff to the best-priority waiter: the slot never
         # touches the free list, so a lower-priority waiter (or a
         # fresh fast-path acquire) cannot steal it.
-        w = self._best_waiter()
+        w = self._best_waiter_locked()
         self._waiters.remove(w)
         w.slot = slot
         w.event.set()
@@ -748,8 +786,17 @@ class InferenceServer:
         jax.block_until_ready(self._key)
       except Exception:
         recovered = True
+        # Round 18 (guarded-by lint + review): read the recovery
+        # count under _stats_lock NESTED in _key_lock — two racing
+        # recoveries serialize on _key_lock, and each must see the
+        # previous one's increment (below, same nesting) or both
+        # would reseed with the identical (base_seed, count) key and
+        # silently replay the same inference RNG stream. Lock order
+        # _key_lock -> _stats_lock; nothing takes them inverted.
+        with self._stats_lock:
+          recoveries = self._chain_recoveries
         key = jax.random.PRNGKey(
-            self._base_seed + 100_003 * (self._chain_recoveries + 1))
+            self._base_seed + 100_003 * (recoveries + 1))
         if self._mesh is not None:
           key = jax.device_put(key, self._replicated)
         self._key = key
@@ -764,9 +811,12 @@ class InferenceServer:
             if self._mesh is not None:
               arena = jax.device_put(arena, self._replicated)
             self._arena = arena
-    if recovered:
-      with self._stats_lock:
-        self._chain_recoveries += 1
+      if recovered:
+        # Still inside _key_lock: the count advance is part of the
+        # recovery's critical section, not an afterthought a second
+        # recoverer can sneak past.
+        with self._stats_lock:
+          self._chain_recoveries += 1
 
   def _padded_size(self, n):
     """Bucket size for a merged batch of n: next power of two (capped
@@ -871,6 +921,7 @@ class InferenceServer:
       unjoined = self._unjoined_threads
     with self._slot_lock:
       waitlist_depth = len(self._waiters)
+      admission = self._admission
     (wait_p99_ms,) = self._admission_wait_reservoir.percentile_ms(0.99)
     p50 = percentile_ms(lat, 0.5)
     p99 = percentile_ms(lat, 0.99)
@@ -890,7 +941,7 @@ class InferenceServer:
         'slots_free': self.slots_free() if self._state_cache else None,
         # Admission/overload telemetry (round 9): the shed fraction is
         # sheds / acquires — the serving-plane overload SLO number.
-        'admission': self._admission,
+        'admission': admission,
         'acquires': acquires,
         'admission_waits': admission_waits,
         'sheds': sheds,

@@ -116,6 +116,7 @@ import numpy as np
 
 from scalable_agent_tpu import integrity
 from scalable_agent_tpu import telemetry
+from scalable_agent_tpu.analysis.runtime import guarded_by, make_lock
 from scalable_agent_tpu.runtime import faults as faults_lib
 from scalable_agent_tpu.runtime import ring_buffer
 
@@ -1046,12 +1047,17 @@ class _Conn:
   send path is progress-bounded: a non-reading peer aborts the send
   with `_SendStall` instead of wedging the sending thread."""
 
+  # Lock discipline (round 18, guarded-by lint): the in-flight count
+  # is the only _Conn field shared between the reader, the worker
+  # pool, and the reaper; send_lock serializes writers on the socket.
+  inflight: guarded_by('inflight_lock')
+
   def __init__(self, sock: socket.socket, addr=None,
                send_stall_secs: Optional[float] = None,
                base_timeout: Optional[float] = None):
     self.sock = sock
     self.addr = addr
-    self.send_lock = threading.Lock()
+    self.send_lock = make_lock('remote._Conn.send_lock')
     self.send_stall_secs = send_stall_secs
     # The socket timeout try_send must RESTORE (None = blocking legacy
     # mode; the reader's poll interval in liveness mode — restoring
@@ -1083,7 +1089,7 @@ class _Conn:
     # exempt such conns or they would reap/flag protocol-obedient
     # peers exactly when the learner is slowest.
     self.inflight = 0
-    self.inflight_lock = threading.Lock()
+    self.inflight_lock = make_lock('remote._Conn.inflight_lock')
 
   def job_started(self):
     with self.inflight_lock:
@@ -1174,7 +1180,7 @@ class _ParamLane:
     self._idle_timeout = float(idle_timeout_secs)
     self._watchdog = watchdog
     self._selector = selectors.DefaultSelector()
-    self._lock = threading.Lock()  # guards adopt vs close
+    self._lock = make_lock('remote._ParamLane._lock')  # adopt vs close
     self._closed = False
     self._blobs_served = 0
     self._bytes_sent = 0
@@ -1536,6 +1542,25 @@ class TrajectoryIngestServer:
       CRC-off row, and the escape hatch for CPU-bound ingest hosts).
   """
 
+  # Lock discipline (round 18, guarded-by lint). Three planes, three
+  # locks, no nesting between them: the published snapshot + its
+  # serialization clock under _params_lock, the connection/reattach
+  # counters under _stats_lock, the live conn/thread lists under
+  # _conns_lock. The registry counters (ingest/unrolls etc.) carry
+  # their own per-counter locks and stay unannotated.
+  _version: guarded_by('_params_lock')
+  _blob_version: guarded_by('_params_lock')
+  _params_frame: guarded_by('_params_lock')
+  _serializations: guarded_by('_params_lock')
+  _connections: guarded_by('_stats_lock')
+  _param_subscribers: guarded_by('_stats_lock')
+  _reattached: guarded_by('_stats_lock')
+  _reconnected: guarded_by('_stats_lock')
+  _reattach_latency: guarded_by('_stats_lock')
+  _unjoined_threads: guarded_by('_stats_lock')
+  _threads: guarded_by('_conns_lock')
+  _conns: guarded_by('_conns_lock')
+
   def __init__(self, buffer, params, host: str = '127.0.0.1',
                port: int = 0, contract=None,
                wire_dtype: Optional[str] = None,
@@ -1586,7 +1611,7 @@ class TrajectoryIngestServer:
       polls.append(self._heartbeat_secs / 2)
     self._poll_secs = max(min(polls), 0.05)
     self._watchdog = ThreadWatchdog()
-    self._params_lock = threading.Lock()
+    self._params_lock = make_lock('remote.IngestServer._params_lock')
     self._version = 1
     self._blob_version = 1
     # One pickle per version (VERDICT r2 W2): handler threads send
@@ -1595,7 +1620,7 @@ class TrajectoryIngestServer:
     # version bump otherwise costs O(hosts × tree) pickles.
     self._serializations = 0
     self._params_frame = self._make_blob(self._version, params)
-    self._stats_lock = threading.Lock()
+    self._stats_lock = make_lock('remote.IngestServer._stats_lock')
     # Round 13: the scattered per-module ints moved into the unified
     # metrics registry (telemetry.Counter — each has its own lock;
     # cross-counter atomicity was never relied on). stats() keeps its
@@ -1641,7 +1666,7 @@ class TrajectoryIngestServer:
     # actor hosts over a long run must not accumulate dead entries).
     self._threads: List[threading.Thread] = []
     self._conns: List[_Conn] = []
-    self._conns_lock = threading.Lock()
+    self._conns_lock = make_lock('remote.IngestServer._conns_lock')
     # Trajectory-lane handoff: readers push (conn, unroll, t_recv,
     # client_version); the worker pool validates, commits
     # (backpressure lives in the blocking put) and acks. BOUNDED

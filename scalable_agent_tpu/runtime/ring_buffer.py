@@ -51,6 +51,7 @@ from typing import Callable, List, Optional, Tuple
 
 from scalable_agent_tpu import integrity
 from scalable_agent_tpu import telemetry
+from scalable_agent_tpu.analysis.runtime import guarded_by, make_lock
 from scalable_agent_tpu.runtime.actor import batch_unrolls
 from scalable_agent_tpu.structs import ActorOutput
 
@@ -91,6 +92,16 @@ class ReplayTier:
   Thread-safe (own lock; never calls back into the buffer).
   """
 
+  # Lock discipline (round 18, guarded-by lint). The public eviction/
+  # reuse counters stay unannotated on purpose: their fn-gauge reads
+  # are lock-free by design (torn-read-benign ints, documented below).
+  _entries: guarded_by('_lock')
+  _cursor: guarded_by('_lock')
+  _version: guarded_by('_lock')
+  _staleness_sum: guarded_by('_lock')
+  _staleness_samples: guarded_by('_lock')
+  _last_sample: guarded_by('_lock')
+
   def __init__(self, capacity_unrolls: int, max_staleness: int = 0,
                verify_crc: bool = True):
     if capacity_unrolls < 1:
@@ -100,7 +111,7 @@ class ReplayTier:
     self._verify_crc = bool(verify_crc)
     self._entries = collections.deque()  # (unroll, version, crc|None)
     self._cursor = 0
-    self._lock = threading.Lock()
+    self._lock = make_lock('ring_buffer.ReplayTier._lock')
     self._version = 0
     # Telemetry (summary surface via TrajectoryBuffer.stats()).
     self.evictions_age = 0
@@ -267,6 +278,17 @@ class TrajectoryBuffer:
   retention behind it.
   """
 
+  # Lock discipline (round 18, guarded-by lint): the deque, close
+  # flag, and backpressure counters mutate only under _lock (the
+  # conditions wrap the same mutex — the checker understands the
+  # aliasing); fn-gauge reads in __init__ are exempt by convention.
+  _deque: guarded_by('_lock')
+  _closed: guarded_by('_lock')
+  _high_water: guarded_by('_lock')
+  _put_waits: guarded_by('_lock')
+  _put_wait_secs: guarded_by('_lock')
+  _fresh_unrolls: guarded_by('_lock')
+
   def __init__(self, capacity_unrolls: int,
                replay: Optional[ReplayTier] = None,
                replay_ratio: float = 0.0):
@@ -280,7 +302,7 @@ class TrajectoryBuffer:
     self._replay = replay
     self._replay_ratio = replay_ratio
     self._deque = collections.deque()
-    self._lock = threading.Lock()
+    self._lock = make_lock('ring_buffer.TrajectoryBuffer._lock')
     self._not_full = threading.Condition(self._lock)
     self._not_empty = threading.Condition(self._lock)
     self._closed = False
@@ -733,6 +755,21 @@ class BatchPrefetcher:
   tier the one-argument contract is unchanged.
   """
 
+  # Lock discipline (round 18, guarded-by lint): staging state, the
+  # overlap telemetry, and the live replay_k knob all mutate under
+  # _lock (the _ready/_space conditions wrap the same mutex).
+  _out: guarded_by('_lock')
+  _closed: guarded_by('_lock')
+  _error: guarded_by('_lock')
+  _staged: guarded_by('_lock')
+  _gets: guarded_by('_lock')
+  _blocked_gets: guarded_by('_lock')
+  _wait_secs: guarded_by('_lock')
+  _serves: guarded_by('_lock')
+  _reserves: guarded_by('_lock')
+  _fresh_served: guarded_by('_lock')
+  _replay_k: guarded_by('_lock')
+
   def __init__(self, buffer: TrajectoryBuffer, batch_size: int,
                place_fn: Callable = lambda batch, n_fresh=None: batch,
                depth: int = 2,
@@ -756,7 +793,7 @@ class BatchPrefetcher:
     self._reserves = 0
     self._fresh_served = 0
     self._out = collections.deque()
-    self._lock = threading.Lock()
+    self._lock = make_lock('ring_buffer.BatchPrefetcher._lock')
     self._ready = threading.Condition(self._lock)
     self._space = threading.Condition(self._lock)
     self._depth = depth
@@ -913,9 +950,12 @@ class BatchPrefetcher:
 
   @property
   def replay_k(self) -> int:
-    """The live re-serve count (GIL-atomic read; the controller's
-    actuator get path)."""
-    return self._replay_k
+    """The live re-serve count (the controller's actuator get path).
+    Round 18: read under _lock like every other _replay_k access —
+    the bare read was GIL-atomic but violated the declared
+    guarded_by discipline (found by the lint)."""
+    with self._lock:
+      return self._replay_k
 
   def set_replay_k(self, k: int):
     """Thread-safe live replay_k change (round 15: the controller's

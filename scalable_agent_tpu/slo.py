@@ -67,6 +67,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from scalable_agent_tpu import telemetry
+from scalable_agent_tpu.analysis.runtime import guarded_by, make_lock
 
 # Severity ladder. Only `page` triggers deep diagnostics; `info`
 # objectives are recorded in the verdict but never fail it (advisory
@@ -583,6 +584,16 @@ class SloEngine:
   One capture per objective per run; `finalize()` writes
   SLO_VERDICT.json (atomic) and returns the verdict."""
 
+  # Lock discipline (round 18, guarded-by lint): the evaluator state,
+  # the capture rate-limit table, and both work queues mutate only
+  # under _lock — observe() runs from TWO threads (engine tick + the
+  # driver's summary block), so a bare deque append here is exactly
+  # the torn-coordination shape the round-15 snapshot-consistency
+  # test exists for.
+  _captures: guarded_by('_lock')
+  _profile_queue: guarded_by('_lock')
+  _capture_queue: guarded_by('_lock')
+
   def __init__(self, objectives: List[Objective], logdir: str,
                registry: Optional[telemetry.MetricsRegistry] = None,
                writer=None, incidents=None, flight=None, health=None,
@@ -602,7 +613,7 @@ class SloEngine:
     self._capture = bool(capture)
     self._interval = max(float(interval_secs), 0.25)
     self._trace_slice_fn = trace_slice_fn or _trace_slice
-    self._lock = threading.Lock()
+    self._lock = make_lock('slo.SloEngine._lock')
     self._stop = threading.Event()
     self._thread: Optional[threading.Thread] = None
     self._captures: Dict[str, Dict] = {}
@@ -724,8 +735,15 @@ class SloEngine:
     """Write queued capture artifacts (engine thread per tick;
     finalize as the backstop for burns detected after the last tick).
     Each capture is independently best-effort."""
-    while self._capture_queue:
-      name, capture, state = self._capture_queue.popleft()
+    while True:
+      # Round 18 (guarded-by lint): the queue is appended to under
+      # the lock by whichever thread's observe() detects the burn —
+      # the drain must pop under the same lock, not rely on deque
+      # GIL-atomicity.
+      with self._lock:
+        if not self._capture_queue:
+          return
+        name, capture, state = self._capture_queue.popleft()
       try:
         self._write_capture_artifacts(name, capture, state)
       except Exception:  # the contract: never take down the run
@@ -766,7 +784,10 @@ class SloEngine:
           capture['trace_slice'] = slice_path
       except Exception:
         pass
-    self._profile_queue.append(name)
+    # Round 18 (guarded-by lint): the driver loop pops this queue
+    # under the lock; the engine-thread append holds it too.
+    with self._lock:
+      self._profile_queue.append(name)
     if self._incidents is not None:
       try:
         self._incidents.event('slo_capture', objective=name,

@@ -247,6 +247,11 @@ def run_storm(logdir: str, smoke: bool = SMOKE, seed: int = SEED):
       actor_reconnect_secs=120.0,
       health_rollback_after=3,  # K: the burst (5) must cross it
       health_min_window=8,
+      # Round 18 (analysis/runtime.py): run the storm with lock-order
+      # detection ARMED — every lock the threaded planes take under
+      # fault pressure feeds the acquisition graph, so the storm
+      # doubles as a race hunt. The zero-cycles assert is below.
+      lock_order_check=True,
       seed=seed)
   cfg = Config(**cfg_kwargs)
 
@@ -393,6 +398,26 @@ def run_storm(logdir: str, smoke: bool = SMOKE, seed: int = SEED):
               'fleet_healthy_fraction'):
     if tag not in tags:
       errors.append(f'summary tag {tag!r} missing')
+
+  # --- SLO (round 18): zero lock-order inversions over the armed
+  # storm — the detector recorded every acquisition the threaded
+  # planes made under fault pressure, and a cycle anywhere in the
+  # run is a latent deadlock (it would also have landed as a durable
+  # lock_order_inversion incident; assert both surfaces).
+  from scalable_agent_tpu.analysis import runtime as lock_check
+  cycles = lock_check.cycles_detected()
+  results['lock_order'] = {'armed': lock_check.is_armed(),
+                           'cycles': cycles}
+  if not lock_check.is_armed():
+    errors.append('lock-order detection was not armed for the storm')
+  if cycles:
+    errors.append(f'{cycles} lock-order inversion(s) detected: '
+                  f'{lock_check.cycle_reports()}')
+  inversion_incidents = [e for e in incidents
+                         if e['kind'] == 'lock_order_inversion']
+  if inversion_incidents:
+    errors.append(f'lock_order_inversion incidents in the stream: '
+                  f'{inversion_incidents}')
 
   results.update({
       'health': hs,

@@ -70,108 +70,19 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_remote.py \
 'fingerprint or discard or integrity' \
   -p no:cacheprovider
 
-echo '== telemetry metric-name lint (every counter/gauge/histogram'
-echo '   registered in scalable_agent_tpu/ must appear in the'
-echo '   docs/OBSERVABILITY.md inventory, and no documented name may'
-echo '   be orphaned — greppable-literal registration is the contract'
-echo '   that makes this a static check) =='
-python - <<'LINT_EOF'
-import pathlib
-import re
-import sys
-
-root = pathlib.Path('scalable_agent_tpu')
-# Every registration uses the literal-string module helpers
-# (telemetry.counter('x/y') / gauge / histogram — telemetry.py itself
-# calls them bare, integrity.py as _telemetry.*): the lint greps that
-# spelling, which is why non-literal names are forbidden.
-# A dot-prefixed call that is NOT telemetry.* (writer.histogram of
-# the summary stream) is a different API — the lookbehind excludes
-# it; placeholder examples in docstrings use <angle brackets>, which
-# the name filter drops.
-pat = re.compile(
-    r"(?:\btelemetry\.|\b_telemetry\.|(?<![\w.]))"
-    r"(?:counter|gauge|histogram)\(\s*'([^']+)'")
-registered = set()
-for path in sorted(root.rglob('*.py')):
-    for m in pat.finditer(path.read_text()):
-        if re.fullmatch(r'[a-z0-9_]+(?:/[a-z0-9_]+)+', m.group(1)):
-            registered.add(m.group(1))
-doc = pathlib.Path('docs/OBSERVABILITY.md').read_text()
-documented = set(re.findall(r'`([a-z0-9_]+(?:/[a-z0-9_]+)+)`', doc))
-undocumented = sorted(registered - documented)
-orphaned = sorted(documented - registered)
-if undocumented:
-    print('UNDOCUMENTED metric names (add to docs/OBSERVABILITY.md '
-          'inventory):')
-    for n in undocumented:
-        print(f'  {n}')
-if orphaned:
-    print('ORPHANED documented names (no longer registered in '
-          'scalable_agent_tpu/):')
-    for n in orphaned:
-        print(f'  {n}')
-# Round 15: the controller's policy table rides the contract too —
-# every DEFAULT rule's objective must be a shipped DEFAULT objective
-# (a rule watching an objective nobody evaluates never fires), and
-# every rule's actuator must be a KNOWN_ACTUATORS name.
-ctrl_src = pathlib.Path('scalable_agent_tpu/controller.py').read_text()
-ctrl_objectives = set(re.findall(r"objective='([a-z0-9_]+)'",
-                                 ctrl_src))
-ctrl_actuators = set(re.findall(r"actuator='([a-z0-9_]+)'", ctrl_src))
-known = set(re.findall(r"'([a-z0-9_]+)'",
-                       re.search(r'KNOWN_ACTUATORS = \(([^)]*)\)',
-                                 ctrl_src).group(1)))
-# Round 14: the SLO layer rides the same static contract. Every
-# DEFAULT objective's metric must be a REGISTERED name (an objective
-# judging a metric nobody registers silently evaluates as no_data
-# forever — that is a CI failure, not a shrug), and the
-# docs/OBSERVABILITY.md SLO inventory table must match the shipped
-# default set by NAME, both directions.
-slo_src = pathlib.Path('scalable_agent_tpu/slo.py').read_text()
-slo_metrics = set(re.findall(r"metric='([a-z0-9_]+(?:/[a-z0-9_]+)+)'",
-                             slo_src))
-slo_names = set(re.findall(r"Objective\(name='([a-z0-9_]+)'",
-                           slo_src))
-unregistered = sorted(slo_metrics - registered)
-doc_slo = set(re.findall(
-    r"^\|\s*`([a-z0-9_]+)`\s*\|\s*`[a-z0-9_]+(?:/[a-z0-9_]+)+`",
-    doc, re.MULTILINE))
-undoc_slo = sorted(slo_names - doc_slo)
-orphan_slo = sorted(doc_slo - slo_names)
-if unregistered:
-    print('SLO objectives over UNREGISTERED metrics:')
-    for n in unregistered:
-        print(f'  {n}')
-if undoc_slo:
-    print('SLO objectives missing from the docs/OBSERVABILITY.md '
-          'inventory table:')
-    for n in undoc_slo:
-        print(f'  {n}')
-if orphan_slo:
-    print('ORPHANED documented SLO objectives (not in '
-          'slo.DEFAULT_OBJECTIVES):')
-    for n in orphan_slo:
-        print(f'  {n}')
-bad_rule_objectives = sorted(ctrl_objectives - slo_names)
-bad_rule_actuators = sorted(ctrl_actuators - known)
-if bad_rule_objectives:
-    print('controller DEFAULT_RULES over objectives not in '
-          'slo.DEFAULT_OBJECTIVES:')
-    for n in bad_rule_objectives:
-        print(f'  {n}')
-if bad_rule_actuators:
-    print('controller DEFAULT_RULES over unknown actuators:')
-    for n in bad_rule_actuators:
-        print(f'  {n}')
-if (undocumented or orphaned or unregistered or undoc_slo
-        or orphan_slo or bad_rule_objectives or bad_rule_actuators):
-    sys.exit(1)
-print(f'metric-name lint OK: {len(registered)} registered names all '
-      f'documented, none orphaned; {len(slo_names)} SLO objectives '
-      'over registered metrics, inventory in sync; '
-      f'{len(ctrl_objectives)} controller rule objectives resolved')
-LINT_EOF
+echo '== static-analysis lane (round 18: the invariant analyzer —'
+echo '   the full contract-lint suite in scripts/lint.py replaces the'
+echo '   old inline heredoc: metric names / SLO objectives /'
+echo '   controller rules (the ported checks) + config-field flags,'
+echo '   validate_* coverage, durable incident markers, protocol'
+echo '   versions, summary scalars, the guarded_by lock-discipline'
+echo '   AST pass, and the self-applied checker-inventory lint; then'
+echo '   the seeded-violation self-tests (every checker proven able'
+echo '   to fire) and the OrderedLock inversion-detector unit — the'
+echo '   lint itself stays under ~20 s, docs/STATIC_ANALYSIS.md) =='
+python scripts/lint.py
+JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q \
+  -p no:cacheprovider
 
 echo '== slo lane (round 14: declarative objectives over the registry,'
 echo '   burn-rate evaluation, triggered deep diagnostics, the'

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Invariant analyzer CLI — the repo's static contract lint.
+
+    python scripts/lint.py                 # full checker suite
+    python scripts/lint.py --check metric-names --check guarded-by
+    python scripts/lint.py --json          # machine-readable findings
+    python scripts/lint.py --list          # checker inventory
+    python scripts/lint.py --fix-docs      # regenerate generated doc
+                                           # inventory blocks, then
+                                           # re-lint
+
+Exit status: 0 = clean, 1 = findings, 2 = usage error. The framework
+lives in scalable_agent_tpu/analysis/ (stdlib-ast only); the checker
+inventory printed by --list is itself contract-linted against
+docs/STATIC_ANALYSIS.md (the `checker-inventory` check), so docs and
+code cannot drift.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+from scalable_agent_tpu import analysis  # noqa: E402
+from scalable_agent_tpu.analysis import CheckContext, contracts  # noqa: E402
+
+
+def main(argv=None) -> int:
+  parser = argparse.ArgumentParser(
+      description='AST-based contract lint (docs/STATIC_ANALYSIS.md)')
+  parser.add_argument('--check', action='append', default=[],
+                      metavar='NAME',
+                      help='run only this checker (repeatable)')
+  parser.add_argument('--json', action='store_true',
+                      help='emit findings as a JSON list')
+  parser.add_argument('--list', action='store_true',
+                      help='print the checker inventory and exit')
+  parser.add_argument('--fix-docs', action='store_true',
+                      help='regenerate generated doc inventory blocks '
+                           '(summary scalars) before linting')
+  parser.add_argument('--root', default=_ROOT, help=argparse.SUPPRESS)
+  args = parser.parse_args(argv)
+
+  if args.list:
+    for name, description, _ in analysis.all_checkers():
+      print(f'{name}: {description}')
+    return 0
+
+  if args.fix_docs:
+    changed = contracts.fix_summary_scalar_docs(CheckContext(args.root))
+    print('docs/OBSERVABILITY.md summary-scalar block '
+          + ('REGENERATED' if changed else 'already current'),
+          file=sys.stderr)
+
+  try:
+    findings = analysis.run_checks(args.root, only=args.check or None)
+  except ValueError as e:
+    print(f'lint: {e}', file=sys.stderr)
+    return 2
+
+  if args.json:
+    print(json.dumps([vars(f) for f in findings], indent=2))
+  else:
+    for f in findings:
+      print(f.render())
+    n_checks = len(args.check) if args.check else len(
+        analysis.all_checkers())
+    if findings:
+      print(f'lint: {len(findings)} finding(s) across {n_checks} '
+            'checker(s)', file=sys.stderr)
+    else:
+      print(f'lint OK: {n_checks} checker(s), no findings',
+            file=sys.stderr)
+  return 1 if findings else 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

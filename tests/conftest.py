@@ -16,6 +16,12 @@ if '--xla_force_host_platform_device_count' not in _flags:
   os.environ['XLA_FLAGS'] = (
       _flags + ' --xla_force_host_platform_device_count=8').strip()
 os.environ['JAX_PLATFORMS'] = 'cpu'
+# Lock-order detection (round 18, analysis/runtime.py): every test
+# runs with the threaded modules' locks instrumented — make_lock
+# reads this at import/construction, so it must be set before
+# anything imports the package. Detections log + count
+# (analysis/lock_cycles); the chaos storms assert zero.
+os.environ.setdefault('LOCK_ORDER_CHECK', '1')
 
 # Warm the forkserver (default PyProcess start method) while this
 # process is still single-threaded — before jax exists.
