@@ -2417,6 +2417,137 @@ def bench_multihost(smoke):
   return results
 
 
+def bench_mesh2d(smoke):
+  """The 2D {data, model} mesh vs pure-DP at the SAME global batch
+  (round 19, parallel/sharding.py): what does cutting the params over
+  the model axis buy, and what does it cost?
+
+  Two rows through the PRODUCTION sharded path (registry-resolved
+  placements, make_sharded_train_step — the exact code the driver
+  runs):
+
+  - `dp` — mesh {data: N}, `sharding_rules` resolves to 'replicated';
+  - `mesh2d` — mesh {data: N/2, model: 2}, rules 'megatron' (TP on
+    Dense/LSTM-gate/Conv kernels).
+
+  Per row: measured `step_ms` (value-readback barrier), and the
+  per-device memory split the registry's placements actually produce —
+  `state_bytes_per_device` (params + optimizer moments, summed from
+  the live state's addressable shards: the at-rest HBM story TP
+  exists for) and `batch_bytes_per_device` — plus XLA's static
+  `live_bytes_per_device` from the AOT memory analysis of the same
+  step under the same shardings (parallel/fit.py's instrument) when
+  the backend exposes it.
+
+  Headline: `state_bytes_ratio` (mesh2d/dp, ≈0.5 + replicated-head
+  remainder when the cut engages) and both step_ms. CPU rows carry
+  the gathered-TP caveat: tp_compute=auto resolves 'gathered' there
+  (docs/PARALLELISM.md), so mesh2d step_ms prices gather → replicated
+  compute → scatter, NOT true sharded TP compute — per-device step
+  time is a TPU question, the memory split is exact everywhere."""
+  import numpy as np  # noqa: F401  (parity with sibling stages)
+  import jax
+  from scalable_agent_tpu import learner as learner_lib
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.models import ImpalaAgent, init_params
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+  from scalable_agent_tpu.parallel import mesh as mesh_lib
+  from scalable_agent_tpu.parallel import sharding as sharding_lib
+  from scalable_agent_tpu.parallel import train_parallel
+  from scalable_agent_tpu.testing import make_example_batch
+
+  h, w = (72, 96) if not smoke else (24, 32)
+  b = 32 if not smoke else 8
+  t = 20 if not smoke else 4
+  steps = 10 if not smoke else 2
+  torso = 'deep' if not smoke else 'shallow'
+  obs_spec = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+
+  def run_variant(mp):
+    cfg = Config(batch_size=b, unroll_length=t, num_action_repeats=1,
+                 total_environment_frames=int(1e9),
+                 model_parallelism=mp, sharding_rules='auto',
+                 torso=torso, use_instruction=False)
+    agent = ImpalaAgent(num_actions=9, torso=torso,
+                        use_instruction=False)
+    params = init_params(agent, jax.random.PRNGKey(0), obs_spec)
+    mesh = mesh_lib.make_mesh(model_parallelism=mp)
+    registry = sharding_lib.from_config(cfg)
+    state = train_parallel.make_sharded_train_state(
+        params, cfg, mesh, registry=registry)
+    batch = make_example_batch(t + 1, b, h, w, 9, obs_spec['instr_len'],
+                               seed=0, done_prob=0.05)
+    step, place = train_parallel.make_sharded_train_step(
+        agent, cfg, mesh, batch)
+    placed = place(batch)
+
+    def bytes_per_device(tree):
+      return int(sum(
+          x.addressable_shards[0].data.nbytes
+          for x in jax.tree_util.tree_leaves(tree)
+          if isinstance(x, jax.Array)))
+
+    state_bytes = bytes_per_device(state)
+    batch_bytes = bytes_per_device(placed)
+
+    # Static per-device live bytes of the SAME step under the SAME
+    # registry shardings (the fit.py instrument; donation off — the
+    # jaxlib TP donation defect xfail'd in tests/test_parallel.py).
+    live_bytes = None
+    try:
+      raw_step = learner_lib.make_train_step_fn(agent, cfg, mesh=mesh)
+      state_sh = registry.state_shardings(state, mesh)
+      batch_sh = registry.batch_shardings(batch, mesh)
+      ma = jax.jit(
+          raw_step, in_shardings=(state_sh, batch_sh),
+          out_shardings=(state_sh, sharding_lib.replicated(mesh)),
+      ).lower(state, placed).compile().memory_analysis()
+      live_bytes = int(ma.argument_size_in_bytes +
+                       ma.output_size_in_bytes +
+                       ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+    except Exception as e:  # backend without memory_analysis
+      log_note = f'memory_analysis unavailable: {e}'
+      live_bytes = None
+      del log_note
+
+    state, metrics = step(state, placed)  # warm/compile
+    float(metrics['total_loss'])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+      state, metrics = step(state, placed)
+    float(metrics['total_loss'])
+    step_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    model_cut = any(
+        sharding_lib.MODEL_AXIS in str(x.sharding.spec)
+        for x in jax.tree_util.tree_leaves(state.params))
+    return {
+        'mesh': {k: int(v) for k, v in dict(mesh.shape).items()},
+        'rule_set': registry.rule_set,
+        'global_batch': b,
+        'step_ms': round(step_ms, 2),
+        'state_bytes_per_device': state_bytes,
+        'batch_bytes_per_device': batch_bytes,
+        'live_bytes_per_device': live_bytes,
+        'model_sharded': bool(model_cut),
+        'tp_gathered': bool(getattr(step, 'tp_gathered', False)),
+    }
+
+  dp = run_variant(1)
+  mesh2d = run_variant(2)
+  ratio = (round(mesh2d['state_bytes_per_device'] /
+                 dp['state_bytes_per_device'], 3)
+           if dp['state_bytes_per_device'] else None)
+  return {
+      'dp': dp,
+      'mesh2d': mesh2d,
+      # The memory headline: TP's reason to exist at IMPALA scale.
+      'state_bytes_ratio': ratio,
+      'step_ms_ratio': (round(mesh2d['step_ms'] / dp['step_ms'], 3)
+                        if dp['step_ms'] else None),
+  }
+
+
 def main():
   # Child half of the multihost stage: a fresh interpreter dispatched
   # by bench_multihost — must run before any jax/backend setup below.
@@ -2547,6 +2678,21 @@ def main():
     })
     return
 
+  # BENCH_ONLY=mesh2d: just the 2D {data, model} mesh rows (the
+  # scripts/ci.sh sharding-lane smoke — registry-resolved DP vs
+  # DP+TP at the same global batch, step time + per-device bytes).
+  if os.environ.get('BENCH_ONLY') == 'mesh2d':
+    mesh2d = bench_mesh2d(smoke)
+    _emit({
+        'metric': 'mesh2d_state_bytes_ratio',
+        'value': mesh2d['state_bytes_ratio'],
+        'unit': ('per-device state bytes, {data,model} mesh / pure-DP '
+                 'mesh, same global batch%s'
+                 % (' (SMOKE)' if smoke else '')),
+        'mesh2d': mesh2d,
+    })
+    return
+
   # BENCH_ONLY=overload: just the overload rows (the scripts/ci.sh
   # chaos-adjacent smoke — shed-rate/tail-latency mechanics on CPU).
   if os.environ.get('BENCH_ONLY') == 'overload':
@@ -2604,6 +2750,9 @@ def main():
   mh_rows = None
   if os.environ.get('BENCH_SKIP_MULTIHOST') != '1':
     mh_rows = bench_multihost(smoke)
+  mesh2d_rows = None
+  if os.environ.get('BENCH_SKIP_MESH2D') != '1':
+    mesh2d_rows = bench_mesh2d(smoke)
 
   baseline_per_chip = 200_000.0 / 16.0  # north star / v5e-16 chips
   out = {
@@ -2653,6 +2802,8 @@ def main():
     out['controller'] = ctrl_rows
   if mh_rows is not None:
     out['multihost'] = mh_rows
+  if mesh2d_rows is not None:
+    out['mesh2d'] = mesh2d_rows
   _emit(out)
 
 
@@ -2822,6 +2973,16 @@ def _headline(out):
         'fps_per_process': mh_row.get('per_process'),
         'single_fps': (mh.get('single_1proc') or {}).get(
             'env_frames_per_sec')}
+  # The 2D {data, model} mesh rows (round 19): the per-device memory
+  # split the registry's TP rules buy + both step times — the numbers
+  # the mesh shape is accepted/rejected on (docs/PERF.md), clip-safe.
+  m2d = out.get('mesh2d')
+  if m2d:
+    head['mesh2d'] = {
+        'state_bytes_ratio': m2d.get('state_bytes_ratio'),
+        'step_ms_ratio': m2d.get('step_ms_ratio'),
+        'dp_step_ms': (m2d.get('dp') or {}).get('step_ms'),
+        'mesh2d_step_ms': (m2d.get('mesh2d') or {}).get('step_ms')}
   return head
 
 

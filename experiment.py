@@ -407,6 +407,13 @@ flags.DEFINE_string('tp_compute', _DEFAULTS.tp_compute,
                     'jaxlib mis-computes differentiated programs '
                     'over model-sharded leaves), sharded, or '
                     'gathered (docs/PARALLELISM.md).')
+flags.DEFINE_string('sharding_rules', _DEFAULTS.sharding_rules,
+                    'Partition-rule set the sharding registry '
+                    'resolves every placement from (parallel/'
+                    'sharding.py): auto (megatron when '
+                    'model_parallelism > 1, else replicated), '
+                    'replicated, or megatron '
+                    '(docs/PARALLELISM.md).')
 flags.DEFINE_bool('replay_crc', _DEFAULTS.replay_crc,
                   'Verify replay-tier entries against their '
                   'insert-time CRC at every serve; rot evicts '

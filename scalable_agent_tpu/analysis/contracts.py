@@ -643,3 +643,70 @@ def check_ci_wiring(ctx: CheckContext) -> List[Finding]:
         'scripts/ci.sh still contains the inline LINT_EOF lint '
         'heredoc — the checks live in scripts/lint.py now'))
   return findings
+
+
+# --- 11. sharding registry (round 19) ---------------------------------
+
+
+@checker('sharding-registry',
+         'no inline PartitionSpec(...) construction outside '
+         'parallel/sharding.py — every sharding decision resolves '
+         'through the registry')
+def check_sharding_registry(ctx: CheckContext) -> List[Finding]:
+  """parallel/sharding.py is the ONE source of sharding truth: a
+  `PartitionSpec(...)` constructed anywhere else in the package (or
+  its entry points) is a private sharding decision the registry
+  cannot see — exactly the hand-copied-consumer drift this round
+  deleted. Tests are deliberately out of scope (they construct
+  expected specs to assert the registry against)."""
+  sources = ctx.package_sources()
+  for extra in ('experiment.py', 'bench.py'):
+    try:
+      ctx.text(extra)
+      sources.append(extra)
+    except (FileNotFoundError, OSError):
+      pass
+  try:
+    sources.extend(ctx.package_sources('scripts'))
+  except (FileNotFoundError, OSError):
+    pass
+  findings = []
+  for rel in sources:
+    if rel.replace('\\', '/') == 'scalable_agent_tpu/parallel/sharding.py':
+      continue
+    tree = ctx.tree(rel)
+    # PartitionSpec names this module can construct with: `from
+    # jax.sharding import PartitionSpec [as P]` aliases...
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+      if isinstance(node, ast.ImportFrom) and node.module and (
+          node.module == 'jax.sharding'
+          or node.module.endswith('.sharding')):
+        for a in node.names:
+          if a.name == 'PartitionSpec':
+            aliases.add(a.asname or a.name)
+    func_of: Dict[int, str] = {}
+    for node in ast.walk(tree):
+      if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        for sub in ast.walk(node):
+          if hasattr(sub, 'lineno'):
+            func_of.setdefault(sub.lineno, node.name)
+    for node in ast.walk(tree):
+      if not isinstance(node, ast.Call):
+        continue
+      inline = (
+          # P(...) / PartitionSpec(...) via a from-import alias
+          (isinstance(node.func, ast.Name) and node.func.id in aliases)
+          # ...or any attribute spelling: jax.sharding.PartitionSpec(...)
+          or (isinstance(node.func, ast.Attribute)
+              and node.func.attr == 'PartitionSpec'))
+      if inline:
+        where = func_of.get(node.lineno, '<module>')
+        findings.append(Finding(
+            'sharding-registry', rel, node.lineno,
+            f'{rel}:{where}',
+            'inline PartitionSpec construction outside '
+            'parallel/sharding.py — resolve the spec through the '
+            'sharding registry (spec helpers or ShardingRegistry '
+            'methods) so every consumer sees the same decision'))
+  return findings

@@ -126,7 +126,19 @@ class Checkpointer:
 
   def __init__(self, directory: str, max_to_keep: int = 3,
                save_interval_secs: float = 600.0,
-               verify_digests: bool = True):
+               verify_digests: bool = True,
+               registry=None, mesh=None):
+    # Sharding registry + mesh (round 19, parallel/sharding.py): when
+    # provided, every verified save also records the REGISTRY's view
+    # of the param placements (SHARDING_{step}.json — rule set, the
+    # {path: spec} manifest, its content digest), and restores warn
+    # when the on-disk manifest disagrees with what this run would
+    # resolve — the checkpoint plane's sharding truth is the same
+    # single source as the learner's, and the manifest is the on-disk
+    # half of cross-topology resharding (ROADMAP item 3; see
+    # `registry_restore_targets`).
+    self._registry = registry
+    self._mesh = mesh
     self._directory = os.path.abspath(directory)
     os.makedirs(self._directory, exist_ok=True)
     self._manager = ocp.CheckpointManager(
@@ -220,6 +232,7 @@ class Checkpointer:
                   len(damaged))
       return True
     digests = self._record_digests(step)
+    self._record_sharding_manifest(step, state)
     self._mark_last_good(step, digests)
     # Fault site 'ckpt_bitrot' (round 12): flip one byte in a file of
     # the step JUST committed — AFTER its digests were recorded and
@@ -286,16 +299,89 @@ class Checkpointer:
       return None
 
   def _prune_digests(self) -> None:
-    """Drop digest ledgers of steps the manager no longer retains."""
+    """Drop digest/sharding ledgers of steps no longer retained."""
     retained = {str(int(s)) for s in self._manager.all_steps()}
     for name in os.listdir(self._directory):
-      if not (name.startswith('DIGEST_') and name.endswith('.json')):
-        continue
-      if name[len('DIGEST_'):-len('.json')] not in retained:
-        try:
-          os.remove(os.path.join(self._directory, name))
-        except OSError:
-          pass
+      for prefix in ('DIGEST_', 'SHARDING_'):
+        if not (name.startswith(prefix) and name.endswith('.json')):
+          continue
+        if name[len(prefix):-len('.json')] not in retained:
+          try:
+            os.remove(os.path.join(self._directory, name))
+          except OSError:
+            pass
+
+  # --- sharding manifest (round 19, parallel/sharding.py) ---
+
+  def _sharding_path(self, step: int) -> str:
+    return os.path.join(self._directory, f'SHARDING_{int(step)}.json')
+
+  def _record_sharding_manifest(self, step: int, state) -> None:
+    """Record the registry's {param_path: spec} view of this save
+    (process 0, atomic). Best-effort like the digest ledger: a
+    manifest failure must not fail the save — it only costs drift
+    detection for this step."""
+    if self._registry is None or jax.process_index() != 0:
+      return
+    try:
+      specs = self._registry.describe(state.params, self._mesh)
+      mesh_shape = (dict(self._mesh.shape)
+                    if self._mesh is not None else None)
+      payload = {
+          'step': int(step),
+          'rule_set': self._registry.rule_set,
+          'mesh': mesh_shape,
+          'specs': specs,
+          'digest': integrity.digest_record(
+              integrity.spec_table_digest(specs)),
+      }
+      tmp = self._sharding_path(step) + '.tmp'
+      with open(tmp, 'w') as f:
+        json.dump(payload, f, indent=1)
+      os.replace(tmp, self._sharding_path(step))
+    except (OSError, TypeError, ValueError):
+      log.exception('could not record sharding manifest for step %d '
+                    '(resharding drift detection lost for this step)',
+                    step)
+
+  def read_sharding_manifest(self, step: int) -> Optional[Dict]:
+    """The recorded sharding manifest of a retained step, or None."""
+    try:
+      with open(self._sharding_path(step)) as f:
+        return json.load(f)
+    except (OSError, ValueError):
+      return None
+
+  def _warn_sharding_drift(self, step: int, restored) -> None:
+    """Compare the restored step's recorded manifest against what THIS
+    run's registry resolves; a mismatch means the checkpoint was laid
+    out under different rules/topology. The restore itself is still
+    correct — Orbax resharded into the pinned targets — so this warns
+    rather than raises; it is the observability half of cross-topology
+    resharding."""
+    if self._registry is None or restored is None:
+      return
+    manifest = self.read_sharding_manifest(step)
+    if manifest is None:
+      return
+    try:
+      current = self._registry.describe(restored.params, self._mesh)
+    except Exception:
+      log.exception('sharding drift check failed for step %d', step)
+      return
+    recorded = manifest.get('specs', {})
+    if recorded == current:
+      return
+    changed = sorted(
+        set(recorded.items()) ^ set(current.items()))
+    log.warning(
+        'checkpoint step %d was saved under sharding rule set %r '
+        '(mesh %s) but this run resolves %r — %d spec(s) differ '
+        '(first: %s); Orbax resharded into the live placements, '
+        'training continues on the new layout',
+        step, manifest.get('rule_set'), manifest.get('mesh'),
+        self._registry.rule_set, len(changed) // 2 + len(changed) % 2,
+        changed[0] if changed else '?')
 
   def verify_step_digests(self, step: int) -> Optional[bool]:
     """Re-digest a retained step against its recorded ledger.
@@ -459,8 +545,9 @@ class Checkpointer:
     steps = sorted(self._manager.all_steps(), reverse=True)
     if not steps:
       return None
-    restored, _ = self._restore_ladder(
+    restored, step = self._restore_ladder(
         steps, self._make_full_restore_fn(target))
+    self._warn_sharding_drift(step, restored)
     return restored
 
   def restore_last_good(self, target: TrainState
@@ -482,6 +569,7 @@ class Checkpointer:
       log.exception('rollback restore failed on every retained step')
       return None
     log.info('rolled back to checkpoint step %d', step)
+    self._warn_sharding_drift(step, restored)
     return restored
 
   def rollback_step_choice(self) -> int:
@@ -513,7 +601,11 @@ class Checkpointer:
   def _make_full_restore_fn(self, target: TrainState):
     def to_abstract(x):
       # Pin the TARGET's sharding so restored leaves land exactly on
-      # its placements (mesh-sharded or single-device alike).
+      # its placements (mesh-sharded or single-device alike). An
+      # already-abstract leaf carrying a sharding passes through
+      # unchanged (registry_restore_targets builds those).
+      if isinstance(x, jax.ShapeDtypeStruct):
+        return x
       if isinstance(x, jax.Array):
         return jax.ShapeDtypeStruct(x.shape, x.dtype,
                                     sharding=x.sharding)
@@ -589,6 +681,16 @@ class Checkpointer:
   def wait_until_finished(self):
     self._manager.wait_until_finished()
 
+  def restore_resharded(self, abstract_state, registry, mesh):
+    """Restore the latest restorable step directly onto REGISTRY-
+    resolved placements for `mesh` — the cross-topology resharding
+    path (ROADMAP item 3): a checkpoint saved on any topology restores
+    here with Orbax moving each leaf's bytes into the specs this
+    registry resolves for THIS mesh, no concrete donor state needed.
+    `abstract_state` is the eval_shape of the target TrainState."""
+    return self.restore_latest(
+        registry_restore_targets(abstract_state, registry, mesh))
+
   def close(self):
     self._manager.wait_until_finished()
     self._manager.close()
@@ -597,3 +699,22 @@ class Checkpointer:
     from scalable_agent_tpu import telemetry
     for gauge in self._gauges:
       telemetry.registry().unregister(gauge.name, gauge)
+
+
+def registry_restore_targets(abstract_state, registry, mesh):
+  """Abstract restore targets whose placements the sharding REGISTRY
+  resolves (parallel/sharding.py) — not copied from any live state.
+
+  This is the primitive under cross-topology resharding (ROADMAP
+  item 3): restore_latest pins each leaf to its target's sharding, so
+  feeding it targets resolved by the registry FOR THE NEW MESH makes
+  Orbax reshard a checkpoint saved under any topology into exactly the
+  placements the current rules declare. The save-side half is the
+  SHARDING_{step}.json manifest (`Checkpointer._record_sharding_
+  manifest`), which records what the bytes on disk were laid out as.
+  """
+  shardings = registry.state_shardings(abstract_state, mesh)
+  return jax.tree_util.tree_map(
+      lambda leaf, sh: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                            sharding=sh),
+      abstract_state, shardings)

@@ -84,6 +84,13 @@ class Config:
   # tests/test_parallel.py and the tp4 multihost child).
   # 'sharded' | 'gathered' force either path.
   tp_compute: str = 'auto'
+  # Which partition-rule set the sharding registry resolves from
+  # (round 19, parallel/sharding.py — the ONE source of sharding
+  # truth). 'auto' = 'megatron' when model_parallelism > 1 (TP cuts on
+  # Dense/LSTM/Conv output features), 'replicated' (pure DP) otherwise
+  # — i.e. defaults are unchanged. Naming a set explicitly pins it
+  # regardless of the mesh shape.
+  sharding_rules: str = 'auto'
   torso: str = 'deep'                     # deep | deep_fast | shallow
   scan_unroll: int = 10                   # LSTM time-scan unroll factor
                                           # (v5e sweep at T=100, B=32:
@@ -1026,6 +1033,24 @@ def validate_distributed(config: Config,
   if config.tp_compute not in ('auto', 'sharded', 'gathered'):
     raise ValueError(f'tp_compute must be auto|sharded|gathered, got '
                      f'{config.tp_compute!r}')
+  # Registry rule-set name (round 19): resolved against the same table
+  # every consumer queries, so a typo dies here instead of as a
+  # mysterious replicated run.
+  from scalable_agent_tpu.parallel import sharding as _sharding_lib
+  if (config.sharding_rules != 'auto'
+      and config.sharding_rules not in _sharding_lib.RULE_SETS):
+    raise ValueError(
+        f'sharding_rules must be auto|'
+        f'{"|".join(sorted(_sharding_lib.RULE_SETS))}, got '
+        f'{config.sharding_rules!r}')
+  if (config.sharding_rules == 'replicated'
+      and config.model_parallelism > 1):
+    warnings.append(
+        'sharding_rules=replicated with model_parallelism=%d: the '
+        'model axis exists but no rule cuts over it — every param '
+        'replicates across it (TP memory win forfeited); use '
+        'sharding_rules=auto or =megatron to shard'
+        % config.model_parallelism)
   if config.coordinator_address:
     host, sep, port = config.coordinator_address.rpartition(':')
     if not sep or not host or not port.isdigit():

@@ -56,6 +56,7 @@ from scalable_agent_tpu.config import (Config, validate_controller,
 from scalable_agent_tpu.envs import factory, suites
 from scalable_agent_tpu.models import ImpalaAgent, init_params
 from scalable_agent_tpu.parallel import mesh as mesh_lib
+from scalable_agent_tpu.parallel import sharding as sharding_lib
 from scalable_agent_tpu.parallel import train_parallel
 from scalable_agent_tpu.runtime import faults as faults_lib
 from scalable_agent_tpu.runtime import inference as inference_lib
@@ -220,9 +221,9 @@ def choose_mesh(config: Config):
         f'model_parallelism={mp} does not divide the device count '
         f'{len(devices)}')
   # Multi-host TP shards the batch over BOTH mesh axes (see
-  # mesh.batch_shardings), so the batch must divide the full device
-  # count there; otherwise only the data width.
-  if mesh_lib.shard_batch_over_model(config):
+  # sharding.batch_shardings), so the batch must divide the full
+  # device count there; otherwise only the data width.
+  if sharding_lib.shard_batch_over_model(config):
     batch_width = len(devices)
   else:
     batch_width = len(devices) // mp
@@ -410,6 +411,11 @@ def train(config: Config, max_steps: Optional[int] = None,
   # (vtrace.py / ops/vtrace_pallas.sharded_from_importance_weights;
   # parity-gated on the 8-virtual-device mesh in tests/test_parallel).
   mesh = choose_mesh(config)
+  # The ONE registry instance every sharding consumer of this run
+  # queries (round 19, parallel/sharding.py): state placement, the
+  # checkpoint manifest, and the publisher predicate all resolve from
+  # the same declared rule set — private copies are a lint violation.
+  registry = sharding_lib.from_config(config)
   if mesh is not None:
     from scalable_agent_tpu.testing import make_example_batch
     from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
@@ -419,7 +425,7 @@ def train(config: Config, max_steps: Optional[int] = None,
         MAX_INSTRUCTION_LEN)
     state = train_parallel.make_sharded_train_state(
         params, config, mesh, enable_tp=config.model_parallelism > 1,
-        num_popart_tasks=num_popart_tasks)
+        num_popart_tasks=num_popart_tasks, registry=registry)
     train_step, place_fn = train_parallel.make_sharded_train_step(
         agent, config, mesh, example_batch)
   else:
@@ -438,7 +444,8 @@ def train(config: Config, max_steps: Optional[int] = None,
   checkpointer = checkpoint_lib.Checkpointer(
       config.logdir + '/checkpoints',
       save_interval_secs=config.checkpoint_secs,
-      verify_digests=config.ckpt_digests)
+      verify_digests=config.ckpt_digests,
+      registry=registry, mesh=mesh)
   try:
     restored = checkpointer.restore_latest(state)
   except BaseException:
@@ -507,9 +514,11 @@ def train(config: Config, max_steps: Optional[int] = None,
   # (measured: device_get never returns). Actors must run on a FULL
   # host-local copy instead. process_allgather is itself a
   # collective, so every call site must be on the lockstep path
-  # (same step, every host) — which publish_params_every is.
-  localize_actor_params = (mesh is not None and
-                           mesh_lib.shard_batch_over_model(config))
+  # (same step, every host) — which publish_params_every is. The
+  # predicate is the registry's (round 19): the publisher codec asks
+  # the same sharding authority as the learner.
+  localize_actor_params = sharding_lib.needs_host_local_params(
+      config, mesh)
 
   def actor_params(params):
     if localize_actor_params:
@@ -2143,7 +2152,8 @@ def train_anakin(config: Config, max_steps: Optional[int] = None,
   checkpointer = checkpoint_lib.Checkpointer(
       config.logdir + '/checkpoints',
       save_interval_secs=config.checkpoint_secs,
-      verify_digests=config.ckpt_digests)
+      verify_digests=config.ckpt_digests,
+      registry=sharding_lib.from_config(config), mesh=mesh)
   restore_ok = False
   try:
     restored = checkpointer.restore_latest(carry.train_state)

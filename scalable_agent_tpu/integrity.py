@@ -125,6 +125,20 @@ def digest_record(value: int) -> Dict:
   return {'crc': int(value), 'algo': CRC_ALGO}
 
 
+def spec_table_digest(specs: Dict[str, str]) -> int:
+  """Content CRC of a sharding-spec manifest ({param_path: spec
+  string}, parallel/sharding.ShardingRegistry.describe) in sorted-path
+  order. The checkpoint plane records it next to each save
+  (SHARDING_{step}.json) so a restore onto a different topology or a
+  drifted rule set is DETECTED — a spec change is a layout change even
+  when every array byte is identical, which the file digests above
+  cannot see."""
+  crc = Crc()
+  for path in sorted(specs):
+    crc.update(f'{path}={specs[path]};'.encode())
+  return crc.value
+
+
 def verify_record(record, value: int) -> Optional[bool]:
   """Compare `value` against a `digest_record`. None = not comparable
   (missing/malformed record or foreign algorithm — the caller should

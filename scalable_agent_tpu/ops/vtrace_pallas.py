@@ -51,7 +51,6 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.experimental.shard_map import shard_map
-from jax.sharding import PartitionSpec as P
 
 LANE = 128  # TPU lane width: batch block size
 
@@ -198,9 +197,10 @@ def sharded_from_importance_weights(mesh, log_rhos, discounts, rewards,
   by construction (pure per-shard math), but shard_map's replication
   checker cannot see through `pallas_call` to prove it.
   """
+  from scalable_agent_tpu.parallel import sharding as sharding_lib
   ndim = jnp.ndim(log_rhos)
-  spec_t = P(*((None, batch_axis) + (None,) * (ndim - 2)))
-  spec_b = P(*((batch_axis,) + (None,) * (ndim - 2)))
+  spec_t = sharding_lib.spec_time_major(ndim, axis=batch_axis)
+  spec_b = sharding_lib.spec_batch_lead(ndim - 1, axis=batch_axis)
   fn = functools.partial(
       from_importance_weights,
       clip_rho_threshold=clip_rho_threshold,

@@ -312,10 +312,9 @@ def init_env_carry(agent, env_core, config: Config, rng,
     return EnvCarry(env_state, env_output, agent_output, core_state,
                     rng)
 
-  from jax.sharding import NamedSharding, PartitionSpec as P
-  from scalable_agent_tpu.parallel import mesh as mesh_lib
-  data = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
-  replicated = NamedSharding(mesh, P())
+  from scalable_agent_tpu.parallel import sharding as sharding_lib
+  data = sharding_lib.data_sharding(mesh)
+  replicated = sharding_lib.replicated(mesh)
 
   def place(x):
     x = jnp.asarray(x)

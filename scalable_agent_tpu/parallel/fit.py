@@ -70,8 +70,8 @@ def aot_memory_fit(devices: Optional[Sequence[Any]] = None,
   from scalable_agent_tpu.models import ImpalaAgent, init_params
   from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
   from scalable_agent_tpu.parallel import mesh as mesh_lib
+  from scalable_agent_tpu.parallel import sharding as sharding_lib
   from scalable_agent_tpu.testing import make_example_batch
-  from jax.sharding import NamedSharding, PartitionSpec as P
 
   devices = list(devices) if devices is not None else jax.devices()
   n = len(devices)
@@ -100,9 +100,12 @@ def aot_memory_fit(devices: Optional[Sequence[Any]] = None,
                                  height, width, 9,
                                  MAX_INSTRUCTION_LEN))
 
-  batch_shard = mesh_lib.batch_shardings(batch, mesh)
-  replicated = NamedSharding(mesh, P())
-  state_sh = jax.tree_util.tree_map(lambda _: replicated, state_abs)
+  # Pure-DP registry (round 19): params/state replicated, batch over
+  # the data axis — the single sharding authority, not a private copy.
+  registry = sharding_lib.ShardingRegistry(
+      sharding_lib.RULE_SETS['replicated'], rule_set='replicated')
+  batch_shard = registry.batch_shardings(batch, mesh)
+  state_sh = registry.state_shardings(state_abs, mesh)
   # mesh rides in so a pallas-vtrace config lowers under shard_map
   # instead of failing the AOT fit (round 8 — the mesh restriction is
   # lifted everywhere, this path included).

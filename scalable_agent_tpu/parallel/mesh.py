@@ -1,4 +1,4 @@
-"""Device mesh construction and sharding rules.
+"""Device mesh construction (+ delegating sharding wrappers).
 
 The reference scales out with TF1 gRPC: variables pinned to the learner,
 actors enqueueing to a learner-hosted FIFOQueue (reference: experiment.py
@@ -10,8 +10,8 @@ that with an explicit `jax.sharding.Mesh` and XLA collectives:
   `jit` — this is the BASELINE.json north star (multi-learner sync
   without parameter servers).
 - **model axis (TP)**: wide Dense/LSTM kernels can shard their output
-  dim; at IMPALA's model sizes this is optional headroom, wired here so
-  the mechanism is real and tested (SURVEY §2.b).
+  dim; at IMPALA's model sizes this is optional headroom, wired so the
+  mechanism is real and tested (SURVEY §2.b).
 - **Pipeline / expert parallelism**: not applicable to this model family
   (no layer pipeline depth worth cutting, no MoE — SURVEY §2.b marks
   both "explicitly absent" in the reference too).
@@ -23,29 +23,27 @@ that with an explicit `jax.sharding.Mesh` and XLA collectives:
 Multi-host: `jax.distributed.initialize()` + the same mesh spanning all
 processes; trajectory transport stays host-local per learner shard while
 gradients ride ICI/DCN via the same psum.
-"""
 
-import re
+Round 19: the partition-rule table and every sharding decision moved to
+`parallel/sharding.py` (the declarative registry — ONE source of
+sharding truth). This module keeps mesh construction plus thin
+delegating wrappers so existing `mesh_lib.param_shardings(...)` callers
+keep working; the wrappers resolve through the registry, never
+privately.
+"""
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
-DATA_AXIS = 'data'
-MODEL_AXIS = 'model'
+from scalable_agent_tpu.parallel import sharding as sharding_lib
 
+# Canonical axis names live in the registry; re-exported for callers.
+DATA_AXIS = sharding_lib.DATA_AXIS
+MODEL_AXIS = sharding_lib.MODEL_AXIS
 
-def shard_batch_over_model(config) -> bool:
-  """Whether the learner batch must shard over the model axis too.
-
-  True exactly when TP spans hosts: trajectory transport is host-local
-  (each process supplies only its own fleet's rows), so model-axis
-  batch replication would demand bit-identical batches from different
-  hosts. The ONE predicate both the batch-divisibility check
-  (driver.choose_mesh) and the actual sharding choice
-  (train_parallel.make_sharded_train_step) consult — they must never
-  drift."""
-  return config.model_parallelism > 1 and jax.process_count() > 1
+# Re-exported predicate (single authority: parallel/sharding.py).
+shard_batch_over_model = sharding_lib.shard_batch_over_model
 
 
 def make_mesh(devices=None, model_parallelism: int = 1) -> Mesh:
@@ -61,95 +59,18 @@ def make_mesh(devices=None, model_parallelism: int = 1) -> Mesh:
   return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 
 
-# Parameter sharding rules: regex on the flattened param path → spec.
-# The bulk of the params shard their OUTPUT-feature dim over the model
-# axis:
-# - anonymous Dense kernels (torso projections),
-# - every OptimizedLSTMCell gate kernel (i{i,f,g,o} input-to-gate and
-#   h{i,f,g,o} hidden-to-gate) — the recurrent carry then propagates
-#   model-sharded through the time scan, the Megatron-style LSTM cut,
-# - Conv kernels ([kh, kw, in, out]) on their out-channel dim.
-# The named heads (policy_logits, baseline) stay replicated — they are
-# tiny and their outputs feed cross-replica math. Leaves whose sharded
-# dim does not divide the model width drop to replicated
-# (param_shardings guard). At IMPALA scale TP is headroom, not a
-# necessity; the mechanism is real and tested (tests/test_parallel.py
-# asserts both the placements and TP-vs-single-device numerics).
-_PARAM_RULES = (
-    (re.compile(r'.*Dense_\d+/kernel$'), P(None, MODEL_AXIS)),
-    (re.compile(r'.*Dense_\d+/bias$'), P(MODEL_AXIS)),
-    (re.compile(r'.*OptimizedLSTMCell_\d+/[ih][ifgo]/kernel$'),
-     P(None, MODEL_AXIS)),
-    (re.compile(r'.*OptimizedLSTMCell_\d+/[ih][ifgo]/bias$'),
-     P(MODEL_AXIS)),
-    (re.compile(r'.*Conv_\d+/kernel$'), P(None, None, None, MODEL_AXIS)),
-    (re.compile(r'.*Conv_\d+/bias$'), P(MODEL_AXIS)),
-)
-
-
-def param_spec(path: str, enable_tp: bool) -> P:
-  if enable_tp:
-    for pattern, spec in _PARAM_RULES:
-      if pattern.match(path):
-        return spec
-  return P()
-
-
 def param_shardings(params, mesh: Mesh, enable_tp: bool = False):
-  """NamedShardings for a param pytree (TP on Dense kernels if asked)."""
-
-  def path_str(kp):
-    return '/'.join(str(getattr(k, 'key', getattr(k, 'idx', k)))
-                    for k in kp)
-
-  def to_sharding(kp, leaf):
-    spec = param_spec(path_str(kp), enable_tp)
-    # Drop axes that don't divide the leaf (e.g. odd feature sizes).
-    if any(s is not None for s in spec):
-      for dim, name in enumerate(spec):
-        if name is not None and (dim >= leaf.ndim or
-                                 leaf.shape[dim] %
-                                 mesh.shape[MODEL_AXIS] != 0):
-          return NamedSharding(mesh, P())
-    return NamedSharding(mesh, spec)
-
-  return jax.tree_util.tree_map_with_path(to_sharding, params)
+  """NamedShardings for a param pytree — resolved via the registry."""
+  registry = sharding_lib.ShardingRegistry(
+      sharding_lib.RULE_SETS['megatron' if enable_tp else 'replicated'],
+      rule_set='megatron' if enable_tp else 'replicated')
+  return registry.param_shardings(params, mesh)
 
 
 def batch_shardings(batch_pytree, mesh: Mesh,
                     shard_over_model: bool = False):
-  """Shard the learner batch over the data axis.
-
-  Trajectory tensors are time-major [T+1, B, ...] → shard dim 1;
-  level_name/agent_state are [B, ...] → shard dim 0. We key on rank
-  via the structural position: ActorOutput(level_name, agent_state,
-  env_outputs, agent_outputs).
-
-  shard_over_model: shard the batch dim over BOTH axes instead of
-  replicating it across the model axis. Required when TP spans hosts:
-  trajectory transport is host-local (each process supplies only its
-  own fleet's rows to `make_array_from_process_local_data`), and
-  model-axis replication would demand bit-identical batches from
-  different hosts. With the batch fully sharded, every host feeds
-  distinct rows and GSPMD inserts the model-axis all-gather where the
-  TP matmuls need the full data shard — the collective rides
-  ICI/DCN, placed by the compiler (SURVEY §5.8)."""
-  from scalable_agent_tpu.structs import ActorOutput
-
-  batch_axes = ((DATA_AXIS, MODEL_AXIS) if shard_over_model
-                else DATA_AXIS)
-
-  def traj(x):
-    return NamedSharding(mesh, P(None, batch_axes))
-
-  def lead(x):
-    return NamedSharding(mesh, P(batch_axes))
-
-  return ActorOutput(
-      level_name=lead(None),
-      agent_state=jax.tree_util.tree_map(
-          lambda _: lead(None), batch_pytree.agent_state),
-      env_outputs=jax.tree_util.tree_map(
-          lambda _: traj(None), batch_pytree.env_outputs),
-      agent_outputs=jax.tree_util.tree_map(
-          lambda _: traj(None), batch_pytree.agent_outputs))
+  """Learner-batch NamedShardings — resolved via the registry."""
+  registry = sharding_lib.ShardingRegistry(
+      sharding_lib.RULE_SETS['replicated'], rule_set='replicated')
+  return registry.batch_shardings(batch_pytree, mesh,
+                                  shard_over_model=shard_over_model)

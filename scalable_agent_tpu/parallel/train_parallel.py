@@ -14,43 +14,41 @@ import logging
 import numpy as np
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from scalable_agent_tpu import learner as learner_lib
 from scalable_agent_tpu.config import Config
-from scalable_agent_tpu.parallel import mesh as mesh_lib
+from scalable_agent_tpu.parallel import sharding as sharding_lib
 
 log = logging.getLogger('scalable_agent_tpu')
 
 
 def make_sharded_train_state(params, config: Config, mesh: Mesh,
                              enable_tp: bool = False,
-                             num_popart_tasks: int = 0):
-  """Place params on the mesh (replicated, or TP-sharded kernels) and
-  build the TrainState there. Optimizer moment trees inherit the param
-  placements (eager zeros_like follows its input's sharding); scalar
-  leaves (step/opt counters, PopArt stats) are explicitly replicated —
-  a single-device committed scalar next to mesh-committed params is a
-  mixed-placement error under jit (bites after checkpoint restore)."""
-  p_shard = mesh_lib.param_shardings(params, mesh, enable_tp)
+                             num_popart_tasks: int = 0,
+                             registry=None):
+  """Place params on the mesh and build the TrainState there, every
+  placement resolved by the sharding registry (round 19): params by the
+  partition rules, optimizer moments cloned leaf-wise from the matched
+  param specs, `target_params` pinned identically (the IMPACT anchor's
+  in-graph refresh is a leafwise select — mixed placements would force
+  a resharding copy every step), and every remaining leaf (step/opt
+  counters, PopArt stats) explicitly replicated — a single-device
+  committed scalar next to mesh-committed params is a mixed-placement
+  error under jit (bites after checkpoint restore).
+
+  Params are placed BEFORE the optimizer state is built so the eager
+  zeros_like moments materialize already-sharded (never an unsharded
+  full copy in HBM); the final registry-wide device_put is then a
+  no-op confirmation for them."""
+  if registry is None:
+    registry = sharding_lib.from_config(
+        config, enable_tp=enable_tp or config.model_parallelism > 1)
+  p_shard = registry.param_shardings(params, mesh)
   params = jax.tree_util.tree_map(jax.device_put, params, p_shard)
   state = learner_lib.make_train_state(params, config, num_popart_tasks)
-  if state.target_params is not None:
-    # The IMPACT anchor shards EXACTLY like the params (the in-graph
-    # refresh is a leafwise select between the two trees, so mixed
-    # placements would force a resharding copy every step).
-    state = state._replace(target_params=jax.tree_util.tree_map(
-        jax.device_put, state.target_params, p_shard))
-  replicated = NamedSharding(mesh, P())
-  mesh_devices = set(mesh.devices.flat)
-
-  def ensure_on_mesh(x):
-    if (isinstance(x, jax.Array) and
-        x.sharding.device_set == mesh_devices):
-      return x
-    return jax.device_put(x, replicated)
-
-  return jax.tree_util.tree_map(ensure_on_mesh, state)
+  shardings = registry.state_shardings(state, mesh)
+  return jax.tree_util.tree_map(jax.device_put, state, shardings)
 
 
 def resolve_tp_compute(config) -> str:
@@ -104,10 +102,11 @@ def make_sharded_train_step(agent, config: Config, mesh: Mesh,
   ('sharded'); config.tp_compute overrides either way.
   """
   train_step = learner_lib.make_train_step_fn(agent, config, mesh=mesh)
-  batch_shard = mesh_lib.batch_shardings(
+  registry = sharding_lib.from_config(config)
+  batch_shard = registry.batch_shardings(
       example_batch, mesh,
-      shard_over_model=mesh_lib.shard_batch_over_model(config))
-  replicated = NamedSharding(mesh, P())
+      shard_over_model=sharding_lib.shard_batch_over_model(config))
+  replicated = sharding_lib.replicated(mesh)
   # None = decide on the first call from the LIVE state: TP can arrive
   # via config.model_parallelism or via a make_sharded_train_state
   # caller passing enable_tp out-of-band (tests do) — any model-
@@ -149,7 +148,7 @@ def make_sharded_train_step(agent, config: Config, mesh: Mesh,
     nonlocal gathered_tp
     if gathered_tp is None:
       gathered_tp = (resolve_tp_compute(config) == 'gathered' and any(
-          mesh_lib.MODEL_AXIS in str(getattr(x.sharding, 'spec', ''))
+          sharding_lib.MODEL_AXIS in str(getattr(x.sharding, 'spec', ''))
           for x in jax.tree_util.tree_leaves(state)
           if isinstance(x, jax.Array)))
       step.tp_gathered = gathered_tp
@@ -220,9 +219,12 @@ def supports_sdc_check(config, mesh) -> bool:
   cross-check; the driver then leaves the sentinel off."""
   if mesh is None:
     return False
-  if config.model_parallelism != 1:
+  # "Are params logically replicated?" is a registry question now
+  # (round 19): any model-axis rule means each device legitimately
+  # holds a different shard — nothing to cross-compare.
+  if sharding_lib.from_config(config).model_sharded:
     return False
-  if mesh_lib.shard_batch_over_model(config):
+  if sharding_lib.shard_batch_over_model(config):
     return False
   # Multi-process meshes need the in-graph all-gather (round 17): a
   # raw readback device_gets a P('data')-sharded array, which jax
@@ -235,7 +237,7 @@ def supports_sdc_check(config, mesh) -> bool:
          for d in mesh.devices.flat):
     if not getattr(config, 'sdc_allgather', True):
       return False
-  return mesh.shape[mesh_lib.DATA_AXIS] >= 2
+  return mesh.shape[sharding_lib.DATA_AXIS] >= 2
 
 
 def make_sdc_fingerprint_fn(mesh: Mesh):
@@ -277,8 +279,8 @@ def make_sdc_fingerprint_fn(mesh: Mesh):
   itself."""
   from jax.experimental.shard_map import shard_map
 
-  num_replicas = int(mesh.shape[mesh_lib.DATA_AXIS])
-  probe_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+  num_replicas = int(mesh.shape[sharding_lib.DATA_AXIS])
+  probe_sharding = sharding_lib.data_sharding(mesh)
 
   def per_replica(params, probe):
     fp = learner_lib.param_fingerprint(params)
@@ -286,13 +288,14 @@ def make_sdc_fingerprint_fn(mesh: Mesh):
     # corrupted replica's entry differs identically in every copy of
     # the gathered vector, so any host's local read sees it.
     return jax.lax.all_gather(
-        (fp + probe.reshape(())).reshape(()), mesh_lib.DATA_AXIS,
+        (fp + probe.reshape(())).reshape(()), sharding_lib.DATA_AXIS,
         tiled=False)
 
   sharded = jax.jit(shard_map(
       per_replica, mesh=mesh,
-      in_specs=(P(), P(mesh_lib.DATA_AXIS)),
-      out_specs=P(), check_rep=False))
+      in_specs=(sharding_lib.spec_replicated(),
+                sharding_lib.spec_data()),
+      out_specs=sharding_lib.spec_replicated(), check_rep=False))
 
   def fingerprint_fn(params, probe_host=None):
     if probe_host is None:
@@ -317,9 +320,9 @@ def supports_unroll_staging(config, mesh) -> bool:
   supports it."""
   if mesh is None:
     return True
-  if mesh_lib.shard_batch_over_model(config):
+  if sharding_lib.shard_batch_over_model(config):
     return False
-  if mesh.shape[mesh_lib.MODEL_AXIS] != 1:
+  if mesh.shape[sharding_lib.MODEL_AXIS] != 1:
     return False
   local = [d for d in mesh.devices.flat
            if d.process_index == jax.process_index()]
@@ -363,11 +366,11 @@ def make_unroll_assembly(config, mesh, example_batch):
   if not supports_unroll_staging(config, mesh):
     raise ValueError('unroll staging unsupported on this topology '
                      '(see supports_unroll_staging)')
-  batch_shard = mesh_lib.batch_shardings(example_batch, mesh,
-                                         shard_over_model=False)
+  batch_shard = sharding_lib.from_config(config).batch_shardings(
+      example_batch, mesh, shard_over_model=False)
   local_devices = [d for d in mesh.devices.flat
                    if d.process_index == jax.process_index()]
-  data_width = mesh.shape[mesh_lib.DATA_AXIS]
+  data_width = mesh.shape[sharding_lib.DATA_AXIS]
   local_batch = config.batch_size // jax.process_count()
   slot_devices = unroll_slot_owners(local_devices, local_batch)
 

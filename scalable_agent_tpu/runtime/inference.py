@@ -291,12 +291,13 @@ class InferenceServer:
     self._admission_timeout = float(
         getattr(config, 'inference_admission_timeout_secs', 10.0))
     if mesh is not None:
-      from jax.sharding import NamedSharding, PartitionSpec
-      from scalable_agent_tpu.parallel import mesh as mesh_lib
-      self._dp = int(mesh.shape[mesh_lib.DATA_AXIS])
-      self._replicated = NamedSharding(mesh, PartitionSpec())
-      self._batch_sharding = NamedSharding(
-          mesh, PartitionSpec(mesh_lib.DATA_AXIS))
+      # Arena placements come from the sharding registry's primitive
+      # helpers (round 19): params replicated over the acting mesh,
+      # batch rows over the data axis — no private layout choice here.
+      from scalable_agent_tpu.parallel import sharding as sharding_lib
+      self._dp = int(mesh.shape[sharding_lib.DATA_AXIS])
+      self._replicated = sharding_lib.replicated(mesh)
+      self._batch_sharding = sharding_lib.data_sharding(mesh)
       params = jax.device_put(params, self._replicated)
     else:
       self._dp = 1

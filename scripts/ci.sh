@@ -193,15 +193,16 @@ echo '   feeding process-local shards, the cross-process gradient'
 echo '   psum, broadcast-gated collective checkpoints + the SIGKILL'
 echo '   drill, the SDC all-gather rollback drill, cross-host trace'
 echo '   joins, and the BENCH_ONLY=multihost scaling row; the'
-echo '   validate_distributed/slot-placement unit half runs first —'
-echo '   <240 s CPU) =='
+echo '   validate_distributed/slot-placement unit half runs first.'
+echo '   Round 19: the heavy drills (mixed topology, kill drills,'
+echo '   cross-process TP) are slow-marked OUT of tier-1 and run HERE'
+echo '   — the whole file, no -m filter — <600 s CPU) =='
 JAX_PLATFORMS=cpu python -m pytest tests/test_multihost_unit.py -q \
   -p no:cacheprovider
 # Children strip JAX_PLATFORMS/XLA_FLAGS themselves and force their
 # own per-process virtual-device topology.
 python -m pytest \
-  tests/test_multihost.py::test_two_process_training \
-  tests/test_multihost.py::test_kill_one_host_then_resume \
+  tests/test_multihost.py \
   tests/test_multihost_extra.py \
   -q -p no:cacheprovider
 BENCH_SMOKE=1 BENCH_ONLY=multihost python bench.py
@@ -270,5 +271,19 @@ SMOKE=1 python scripts/conv_levers.py
 
 echo '== pallas fused conv+pool smoke (interpret-mode parity) =='
 SMOKE=1 python scripts/pallas_conv_pool.py
+
+echo '== sharding lane (round 19: the declarative registry as the one'
+echo '   source of sharding truth — rule/guard/opt-clone semantics,'
+echo '   the consumers-agree contract, the checkpoint manifest +'
+echo '   cross-mesh resharded restore, and the 2D {data,model} deep-'
+echo '   agent parity gate; then the DP vs DP+TP per-device bytes'
+echo '   rows via BENCH_ONLY=mesh2d and the sharding-registry lint'
+echo '   (no inline PartitionSpec outside parallel/sharding.py)'
+echo '   — <2 min CPU) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_sharding.py -q \
+  -p no:cacheprovider
+XLA_FLAGS='--xla_force_host_platform_device_count=8' \
+  BENCH_SMOKE=1 BENCH_ONLY=mesh2d python bench.py
+python scripts/lint.py --check sharding-registry
 
 echo 'CI OK'

@@ -403,10 +403,14 @@ def _runtime_config(tmp_path, **kw):
   return Config(**base)
 
 
+@pytest.mark.slow
 def test_runtime_anakin_full_lifecycle(tmp_path):
   """--runtime=anakin through driver.train: the fused loop runs as a
   PRODUCTION run — checkpoint restore, green SLO verdict, summaries +
-  incidents JSONL, registry gauges unwound at exit."""
+  incidents JSONL, registry gauges unwound at exit.
+
+  Slow-marked (the heaviest anakin drill, ~20 s): the ci.sh anakin
+  lane runs the whole file unfiltered, so CI still exercises it."""
   from scalable_agent_tpu import driver, slo, telemetry
   cfg = _runtime_config(tmp_path)
   run = driver.train(cfg)  # dispatches on config.runtime

@@ -227,6 +227,29 @@ def test_ci_wiring_fires(tmp_path):
   assert symbols == {'lint-call', 'inline-heredoc'}
 
 
+def test_sharding_registry_fires(tmp_path):
+  root = mini_repo(tmp_path, {
+      # Every spelling the checker must see: a from-import alias, the
+      # bare name, and the fully-qualified attribute call.
+      'scalable_agent_tpu/rogue.py':
+          "from jax.sharding import PartitionSpec as P\n"
+          "def place():\n"
+          "  return P(None, 'model')\n",
+      'scalable_agent_tpu/rogue2.py':
+          "import jax.sharding\n"
+          "spec = jax.sharding.PartitionSpec('data')\n",
+      # The registry itself is exempt.
+      'scalable_agent_tpu/parallel/sharding.py':
+          "from jax.sharding import PartitionSpec as P\n"
+          "HOME = P('data')\n",
+  })
+  findings = run_only(root, 'sharding-registry')
+  symbols = {f.symbol for f in findings}
+  assert symbols == {'scalable_agent_tpu/rogue.py:place',
+                     'scalable_agent_tpu/rogue2.py:<module>'}
+  assert all('registry' in f.message for f in findings)
+
+
 def test_stale_allowlist_entry_is_a_finding(tmp_path, monkeypatch):
   root = mini_repo(tmp_path, {
       'scripts/ci.sh': "python scripts/lint.py\n",
