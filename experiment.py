@@ -495,6 +495,12 @@ flags.DEFINE_float('fleet_probation_secs',
                    'Quarantine probation cool-down before a '
                    'rehabilitation attempt (fleet slots and the '
                    "remote client's CRC self-quarantine).")
+flags.DEFINE_integer('pod_max_hosts', _DEFAULTS.pod_max_hosts,
+                     'Upper bound for the pod_size actuator (elastic '
+                     'pod membership): the controller publishes the '
+                     'desired actor-host count to POD_TARGET.json '
+                     'for the cluster supervisor to reconcile. '
+                     '0 = actuator off.')
 flags.DEFINE_bool('lock_order_check', _DEFAULTS.lock_order_check,
                   'Arm runtime lock-order detection for this run: '
                   'the threaded modules\' locks record the '

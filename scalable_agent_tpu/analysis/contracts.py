@@ -649,16 +649,19 @@ def check_ci_wiring(ctx: CheckContext) -> List[Finding]:
 
 
 @checker('sharding-registry',
-         'no inline PartitionSpec(...) construction outside '
-         'parallel/sharding.py — every sharding decision resolves '
-         'through the registry')
+         'no inline PartitionSpec(...)/NamedSharding(...) '
+         'construction outside parallel/sharding.py — every sharding '
+         'decision resolves through the registry')
 def check_sharding_registry(ctx: CheckContext) -> List[Finding]:
   """parallel/sharding.py is the ONE source of sharding truth: a
-  `PartitionSpec(...)` constructed anywhere else in the package (or
+  `PartitionSpec(...)` — or, round 20, a `NamedSharding(...)` binding
+  a spec to a mesh — constructed anywhere else in the package (or
   its entry points) is a private sharding decision the registry
   cannot see — exactly the hand-copied-consumer drift this round
-  deleted. Tests are deliberately out of scope (they construct
-  expected specs to assert the registry against)."""
+  deleted, and exactly what the elastic cross-topology restore would
+  silently miss when respecifying for a new mesh. Tests are
+  deliberately out of scope (they construct expected specs to assert
+  the registry against)."""
   sources = ctx.package_sources()
   for extra in ('experiment.py', 'bench.py'):
     try:
@@ -683,7 +686,7 @@ def check_sharding_registry(ctx: CheckContext) -> List[Finding]:
           node.module == 'jax.sharding'
           or node.module.endswith('.sharding')):
         for a in node.names:
-          if a.name == 'PartitionSpec':
+          if a.name in ('PartitionSpec', 'NamedSharding'):
             aliases.add(a.asname or a.name)
     func_of: Dict[int, str] = {}
     for node in ast.walk(tree):
@@ -695,17 +698,20 @@ def check_sharding_registry(ctx: CheckContext) -> List[Finding]:
       if not isinstance(node, ast.Call):
         continue
       inline = (
-          # P(...) / PartitionSpec(...) via a from-import alias
+          # P(...) / PartitionSpec(...) / NamedSharding(...) via a
+          # from-import alias
           (isinstance(node.func, ast.Name) and node.func.id in aliases)
-          # ...or any attribute spelling: jax.sharding.PartitionSpec(...)
+          # ...or any attribute spelling:
+          # jax.sharding.PartitionSpec(...) / .NamedSharding(...)
           or (isinstance(node.func, ast.Attribute)
-              and node.func.attr == 'PartitionSpec'))
+              and node.func.attr in ('PartitionSpec',
+                                     'NamedSharding')))
       if inline:
         where = func_of.get(node.lineno, '<module>')
         findings.append(Finding(
             'sharding-registry', rel, node.lineno,
             f'{rel}:{where}',
-            'inline PartitionSpec construction outside '
+            'inline PartitionSpec/NamedSharding construction outside '
             'parallel/sharding.py — resolve the spec through the '
             'sharding registry (spec helpers or ShardingRegistry '
             'methods) so every consumer sees the same decision'))

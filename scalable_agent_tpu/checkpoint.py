@@ -681,13 +681,42 @@ class Checkpointer:
   def wait_until_finished(self):
     self._manager.wait_until_finished()
 
-  def restore_resharded(self, abstract_state, registry, mesh):
+  def saved_mesh_shape(self) -> Optional[Dict[str, int]]:
+    """The mesh shape dict the NEWEST retained step's sharding
+    manifest recorded, or None (no steps / no manifest / pre-manifest
+    writer). The driver's elastic-restore gate compares this against
+    the live mesh to decide whether a restore is cross-topology."""
+    steps = self._manager.all_steps()
+    if not steps:
+      return None
+    manifest = self.read_sharding_manifest(max(steps))
+    if not manifest or not isinstance(manifest.get('mesh'), dict):
+      return None
+    return {str(k): int(v) for k, v in manifest['mesh'].items()}
+
+  def restore_resharded(self, abstract_state, registry, mesh,
+                        strict: bool = True):
     """Restore the latest restorable step directly onto REGISTRY-
     resolved placements for `mesh` — the cross-topology resharding
     path (ROADMAP item 3): a checkpoint saved on any topology restores
     here with Orbax moving each leaf's bytes into the specs this
     registry resolves for THIS mesh, no concrete donor state needed.
-    `abstract_state` is the eval_shape of the target TrainState."""
+    `abstract_state` is the eval_shape of the target TrainState.
+
+    strict (the default, round 20): refuse with `ShardingLayoutError`
+    when the registry resolves a cut this mesh cannot honor for a leaf
+    the save had NOT already recorded as replicated (the manifest's
+    spec table is the exemption list) — a topology change must never
+    silently rewrite a layout the checkpoint still holds. strict=False
+    accepts the divisibility guard's replicated degradation, exactly
+    like a fresh spin-up on the new mesh."""
+    if strict:
+      steps = self._manager.all_steps()
+      manifest = (self.read_sharding_manifest(max(steps))
+                  if steps else None)
+      saved = manifest.get('specs') if manifest else None
+      registry.check_layout(abstract_state.params, mesh, what='param',
+                            saved_specs=saved)
     return self.restore_latest(
         registry_restore_targets(abstract_state, registry, mesh))
 

@@ -496,6 +496,16 @@ class Config:
   # re-quarantine on repeat failure. The controller's grow-fleet move
   # reclaims slots through this ladder (slots_rehabilitated).
   fleet_probation_secs: float = 30.0
+  # Elastic pod membership (round 20): upper bound for the pod_size
+  # actuator — the pod-level analogue of fleet_size. The learner does
+  # not SPAWN hosts; the actuator publishes the desired host count to
+  # <logdir>/POD_TARGET.json (atomic replace) and the cluster
+  # supervisor (chaos.py's elastic storm in tests; an operator's
+  # orchestration in production) reconciles actual hosts toward it.
+  # 0 (default) = actuator not registered; membership accounting
+  # (host_joined/host_left incidents, driver/remote_live_hosts) is
+  # independent of this knob and always on for v9 peers.
+  pod_max_hosts: int = 0
   # --- Runtime axis (round 16; docs/PARALLELISM.md, RUNBOOK §13).
   # 'fleet' is the production Sebulba pipeline (host envs → inference
   # → buffer → learner). 'anakin' fuses act+learn into ONE jitted
@@ -882,6 +892,15 @@ def validate_controller(config: Config) -> List[str]:
   if config.fleet_probation_secs < 0:
     raise ValueError(f'fleet_probation_secs must be >= 0, got '
                      f'{config.fleet_probation_secs}')
+  if config.pod_max_hosts < 0:
+    raise ValueError(f'pod_max_hosts must be >= 0, got '
+                     f'{config.pod_max_hosts}')
+  if config.pod_max_hosts > 0 and not config.remote_actor_port:
+    warnings.append(
+        'pod_max_hosts=%d with remote ingest disabled '
+        '(remote_actor_port=0): the pod_size actuator reads the '
+        'ingest membership ledger — it will not be registered'
+        % config.pod_max_hosts)
   if (config.remote_heartbeat_secs == 0
       and config.remote_conn_idle_timeout_secs > 0
       and config.fleet_probation_secs >

@@ -87,7 +87,7 @@ MODES = ('off', 'observe', 'act')
 # registers is a typo, not a topology gap; topology gaps are the
 # KNOWN names the driver legitimately skipped, logged at spin-up).
 KNOWN_ACTUATORS = ('replay_k', 'admission', 'publish_secs',
-                   'fleet_size')
+                   'fleet_size', 'pod_size')
 
 ACTUATOR_KINDS = ('int', 'float', 'enum')
 
@@ -245,6 +245,24 @@ DEFAULT_RULES = (
          direction='down', step=1, cooldown_secs=180.0,
          clear_margin=0.05,
          description='producers fully parked: shrink the fleet'),
+    # Elastic pod membership (round 20): the pod-level analogues of
+    # the two fleet_size rules. pod_size is DECLARATIVE — the
+    # actuator publishes the desired host count to POD_TARGET.json
+    # (process 0 owns it, per-actuator-ownership) and the cluster
+    # supervisor reconciles actual hosts toward it; the learner
+    # never spawns or kills hosts itself. Registered only when
+    # --pod_max_hosts > 0, so these rules drop with a spin-up log
+    # line on fixed-topology runs (the KNOWN-name topology-gap path).
+    Rule(objective='fleet_healthy_fraction', actuator='pod_size',
+         direction='up', step=1, trigger_margin=0.25,
+         clear_margin=0.5, cooldown_secs=120.0,
+         description='thinning pod: request a replacement actor host '
+                     '(POD_TARGET.json; supervisor reconciles)'),
+    Rule(objective='env_plane_utilization', actuator='pod_size',
+         direction='down', step=1, cooldown_secs=300.0,
+         clear_margin=0.05,
+         description='producers fully parked: request a smaller pod '
+                     '(PAL shrink direction, arXiv 2110.01101)'),
 )
 
 

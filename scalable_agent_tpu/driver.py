@@ -446,8 +446,33 @@ def train(config: Config, max_steps: Optional[int] = None,
       save_interval_secs=config.checkpoint_secs,
       verify_digests=config.ckpt_digests,
       registry=registry, mesh=mesh)
+  # Elastic restore gate (round 20, elastic membership): when the
+  # newest step's sharding manifest records a DIFFERENT mesh than this
+  # run's (a 2-process checkpoint under a 4-process restart, or vice
+  # versa), route through the registry's explicit resharding path —
+  # targets respecified for the LIVE mesh, with the strict layout
+  # check refusing cuts the new topology cannot honor — instead of the
+  # implicit same-topology pinning. Fixed-topology restores take the
+  # unchanged restore_latest path (docs/MIGRATION.md).
+  elastic_restore = None
   try:
-    restored = checkpointer.restore_latest(state)
+    topo_delta = (distributed.topology_delta(
+        checkpointer.saved_mesh_shape(), mesh)
+                  if mesh is not None else None)
+    if topo_delta is not None:
+      log.warning(
+          'cross-topology restore: checkpoint saved on mesh %s, this '
+          'run is mesh %s (%d process(es)) — resharding onto registry '
+          'targets for the live topology', topo_delta['saved_mesh'],
+          topo_delta['live_mesh'], topo_delta['processes'])
+      abstract = jax.tree_util.tree_map(
+          lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+      restored = checkpointer.restore_resharded(abstract, registry,
+                                                mesh)
+      if restored is not None:
+        elastic_restore = topo_delta
+    else:
+      restored = checkpointer.restore_latest(state)
   except BaseException:
     # A structure-mismatch raise must not leak the manager (its
     # background threads survive a same-process retry).
@@ -773,6 +798,12 @@ def train(config: Config, max_steps: Optional[int] = None,
     # the sink is free; the finally clears it (the bound method keeps
     # this run's incident stream referenced).
     lock_check.set_incident_sink(incidents.event)
+    # The elastic restore above predates this stream — announce it
+    # here so the topology change is on the incident record, not just
+    # in the log (round 20).
+    if elastic_restore is not None:
+      incidents.event('topology_resharded', step=_initial_steps,
+                      **elastic_restore)
     # Telemetry plane (round 13, telemetry.py): the pipeline tracer
     # completes per-unroll trace spans (actor → wire → ingest →
     # staging → serve → step) into traces.jsonl and keeps the flight
@@ -887,6 +918,39 @@ def train(config: Config, max_steps: Optional[int] = None,
             get_fn=fleet.target_size,
             set_fn=fleet.set_target_size,
             minimum=1, maximum=config.num_actors))
+      # Pod topology actuator (round 20, elastic membership): the
+      # pod-level set_target_size. DECLARATIVE — the learner cannot
+      # spawn hosts, so a move publishes the desired host count to
+      # <logdir>/POD_TARGET.json (atomic replace) for the cluster
+      # supervisor (chaos.py's elastic storm; an operator's
+      # orchestration in production) to reconcile against. Process 0
+      # only, per the per-actuator-ownership rule — one pod, one
+      # declared target, exactly like the checkpoint manifests.
+      if (ingest is not None and process_index == 0
+          and config.pod_max_hosts > 0):
+        pod_target = {'hosts': None}  # None = never moved: mirror live
+
+        def _pod_target_get():
+          if pod_target['hosts'] is not None:
+            return pod_target['hosts']
+          return max(ingest.live_hosts(), 1)
+
+        def _pod_target_set(n):
+          pod_target['hosts'] = int(n)
+          payload = {'target_hosts': int(n),
+                     'live_hosts': ingest.live_hosts(),
+                     'membership': ingest.membership(),
+                     'wall_time': round(time.time(), 3)}
+          path = os.path.join(config.logdir, 'POD_TARGET.json')
+          tmp = f'{path}.tmp'
+          with open(tmp, 'w') as f:
+            json.dump(payload, f, indent=2)
+          os.replace(tmp, path)
+
+        actuators.append(controller_lib.Actuator(
+            'pod_size', kind='int',
+            get_fn=_pod_target_get, set_fn=_pod_target_set,
+            minimum=1, maximum=config.pod_max_hosts))
       ctrl_interval = (config.controller_interval_secs
                        if config.controller_interval_secs > 0
                        else slo_interval)
@@ -1016,6 +1080,8 @@ def train(config: Config, max_steps: Optional[int] = None,
         gauge = telemetry.gauge('driver/env_plane_utilization')
       elif name == 'learner':
         gauge = telemetry.gauge('driver/learner_plane_utilization')
+      elif name == 'hosts':
+        gauge = telemetry.gauge('driver/remote_live_hosts')
       else:
         gauge = telemetry.gauge('driver/fleet_healthy_fraction')
       _plane_gauges[name] = gauge
@@ -1784,6 +1850,33 @@ def train(config: Config, max_steps: Optional[int] = None,
               health.note_external('ingest_threads_wedged')
             log.error('ingest watchdog: %d wedged thread(s): %s',
                       wedged_now, ', '.join(names))
+          # Elastic membership (round 20): the v9 host ledger. The
+          # gauge is the pod-size ground truth the SLO engine and the
+          # pod_size actuator read; join/leave events drain into
+          # DURABLE incidents (the 'host_' marker) so survivors'
+          # incident streams narrate every topology change — the
+          # departure itself is benign (training continues at reduced
+          # topology), which is exactly why it must be on the record.
+          live_hosts = ing.get('live_hosts', 0)
+          writer.scalar('remote_live_hosts', live_hosts, step_now)
+          _set_plane_gauge('hosts', live_hosts)
+          for member_ev in ingest.drain_membership_events():
+            if member_ev.get('kind') == 'host_left':
+              incidents.event('host_left', step=step_now,
+                              host=member_ev.get('host'),
+                              reason=member_ev.get('reason'))
+              log.warning(
+                  'pod membership: host %s left (%s); %d host(s) '
+                  'remain — continuing at reduced topology',
+                  member_ev.get('host'), member_ev.get('reason'),
+                  live_hosts)
+            else:
+              incidents.event('host_joined', step=step_now,
+                              host=member_ev.get('host'),
+                              reattach=member_ev.get('reattach',
+                                                     False))
+              log.info('pod membership: host %s joined (%d live)',
+                       member_ev.get('host'), live_hosts)
           dt_summary = now - last_ingest_time
           d_unrolls = ing['unrolls'] - last_ingest_snap['unrolls']
           writer.scalar('remote_unrolls_per_sec',

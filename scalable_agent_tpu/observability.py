@@ -95,12 +95,20 @@ class EventLog(_JsonlAppender):
   # survive whatever crash follows it.
   # 'lock_order' (round 18): a lock_order_inversion detection IS the
   # latent-deadlock postmortem — it must survive the deadlock/crash
-  # it predicts. The canonical marker list is contract-linted
+  # it predicts.
+  # 'host_' (round 20): host_left/host_joined membership records are
+  # how an operator reconstructs the pod's shape over time — a
+  # departure record that dies with the crash that caused the
+  # departure defeats the audit.
+  # 'reshard' (round 20): a topology_resharded record marks a restore
+  # whose layout was respecified for a NEW mesh — the provenance line
+  # every later numerical question starts from.
+  # The canonical marker list is contract-linted
   # (scripts/lint.py durable-markers) against the docs/OBSERVABILITY
   # .md "Durable incident markers" section AND against the kinds the
   # modules actually emit, both directions.
   _DURABLE_MARKERS = ('halt', 'rollback', 'sdc', 'quarantin', 'slo',
-                      'controller', 'lock_order')
+                      'controller', 'lock_order', 'host_', 'reshard')
 
   def __init__(self, logdir: str, filename: str = 'incidents.jsonl'):
     super().__init__(logdir, filename)

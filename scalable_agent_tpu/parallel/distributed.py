@@ -151,6 +151,27 @@ def maybe_initialize(config) -> bool:
   return True
 
 
+def topology_delta(saved_mesh_shape, mesh) -> Optional[dict]:
+  """The elastic-restart detector (round 20, elastic membership).
+
+  Compares the mesh-shape dict a checkpoint's sharding manifest
+  recorded at save time against the LIVE mesh. None = same topology
+  (or nothing recorded — pre-manifest checkpoints restore on the
+  unchanged fixed-topology path); else the change record the driver
+  logs and writes as the durable `topology_resharded` incident, with
+  the live process topology attached so a postmortem can tell a
+  2→4 grow from a 4→2 shrink without cross-referencing launch logs."""
+  if saved_mesh_shape is None or mesh is None:
+    return None
+  live = {str(axis): int(n) for axis, n in dict(mesh.shape).items()}
+  saved = {str(axis): int(n) for axis, n in saved_mesh_shape.items()}
+  if saved == live:
+    return None
+  return {'saved_mesh': saved, 'live_mesh': live,
+          'processes': jax.process_count(),
+          'process_index': jax.process_index()}
+
+
 def global_batch_from_local(mesh, spec, local_batch):
   """Assemble a globally-sharded array from this host's local batch.
 

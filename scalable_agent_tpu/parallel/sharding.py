@@ -67,6 +67,16 @@ class ShardingRuleError(ValueError):
   never a sharding decision (the registry's core contract)."""
 
 
+class ShardingLayoutError(ShardingRuleError):
+  """A resolved spec the TARGET mesh cannot honor — the axis is not on
+  the mesh, the cut dim is out of rank, or the dim does not divide the
+  axis width. Where live binding silently degrades such a cut to
+  replicated (`_guard`), the strict layout check cross-topology restore
+  runs (round 20, elastic membership) refuses with the structural story
+  instead: a topology change must never silently rewrite a layout the
+  checkpoint still holds."""
+
+
 def shard_batch_over_model(config) -> bool:
   """Whether the learner batch must shard over the model axis too.
 
@@ -243,6 +253,69 @@ class ShardingRegistry:
                              or leaf.shape[dim] % width != 0):
         return P()
     return spec
+
+  def layout_violations(self, tree, mesh: Mesh):
+    """[(path, reason)] for every leaf whose RESOLVED spec this mesh
+    cannot honor — the structural half of the divisibility guard.
+    Where `_guard` silently degrades such a binding to replicated,
+    this names the leaf and the reason; cross-topology restore
+    consults it (`check_layout`) so a topology change never silently
+    rewrites the declared layout (round 20, elastic membership)."""
+    out = []
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+      path = _path_str(kp)
+      spec = self.spec_for(path, leaf)
+      shape = tuple(getattr(leaf, 'shape', ()) or ())
+      for dim, ax in enumerate(spec):
+        if ax is None:
+          continue
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        missing = sorted(set(axes) - set(mesh.shape))
+        if missing:
+          out.append((path, (
+              f'spec {spec} names mesh axis {missing[0]!r} but the '
+              f'target mesh only has {dict(mesh.shape)}')))
+          continue
+        width = 1
+        for a in axes:
+          width *= int(mesh.shape[a])
+        if dim >= len(shape):
+          out.append((path, (
+              f'spec {spec} cuts dim {dim} but the leaf is rank '
+              f'{len(shape)} {shape}')))
+        elif shape[dim] % width != 0:
+          out.append((path, (
+              f'dim {dim} (size {shape[dim]}) does not divide mesh '
+              f'axis {"*".join(axes)} width {width} (spec {spec})')))
+    return out
+
+  def check_layout(self, tree, mesh: Mesh, what: str = 'state',
+                   saved_specs: Optional[Dict[str, str]] = None
+                   ) -> None:
+    """Raise `ShardingLayoutError` unless every leaf's resolved spec
+    can bind on `mesh` exactly as resolved — the refusal gate of
+    strict cross-topology restore. A leaf the SAVE already recorded
+    as replicated (`saved_specs`: the checkpoint sharding manifest's
+    {path: spec} table) is exempt: its cut was degraded before the
+    topology changed, so the restore loses nothing the save still
+    had."""
+    replicated = str(P())
+    violations = [
+        (path, reason)
+        for path, reason in self.layout_violations(tree, mesh)
+        if saved_specs is None or saved_specs.get(path) != replicated]
+    if not violations:
+      return
+    shown = '\n'.join(f'  - {p}: {r}' for p, r in violations[:8])
+    more = ('' if len(violations) <= 8
+            else f'\n  ... and {len(violations) - 8} more')
+    raise ShardingLayoutError(
+        f'{len(violations)} {what} leaf/leaves cannot be laid out on '
+        f'the target mesh {dict(mesh.shape)} under rule set '
+        f'{self.rule_set!r}:\n{shown}{more}\n'
+        'Fix the target topology (every cut dim must divide its axis '
+        'width), pick a rule set the mesh can honor, or restore '
+        'non-strict to accept replicated degradation.')
 
   def param_shardings(self, params, mesh: Mesh):
     """NamedShardings for a param pytree on this mesh."""

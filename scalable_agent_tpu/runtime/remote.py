@@ -104,6 +104,7 @@ import pickle
 import queue
 import random
 import selectors
+import signal
 import socket
 import struct
 import threading
@@ -782,14 +783,30 @@ class Backoff:
 #     metrics-registry snapshot plus its ingest stats, served over
 #     the existing control lane so operators (and tests) can read the
 #     single source of truth remotely.
-PROTOCOL_VERSION = 8
+# v9 (round 20): elastic pod membership, v5..v8-COMPATIBLE both ways:
+#   - the 'hello' client-info dict MAY carry 'host' — a stable host
+#     identity string. The server keys its membership ledger on it:
+#     a hello for an unknown host records a host_joined event, and
+#     the connection's unwind records host_left with the reason
+#     (drain/reaped/lost). Old servers ignore the extra key; old
+#     clients simply never appear in the ledger (membership events
+#     degrade to nothing, exactly like heartbeats on a v5 peer).
+#   - 'leave' on the trajectory lane announces a DELIBERATE exit
+#     (SIGTERM drain): ('leave', info) → ('bye_ack',). The server
+#     marks the connection draining so its unwind records
+#     host_left(reason='drain') instead of 'lost'. Old servers answer
+#     ('error', unknown kind) — the draining client tolerates that
+#     and closes anyway (the exit is best-effort-announced, never
+#     gated on the server's vintage).
+PROTOCOL_VERSION = 9
 
 # Handshakes accepted without negotiation failure: v5 peers get the
 # round-9 wire exactly (no heartbeats, no busy keepalives, no epoch
 # checks), v6 peers the round-11 wire (no CRC trailers, no digest
-# checks), v7 peers the round-12 wire (no trace stamps); everything
-# else about the lanes is unchanged.
-_COMPATIBLE_PROTOCOLS = (5, 6, 7, 8)
+# checks), v7 peers the round-12 wire (no trace stamps), v8 peers the
+# round-13 wire (no membership ledger entries); everything else about
+# the lanes is unchanged.
+_COMPATIBLE_PROTOCOLS = (5, 6, 7, 8, 9)
 
 # Bound on the reader→worker handoff queue. The request→reply
 # lockstep already implies at most one in-flight unroll per live
@@ -1082,6 +1099,12 @@ class _Conn:
     # conn's PRIOR state, so a re-handshake stays parseable).
     self.crc = False
     self.crc_rejected = 0      # unrolls refused with ('corrupt', crc)
+    # v9 elastic membership: the host identity the hello's client-info
+    # carried (None for pre-v9 peers — they never enter the ledger),
+    # and whether a 'leave' announced a deliberate drain (the unwind
+    # then records host_left(reason='drain') instead of 'lost').
+    self.host_id = None
+    self.draining = False
     # Unrolls handed to the worker pool whose ack has not gone out
     # yet. A LOCKSTEP client is silent BY PROTOCOL while its unroll is
     # in flight (it may be parked for minutes behind buffer
@@ -1560,6 +1583,8 @@ class TrajectoryIngestServer:
   _unjoined_threads: guarded_by('_stats_lock')
   _threads: guarded_by('_conns_lock')
   _conns: guarded_by('_conns_lock')
+  _members: guarded_by('_conns_lock')
+  _member_events: guarded_by('_conns_lock')
 
   def __init__(self, buffer, params, host: str = '127.0.0.1',
                port: int = 0, contract=None,
@@ -1666,6 +1691,16 @@ class TrajectoryIngestServer:
     # actor hosts over a long run must not accumulate dead entries).
     self._threads: List[threading.Thread] = []
     self._conns: List[_Conn] = []
+    # Elastic membership ledger (round 20): host identity -> the conn
+    # currently carrying it, plus the pending join/leave events the
+    # driver drains into durable incidents. Keyed on the v9 hello's
+    # 'host' string, so a RECONNECT of a known host (new conn, same
+    # identity) is a non-event while a fresh host records host_joined
+    # and a dead conn still owning its identity records host_left.
+    self._members: Dict[str, _Conn] = {}
+    self._member_events: List[Dict] = []
+    self._hosts_joined = telemetry.counter('ingest/hosts_joined')
+    self._hosts_left = telemetry.counter('ingest/hosts_left')
     self._conns_lock = make_lock('remote.IngestServer._conns_lock')
     # Trajectory-lane handoff: readers push (conn, unroll, t_recv,
     # client_version); the worker pool validates, commits
@@ -1790,9 +1825,30 @@ class TrajectoryIngestServer:
     with self._params_lock:
       return self._serializations
 
+  def live_hosts(self) -> int:
+    """Hosts currently in the membership ledger (v9 peers only —
+    pre-v9 connections never name a host identity and so never
+    count here; use stats()['live'] for raw connection counts)."""
+    with self._conns_lock:
+      return len(self._members)
+
+  def membership(self) -> List[str]:
+    """Sorted host identities currently attached."""
+    with self._conns_lock:
+      return sorted(self._members)
+
+  def drain_membership_events(self) -> List[Dict]:
+    """Pop-all of the pending join/leave events, oldest first. The
+    driver turns these into durable host_joined/host_left incidents
+    at the summary cadence; each event is delivered exactly once."""
+    with self._conns_lock:
+      events, self._member_events = self._member_events, []
+    return events
+
   def stats(self):
     with self._conns_lock:
       live = len(self._conns)
+      live_hosts = len(self._members)
       per_conn = {f'{c.addr}': c.unrolls for c in self._conns}
       per_conn_stale = {f'{c.addr}': c.stale_rejected
                         for c in self._conns if c.stale_rejected}
@@ -1826,6 +1882,13 @@ class TrajectoryIngestServer:
               'discarded_bytes': self._discarded_bytes.value,
               'connections': self._connections,  # cumulative
               'live': live,
+              # Elastic membership (round 20): hosts currently in the
+              # v9 ledger and the cumulative join/leave traffic — the
+              # pod-size ground truth the driver gauges and the
+              # controller's pod_size actuator read.
+              'live_hosts': live_hosts,
+              'hosts_joined': self._hosts_joined.value,
+              'hosts_left': self._hosts_left.value,
               # Per-lane transport counters (round 6): the driver
               # turns these into summary-interval rates/latencies.
               'per_conn_unrolls': per_conn,
@@ -2178,6 +2241,25 @@ class TrajectoryIngestServer:
                     self.session_epoch, self._reattach_latency)
               else:
                 self._reconnected += 1
+          # v9 membership: a hello naming a host identity enters the
+          # ledger. Only a NEW identity is a join event — a reconnect
+          # of a known host just re-points its entry at this conn
+          # (the old conn's unwind sees it no longer owns the
+          # identity and stays silent).
+          host_id = (client_info.get('host')
+                     if isinstance(client_info, dict) else None)
+          if isinstance(host_id, str) and host_id:
+            conn.host_id = host_id
+            with self._conns_lock:
+              fresh = host_id not in self._members
+              self._members[host_id] = conn
+              if fresh:
+                self._member_events.append(
+                    {'kind': 'host_joined', 'host': host_id,
+                     'reattach': prior_epoch is not None})
+            if fresh:
+              self._hosts_joined.inc()
+              log.info('host %s JOINED the pod (%s)', host_id, addr)
           segments, trailer = self._snapshot_frame()
           conn.send_segments(segments,
                              trailer if conn.crc else None)
@@ -2254,6 +2336,16 @@ class TrajectoryIngestServer:
                               (crc_ctx.computed, crc_ctx.wire)
                               if crc_ctx is not None else None,
                               trace))
+        elif kind == 'leave':
+          # v9 drain announcement: the host is exiting DELIBERATELY
+          # (SIGTERM quiesce), so its unwind records
+          # host_left(reason='drain') — survivors tell a planned
+          # departure from a crash without any out-of-band channel.
+          conn.draining = True
+          conn.send(('bye_ack',))
+          log.info('host %s announced drain from %s',
+                   conn.host_id or '<unnamed>', addr)
+          return  # the finally block runs the membership unwind
         elif kind == 'stats':
           # On-demand fleet telemetry (round 13): the unified
           # metrics-registry snapshot + this server's ingest stats,
@@ -2320,9 +2412,27 @@ class TrajectoryIngestServer:
       if not adopted and not leave_to_close:
         conn.sock.close()
       if not leave_to_close:
+        left_as = None
         with self._conns_lock:
           if conn in self._conns:
             self._conns.remove(conn)
+          # Membership unwind: only the conn CURRENTLY owning the
+          # identity records the departure — a reconnect re-pointed
+          # the entry before the old reader unwound, so the old
+          # conn's exit is a non-event.
+          if (conn.host_id is not None
+              and self._members.get(conn.host_id) is conn):
+            del self._members[conn.host_id]
+            reason = ('drain' if conn.draining
+                      else 'reaped' if conn.reaped else 'lost')
+            left_as = reason
+            self._member_events.append(
+                {'kind': 'host_left', 'host': conn.host_id,
+                 'reason': reason})
+        if left_as is not None:
+          self._hosts_left.inc()
+          log.warning('host %s LEFT the pod (%s)', conn.host_id,
+                      left_as)
       if not adopted and not leave_to_close:
         log.info('remote actor %s disconnected', addr)
 
@@ -2657,8 +2767,8 @@ class RemoteActorClient:
           tree)
     return version, tree
 
-  def handshake(self, contract,
-                prior_epoch: Optional[int] = None) -> Tuple[int, object]:
+  def handshake(self, contract, prior_epoch: Optional[int] = None,
+                host: Optional[str] = None) -> Tuple[int, object]:
     """Offer this host's trajectory contract; returns (version,
     params) on agreement, raises ContractMismatch (naming the
     offending fields) when the learner refuses. The handshake blob
@@ -2670,7 +2780,8 @@ class RemoteActorClient:
     foreign epoch and counts/times the fleet re-attach; old servers
     ignore the extra hello element. The same client-info dict carries
     the v7 CRC offer (algorithm included — mixed-fallback pairs must
-    negotiate the check OFF, not miscompare)."""
+    negotiate the check OFF, not miscompare) and the v9 `host`
+    identity for the learner's elastic membership ledger."""
     # Offer CRC only when the CONTRACT itself speaks v7: tests (and
     # mixed fleets mid-upgrade) legitimately offer an older protocol
     # through a forged contract, and the negotiation must then land
@@ -2688,6 +2799,11 @@ class RemoteActorClient:
     if offer_crc:
       info['crc'] = True
       info['crc_algo'] = integrity.CRC_ALGO
+    if host is not None:
+      # v9 membership: a stable host identity enters the learner's
+      # ledger (join/leave events, live-host gauge). Old servers
+      # ignore the extra key — offering it costs nothing.
+      info['host'] = str(host)
     msg = ('hello', contract, info) if info else ('hello', contract)
     if not offer_crc:
       self._crc = False
@@ -2877,6 +2993,19 @@ class RemoteActorClient:
       raise ProtocolError(f'expected stats, got {reply[0]!r}')
     return reply[1]
 
+  def send_leave(self) -> bool:
+    """Announce a DELIBERATE exit (v9 drain): the learner records
+    host_left(reason='drain') instead of 'lost' when this connection
+    unwinds. Best-effort by design — True when the learner
+    acknowledged, False against an old server (('error', unknown
+    kind) → RuntimeError) or a dead connection; the caller closes
+    and exits either way, never gated on the announcement."""
+    try:
+      reply = self._rpc(('leave', {}))
+    except (RuntimeError, OSError, LearnerShutdown):
+      return False
+    return reply[0] == 'bye_ack'
+
   def close(self):
     self._close_param_sock()
     try:
@@ -2950,11 +3079,36 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
                                  num_tasks=len(levels))
 
   contract = trajectory_contract(config, agent, spec0.num_actions)
+  # v9 membership identity: stable for THIS host process's lifetime
+  # (reconnects keep it — a reconnect is a non-event in the learner's
+  # ledger), unique across hosts and across restarts of the same task
+  # slot (the pid) — a replacement host for the same task is a fresh
+  # join, which is exactly what the elastic storm asserts.
+  host_id = f'{socket.gethostname()}:{os.getpid()}:task{task}'
   client = RemoteActorClient(learner_address,
                              connect_timeout_secs=connect_timeout_secs,
                              io_timeout_secs=io_timeout,
                              wire_crc=wire_crc)
   unrolls_sent = 0
+  # SIGTERM drain (round 20, riding the PR 6 quiesce idiom): the
+  # handler only flips an event — the pump notices at its next wake,
+  # quiesces the fleet, ANNOUNCES the departure ('leave' → the
+  # learner records host_left(reason='drain') instead of 'lost') and
+  # exits cleanly. Registered best-effort: under a non-main thread
+  # (tests drive this function directly) signal.signal raises
+  # ValueError and the drain stays externally triggerable only.
+  drain = threading.Event()
+
+  def _on_sigterm(signum, frame):
+    del signum, frame
+    log.warning('remote actor task=%d received SIGTERM — draining '
+                '(quiesce fleet, announce leave, exit)', task)
+    drain.set()
+
+  try:
+    signal.signal(signal.SIGTERM, _on_sigterm)
+  except ValueError:
+    pass  # not the main thread: no signal-driven drain
   # Integrity ledger across reconnects (client objects are replaced):
   # CRC refusals of our unrolls (with the round-15 probation rung),
   # digest-refused publishes, and whether this host took itself out
@@ -2972,7 +3126,7 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
     backoff = Backoff(base=0.3, cap=3.0)
     for attempt in range(5):
       try:
-        version, params = client.handshake(contract)
+        version, params = client.handshake(contract, host=host_id)
         break
       except LearnerShutdown:
         # Connected just as training ended: a clean no-op, not a
@@ -3055,7 +3209,8 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
           # The prior epoch rides the hello: a RESTARTED learner (new
           # epoch) counts this as a fleet re-attach and times it.
           v, new_params = new_client.handshake(contract,
-                                               prior_epoch=known_epoch)
+                                               prior_epoch=known_epoch,
+                                               host=host_id)
         except ContractMismatch:
           # The restarted learner runs an INCOMPATIBLE config: retrying
           # cannot succeed — surface it instead of burning the window.
@@ -3139,8 +3294,9 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
       unroll = None  # a drop mid-send must not lose the unroll
       unroll_trace = None  # its trace context rides every (re)send
       last_io = time.monotonic()
-      while (stop_after_unrolls is None or
-             unrolls_sent < stop_after_unrolls):
+      while (not drain.is_set() and
+             (stop_after_unrolls is None or
+              unrolls_sent < stop_after_unrolls)):
         if unroll is None:
           probation.next_unroll()
           try:
@@ -3260,6 +3416,16 @@ def run_remote_actor(config, learner_address: str, task: int = 0,
             if not resume_after_drop():
               break
             last_io = time.monotonic()
+      if drain.is_set():
+        # Quiesce first (no more unrolls can be produced against the
+        # announced-gone connection), then tell the learner this is a
+        # DELIBERATE exit — best-effort: an old/dead learner just
+        # records 'lost' when the socket closes below.
+        fleet.stop()
+        acked = client.send_leave()
+        log.warning('remote actor task=%d drained cleanly after %d '
+                    'unroll(s) (leave %s)', task, unrolls_sent,
+                    'acked' if acked else 'not acked — old learner?')
     except LearnerShutdown:
       # Clean end of training ('bye'): no reconnect window to burn.
       log.info('learner finished training; remote actor exiting')

@@ -133,6 +133,18 @@ the controller logs the moves it WOULD have made (applied: false)
 and touches nothing — the quorum objective burns and the verdict
 FAILS, recording exactly the violation the actuated run avoided.
 
+Round 20 adds the ELASTIC storm (`run_elastic_storm`,
+CHAOS_STORM=elastic — its own invocation, not part of 'all'): a
+learner fed ENTIRELY by two remote actor hosts has one SIGKILLed
+mid-run. The membership ledger must record host_left(lost) as a
+durable incident, the pod-hosts SLO margin must thin without burning,
+the controller's pod_size actuator must raise the declared target
+(POD_TARGET.json), the harness's grow-only cluster supervisor must
+spawn the replacement, and the replacement must JOIN a live learner —
+no restart, no pause, verdict green, zero human knob-turning. The
+full run adds a SIGTERM drain cycle (host_left reason='drain' via the
+v9 'leave' announcement) and heals that too.
+
 Writes CHAOS_OUT (default CHAOS.json at the repo root). Invocation:
 
     python scripts/chaos.py               # all storms, ~4-6 min CPU
@@ -142,6 +154,8 @@ Writes CHAOS_OUT (default CHAOS.json at the repo root). Invocation:
     CHAOS_STORM=partition python scripts/chaos.py  # just the partition
     CHAOS_STORM=corruption python scripts/chaos.py # just the integrity
     CHAOS_STORM=controller python scripts/chaos.py # just the controller
+    CHAOS_STORM=elastic   python scripts/chaos.py  # pod membership
+                                                   # (not part of 'all')
     CHAOS_SEED=7 python scripts/chaos.py  # different garbage bytes
 
 The fault schedule is a pure function of the arguments (the seed only
@@ -1592,6 +1606,320 @@ def run_controller_storm(logdir: str, smoke: bool = SMOKE,
   return results, errors
 
 
+def run_elastic_storm(logdir: str, smoke: bool = SMOKE,
+                      seed: int = SEED):
+  """The elastic pod-membership drill (round 20); returns (results,
+  hard-assert errors).
+
+  A single-process learner (no local actors) trains entirely from two
+  remote actor hosts. Mid-run the harness SIGKILLs one host. The
+  survivors must observe the departure (host_left reason='lost'
+  durable incident), the pod-hosts SLO margin must thin WITHOUT
+  burning, the controller's pod_size actuator must raise the declared
+  target (POD_TARGET.json), and the harness's grow-only cluster
+  supervisor — the reconciliation role a real deployment's cluster
+  manager plays — must spawn the replacement, which joins WITHOUT the
+  learner pausing. Zero human knob-turning; the verdict stays green.
+  The full (non-smoke) run adds a second cycle: SIGTERM-draining a
+  host (the deliberate 'leave' announcement → host_left
+  reason='drain') and healing again."""
+  import signal as signal_lib
+  import threading
+
+  from scalable_agent_tpu import controller as controller_lib
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu import slo as slo_lib
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.runtime import faults as faults_lib
+
+  port = _free_port()
+  # The pod-hosts objective: fractional target so the margin is never
+  # exactly zero at quorum (2 hosts -> margin +0.5, 1 host -> -0.5).
+  # Slow window sized so the healthy warm-up always outweighs the
+  # violation dip (burn needs >= half the slow-window samples bad).
+  spec = [
+      dict(name='pod_hosts', metric='driver/remote_live_hosts',
+           comparison='>=', target=1.5, severity='page',
+           fast_window_secs=2.0, slow_window_secs=90.0,
+           description='elastic drill: the pod must hold 2 actor '
+                       'hosts'),
+  ]
+  # clear_margin 10 is unreachable (pod_max_hosts bounds the gauge):
+  # the grow decision is never reverted — shrinking the pod is the
+  # utilization rule's job in production, and the drill's supervisor
+  # is grow-only by design.
+  policy = [
+      dict(objective='pod_hosts', actuator='pod_size',
+           direction='up', step=1, trigger_margin=0.25,
+           clear_margin=10.0, cooldown_secs=15.0,
+           description='a host left: raise the declared pod target '
+                       'so the cluster supervisor replaces it'),
+  ]
+  os.makedirs(logdir, exist_ok=True)
+  spec_path = os.path.join(logdir, 'elastic_slo_spec.json')
+  policy_path = os.path.join(logdir, 'elastic_policy.json')
+  with open(spec_path, 'w') as f:
+    json.dump(spec, f, indent=2)
+  with open(policy_path, 'w') as f:
+    json.dump(policy, f, indent=2)
+
+  cfg_kwargs = dict(
+      logdir=logdir,
+      env_backend='bandit',
+      num_actors=0,             # every row arrives over TCP
+      batch_size=2,
+      unroll_length=5,
+      num_action_repeats=1,
+      episode_length=4,
+      height=24, width=32,
+      torso='shallow',
+      use_py_process=False,
+      use_instruction=False,
+      total_environment_frames=10 ** 9,
+      inference_timeout_ms=5,
+      checkpoint_secs=0,
+      summary_secs=0,
+      remote_actor_port=port,
+      # A SIGKILLed host must be reaped (and the ledger must record
+      # the loss) in seconds, not the production minute — but the
+      # window must still cover a fresh host's first-compile silence
+      # (~5 s before its ping thread is up), or a HEALTHY joiner gets
+      # reaped as half-open.
+      remote_heartbeat_secs=0.5,
+      remote_conn_idle_timeout_secs=8.0,
+      controller='act',
+      controller_policy=policy_path,
+      controller_interval_secs=0.25,
+      pod_max_hosts=3,
+      slo_spec=spec_path,
+      slo_capture=False,
+      seed=seed)
+  cfg = Config(**cfg_kwargs)
+
+  child_overrides = {k: v for k, v in cfg_kwargs.items()
+                     if k in ('env_backend', 'batch_size',
+                              'unroll_length', 'num_action_repeats',
+                              'episode_length', 'height', 'width',
+                              'torso', 'use_py_process',
+                              'use_instruction',
+                              'total_environment_frames',
+                              'inference_timeout_ms', 'seed')}
+  child_overrides['num_actors'] = 2
+  no_faults = faults_lib.FaultPlan([], seed=seed).to_json()
+
+  def _spawn_host(idx):
+    ov = dict(child_overrides, logdir=os.path.join(logdir,
+                                                   f'host{idx}'))
+    return _spawn_actor_child(f'127.0.0.1:{port}', ov, no_faults)
+
+  children = {0: _spawn_host(0), 1: _spawn_host(1)}
+  next_idx = [2]
+  stop = threading.Event()
+  timeline = []           # the supervisor's own audit trail
+
+  # Burn math (slow window 90 s, burn needs >= half the samples bad):
+  # a dip lasts reap (8 s) + controller (<1 s) + replacement spawn-to-
+  # handshake (~8-10 s) ~= 17 s, so every dip must start with > 17 s
+  # of healthy samples banked since the previous one.
+  warm_secs = 22.0
+  heal_wait_secs = 25.0
+  max_seconds = 95.0 if smoke else 140.0
+  pod_path = os.path.join(logdir, 'POD_TARGET.json')
+
+  def _live_rows():
+    rows = _read_jsonl(os.path.join(logdir, 'summaries.jsonl'))
+    return [r['value'] for r in rows
+            if r.get('tag') == 'remote_live_hosts']
+
+  def _alive():
+    return [i for i, p in children.items() if p.poll() is None]
+
+  def _wait_live(n, deadline):
+    while not stop.is_set() and time.monotonic() < deadline:
+      vals = _live_rows()
+      if vals and vals[-1] >= n:
+        return True
+      stop.wait(0.3)
+    return False
+
+  def _reconcile_until_live(n, deadline):
+    """The grow-only cluster supervisor: spawn a replacement host
+    whenever the controller's declared target exceeds the live pod,
+    until the gauge has DIPPED below `n` and recovered — a pre-dip
+    reading of n must not count as healed (the reap takes seconds;
+    the gauge still shows the dead host until then)."""
+    start = len(_live_rows())
+    while not stop.is_set() and time.monotonic() < deadline:
+      try:
+        with open(pod_path) as f:
+          target = int(json.load(f)['target_hosts'])
+      except (OSError, ValueError, KeyError):
+        target = None
+      if (target is not None and target > len(_alive())
+          and next_idx[0] < 5):
+        idx = next_idx[0]
+        next_idx[0] += 1
+        children[idx] = _spawn_host(idx)
+        timeline.append(
+            {'event': 'replacement_spawned', 'host': idx,
+             'target': target,
+             'wall': round(time.monotonic() - t0, 2)})
+      since = _live_rows()[start:]
+      dip = next((i for i, v in enumerate(since) if v < n), None)
+      if dip is not None and any(v >= n for v in since[dip:]):
+        return True
+      stop.wait(0.3)
+    return False
+
+  def _harness(t0):
+    deadline = t0 + max_seconds - 10.0
+    if not _wait_live(2, deadline):
+      timeline.append({'event': 'no_initial_quorum'})
+      return
+    timeline.append({'event': 'quorum', 'wall': round(
+        time.monotonic() - t0, 2)})
+    # Healthy warm-up: the slow window must hold more good samples
+    # than the coming violation dip will add bad ones.
+    if stop.wait(warm_secs):
+      return
+    victim = children[0]
+    victim.kill()                       # SIGKILL: no goodbye
+    timeline.append({'event': 'sigkill', 'host': 0,
+                     'wall': round(time.monotonic() - t0, 2)})
+    if not _reconcile_until_live(2, deadline):
+      timeline.append({'event': 'no_heal_after_kill'})
+      return
+    timeline.append({'event': 'healed', 'wall': round(
+        time.monotonic() - t0, 2)})
+    if smoke:
+      return
+    # Cycle 2: the DELIBERATE exit. Let the window re-fill with
+    # healthy samples, then drain a host via SIGTERM (the PR 6
+    # quiesce path ends in the v9 'leave' announcement).
+    if stop.wait(heal_wait_secs):
+      return
+    drain_idx = next(i for i in sorted(_alive()) if i != 0)
+    children[drain_idx].send_signal(signal_lib.SIGTERM)
+    timeline.append({'event': 'sigterm_drain', 'host': drain_idx,
+                     'wall': round(time.monotonic() - t0, 2)})
+    if _reconcile_until_live(2, deadline):
+      timeline.append({'event': 'healed_after_drain', 'wall': round(
+          time.monotonic() - t0, 2)})
+    else:
+      timeline.append({'event': 'no_heal_after_drain'})
+
+  t0 = time.monotonic()
+  harness = threading.Thread(target=_harness, args=(t0,), daemon=True)
+  crash = None
+  run = None
+  try:
+    harness.start()
+    run = driver.train(cfg, max_seconds=max_seconds,
+                       stall_timeout_secs=30.0)
+  except BaseException as e:  # SLO: zero learner crashes
+    crash = f'{type(e).__name__}: {e}'
+  finally:
+    stop.set()
+    harness.join(timeout=10.0)
+    for p in children.values():
+      if p.poll() is None:
+        p.terminate()
+    for p in children.values():
+      try:
+        p.communicate(timeout=20)
+      except subprocess.TimeoutExpired:
+        p.kill()
+        p.communicate()
+
+  errors = []
+  events = {t['event'] for t in timeline}
+  results = {
+      'smoke': smoke,
+      'wall_secs': round(time.monotonic() - t0, 2),
+      'crash': crash,
+      'timeline': timeline,
+  }
+  if crash is not None:
+    errors.append(f'learner crashed during the elastic drill: {crash}')
+    return results, errors
+  if 'sigkill' not in events:
+    errors.append(f'the harness never reached the SIGKILL ({timeline})')
+    return results, errors
+
+  ing = run.ingest.stats()
+  verdict = slo_lib.read_verdict(logdir)
+  clog = controller_lib.read_log(logdir)
+  incidents = _read_jsonl(os.path.join(logdir, 'incidents.jsonl'))
+  left = [e for e in incidents if e['kind'] == 'host_left']
+  joined = [e for e in incidents if e['kind'] == 'host_joined']
+  results.update({
+      'slo_verdict': None if verdict is None else {
+          'pass': verdict.get('pass'),
+          'violations': verdict.get('violations')},
+      'controller_counts': None if clog is None else clog['counts'],
+      'hosts_joined': ing.get('hosts_joined'),
+      'hosts_left': ing.get('hosts_left'),
+      'live_hosts_at_exit': ing.get('live_hosts'),
+      'stale_epoch_rejected': ing.get('stale_epoch_rejected'),
+      'host_left_reasons': sorted({e.get('reason') for e in left}),
+  })
+
+  # --- The headline: a host died, the replacement joined, the verdict
+  # stayed green with zero human knob-turning.
+  if 'healed' not in events:
+    errors.append(f'the pod never healed after the SIGKILL: {timeline}')
+  if verdict is None:
+    errors.append('no SLO_VERDICT.json')
+  else:
+    if not verdict.get('pass'):
+      errors.append(f"SLO verdict FAILED: {verdict.get('violations')}")
+    pod = (verdict.get('objectives') or {}).get('pod_hosts') or {}
+    if pod.get('burns', 0) != 0:
+      errors.append(f"pod_hosts burned {pod.get('burns')}x — the "
+                    'pod was down a host longer than the healthy '
+                    'warm-up covered')
+  # --- The controller moved the pod_size actuator, applied.
+  if clog is None:
+    errors.append('no CONTROLLER_LOG.json')
+  else:
+    pod_moves = [a for a in (clog.get('actions') or [])
+                 if a['actuator'] == 'pod_size' and a['applied']]
+    if not pod_moves:
+      errors.append('the controller never applied a pod_size move')
+  if not os.path.exists(pod_path):
+    errors.append('no POD_TARGET.json — the actuator never declared '
+                  'a target')
+  else:
+    with open(pod_path) as f:
+      pod_target = json.load(f)
+    results['pod_target'] = pod_target
+    if pod_target.get('target_hosts', 0) < 2:
+      errors.append(f'POD_TARGET.json target_hosts='
+                    f"{pod_target.get('target_hosts')} < 2")
+  if 'replacement_spawned' not in events:
+    errors.append('the supervisor never spawned a replacement host')
+  # --- The membership ledger's durable audit trail.
+  if not any(e.get('reason') == 'lost' for e in left):
+    errors.append(f'no host_left(lost) incident: {left}')
+  if len(joined) < 3:
+    errors.append(f'expected >= 3 host_joined incidents (2 initial + '
+                  f'replacement), got {len(joined)}')
+  if not smoke:
+    if 'healed_after_drain' not in events:
+      errors.append(f'the pod never healed after the drain: '
+                    f'{timeline}')
+    if not any(e.get('reason') == 'drain' for e in left):
+      errors.append(f"no host_left(drain) incident — the SIGTERM'd "
+                    f'host left without its leave announcement: '
+                    f'{left}')
+  # --- No epoch confusion: joins are fresh hellos, not stale traffic.
+  if ing.get('stale_epoch_rejected', 0) != 0:
+    errors.append(f"stale_epoch_rejected="
+                  f"{ing.get('stale_epoch_rejected')} during a "
+                  'membership-only drill')
+  return results, errors
+
+
 def _run_corruption_subprocess():
   """CHAOS_STORM=all path: the corruption storm needs its own process
   (XLA device-count flags must precede the jax import, and the other
@@ -1639,6 +1967,13 @@ def main():
       results['controller'], controller_errors = \
           run_controller_storm(logdir)
     errors += [f'controller: {e}' for e in controller_errors]
+  if which == 'elastic':
+    # Dedicated invocation only (the ci.sh elastic lane): the drill's
+    # wall clock is dominated by real host replacement — folding it
+    # into CHAOS_STORM=all would double the default storm budget.
+    with tempfile.TemporaryDirectory(prefix='chaos_elastic_') as logdir:
+      results['elastic'], elastic_errors = run_elastic_storm(logdir)
+    errors += [f'elastic: {e}' for e in elastic_errors]
   if which == 'corruption':
     with tempfile.TemporaryDirectory(prefix='chaos_corr_') as logdir:
       results['corruption'], corruption_errors = \
@@ -1664,6 +1999,8 @@ def main():
                         results.get('partition', {}).get('wall_secs'),
                     'controller_wall_secs':
                         results.get('controller', {}).get('wall_secs'),
+                    'elastic_wall_secs':
+                        results.get('elastic', {}).get('wall_secs'),
                     'corruption_wall_secs':
                         results.get('corruption', {}).get('wall_secs'),
                     'violations': errors,

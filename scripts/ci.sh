@@ -207,6 +207,23 @@ python -m pytest \
   -q -p no:cacheprovider
 BENCH_SMOKE=1 BENCH_ONLY=multihost python bench.py
 
+echo '== elastic lane (round 20: elastic pod membership — the'
+echo '   resharding edge-case unit tests + v9 membership-ledger units,'
+echo '   the 2-proc -> 4-proc checkpoint-reshard parity drill, and the'
+echo '   elastic storm smoke: SIGKILL an actor host mid-run, the'
+echo '   controller raises POD_TARGET.json, the grow-only supervisor'
+echo '   spawns the replacement, it JOINS the live learner, verdict'
+echo '   green with zero knob-turning — <300 s CPU) =='
+XLA_FLAGS='--xla_force_host_platform_device_count=8' \
+  JAX_PLATFORMS=cpu python -m pytest tests/test_sharding.py -q \
+  -k 'layout or reshard or topology' -p no:cacheprovider
+JAX_PLATFORMS=cpu python -m pytest tests/test_remote.py -q \
+  -k 'membership' -p no:cacheprovider
+python -m pytest \
+  "tests/test_multihost.py::test_reshard_checkpoint_2_to_4_processes" \
+  -q -p no:cacheprovider
+CHAOS_SMOKE=1 CHAOS_STORM=elastic python scripts/chaos.py
+
 echo '== telemetry smoke (trace spans end to end: registry semantics,'
 echo '   tracer pipeline, v8 negotiation + remote stamping,'
 echo '   trace_report reconstruction; then the tiny tracing-on/off'

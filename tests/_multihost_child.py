@@ -15,6 +15,12 @@ default 'run'):
           opens a remote-actor ingest on port P and runs NO local
           actors (its batch shard arrives over TCP) while process 1
           keeps a local fleet; 3 steps, assert, exit 0.
+- save:   train 2 deterministic sharded steps and write a registry-
+          manifested checkpoint (the elastic drill's topology-A leg).
+- reshard P: restore the 'save' checkpoint onto THIS topology via
+          restore_resharded, step once, dump checksums+loss to P —
+          the parent parity-gates a cross-topology restore against a
+          same-topology one (round 20 elastic membership).
 - tp4:    4 processes × 1 device, model_parallelism=2 — the model
           axis PAIRS DEVICES FROM DIFFERENT PROCESSES (mesh rows
           [[p0,p1],[p2,p3]]), so TP matmul collectives cross the
@@ -244,6 +250,100 @@ def main():
     assert int(run.state.update_steps) == 8, run.state.update_steps
     print(f'child {proc}: sdc ok mismatches={hs["sdc_mismatches"]} '
           f'rollbacks={hs["rollbacks"]}', flush=True)
+  elif mode in ('save', 'reshard'):
+    # Elastic resharding drill (round 20): 'save' trains 2
+    # deterministic sharded steps on THIS topology and writes a
+    # registry-manifested checkpoint; 'reshard' (argv[5] = result
+    # JSON) restores that checkpoint onto THIS — possibly different —
+    # topology via restore_resharded, takes 1 more step, and process 0
+    # dumps the restored-params checksum, the step loss, and the
+    # post-step checksum for the parent's cross-topology parity gate.
+    import dataclasses
+    import json
+    import numpy as np
+    import jax.numpy as jnp
+    from scalable_agent_tpu import checkpoint as checkpoint_lib
+    from scalable_agent_tpu import learner as learner_lib
+    from scalable_agent_tpu.models import init_params
+    from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+    from scalable_agent_tpu.parallel import mesh as mesh_lib
+    from scalable_agent_tpu.parallel import sharding as sharding_lib
+    from scalable_agent_tpu.parallel import train_parallel
+    from scalable_agent_tpu.testing import make_example_batch
+
+    mp = int(os.environ.get('MH_MP', '2'))
+    cfg = dataclasses.replace(cfg, batch_size=batch,
+                              model_parallelism=mp)
+    num_actions = 3
+    agent = driver.build_agent(cfg, num_actions)
+    obs = {'frame': (cfg.height, cfg.width, 3),
+           'instr_len': MAX_INSTRUCTION_LEN}
+    params = init_params(agent, jax.random.PRNGKey(cfg.seed), obs)
+    mesh = mesh_lib.make_mesh(model_parallelism=mp)
+    registry = sharding_lib.from_config(cfg, enable_tp=mp > 1)
+    t1 = cfg.unroll_length + 1
+    gbatch = make_example_batch(t1, cfg.batch_size, cfg.height,
+                                cfg.width, num_actions,
+                                MAX_INSTRUCTION_LEN, seed=7,
+                                done_prob=0.1)
+    step, place = train_parallel.make_sharded_train_step(
+        agent, cfg, mesh, gbatch)
+    # Batch dim shards over (data, model) when TP spans hosts: with 1
+    # device per process that is nprocs contiguous row blocks, this
+    # process owning rows [proc*k, (proc+1)*k).
+    k = cfg.batch_size // nprocs
+    host = jax.tree_util.tree_map(np.asarray, gbatch)
+    lo, hi = proc * k, (proc + 1) * k
+    local = host._replace(
+        level_name=host.level_name[lo:hi],
+        agent_state=jax.tree_util.tree_map(
+            lambda x: x[lo:hi], host.agent_state),
+        env_outputs=jax.tree_util.tree_map(
+            lambda x: x[:, lo:hi], host.env_outputs),
+        agent_outputs=jax.tree_util.tree_map(
+            lambda x: x[:, lo:hi], host.agent_outputs))
+    dev_batch = place(local)
+
+    @jax.jit
+    def checksum(p):
+      return jax.tree_util.tree_reduce(
+          lambda a, x: a + jnp.sum(jnp.abs(x.astype(jnp.float32))),
+          p, jnp.float32(0))
+
+    ckpt = checkpoint_lib.Checkpointer(
+        os.path.join(logdir, 'elastic_ckpt'), save_interval_secs=0,
+        registry=registry, mesh=mesh)
+    if mode == 'save':
+      state = train_parallel.make_sharded_train_state(
+          params, cfg, mesh, registry=registry)
+      for _ in range(2):
+        state, _ = step(state, dev_batch)
+      assert ckpt.save(state, step=2)
+      ckpt.wait_until_finished()
+      ckpt.close()
+      print(f'child {proc}: save ok', flush=True)
+    else:
+      out_path = sys.argv[5]
+      state0 = learner_lib.make_train_state(params, cfg)
+      abstract = jax.tree_util.tree_map(
+          lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state0)
+      saved_mesh = ckpt.saved_mesh_shape()
+      delta = distributed.topology_delta(saved_mesh, mesh)
+      if os.environ.get('MH_EXPECT_DELTA') == '1':
+        assert delta is not None, (saved_mesh, dict(mesh.shape))
+      restored = ckpt.restore_resharded(abstract, registry, mesh)
+      assert restored is not None
+      assert int(jax.device_get(restored.update_steps)) == 2
+      restored_sum = float(jax.device_get(checksum(restored.params)))
+      state, metrics = step(restored, dev_batch)
+      loss = float(jax.device_get(metrics['total_loss']))
+      stepped_sum = float(jax.device_get(checksum(state.params)))
+      ckpt.close()
+      if proc == 0:
+        with open(out_path, 'w') as f:
+          json.dump({'restored_sum': restored_sum, 'loss': loss,
+                     'stepped_sum': stepped_sum, 'delta': delta}, f)
+      print(f'child {proc}: reshard ok', flush=True)
   elif mode == 'drill':
     # Frequent collective checkpoints; runs until the parent kills this
     # process or the runtime aborts us because the peer died.

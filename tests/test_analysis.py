@@ -238,6 +238,16 @@ def test_sharding_registry_fires(tmp_path):
       'scalable_agent_tpu/rogue2.py':
           "import jax.sharding\n"
           "spec = jax.sharding.PartitionSpec('data')\n",
+      # Round 20: hand-built NamedSharding is the same offense — a
+      # placement the registry never resolved (both spellings).
+      'scalable_agent_tpu/rogue3.py':
+          "from jax.sharding import NamedSharding\n"
+          "def pin(mesh, spec):\n"
+          "  return NamedSharding(mesh, spec)\n",
+      'scalable_agent_tpu/rogue4.py':
+          "import jax.sharding\n"
+          "def pin(mesh, spec):\n"
+          "  return jax.sharding.NamedSharding(mesh, spec)\n",
       # The registry itself is exempt.
       'scalable_agent_tpu/parallel/sharding.py':
           "from jax.sharding import PartitionSpec as P\n"
@@ -246,7 +256,9 @@ def test_sharding_registry_fires(tmp_path):
   findings = run_only(root, 'sharding-registry')
   symbols = {f.symbol for f in findings}
   assert symbols == {'scalable_agent_tpu/rogue.py:place',
-                     'scalable_agent_tpu/rogue2.py:<module>'}
+                     'scalable_agent_tpu/rogue2.py:<module>',
+                     'scalable_agent_tpu/rogue3.py:pin',
+                     'scalable_agent_tpu/rogue4.py:pin'}
   assert all('registry' in f.message for f in findings)
 
 

@@ -5,6 +5,8 @@ chip); here we only pin the contract: all stages run, report the
 expected keys, and produce positive rates.
 """
 
+import pytest
+
 import bench
 
 
@@ -113,6 +115,7 @@ def test_headline_carries_inference_plane_rows(tmp_path, capsys):
   assert len(lines[-1]) < 1000
 
 
+@pytest.mark.slow  # tier-1 wall trim (round 20); ci.sh full-suite lane runs it
 def test_anakin_bench_smoke():
   """The round-16 stage shape: per-{backend, devices} fps rows, the
   fed-fleet reference + ratio, and the hybrid filler off/on rows with

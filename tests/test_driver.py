@@ -211,6 +211,7 @@ def test_profiler_trace_capture(tmp_path):
   assert traces, f'no trace under {prof_dir}'
 
 
+@pytest.mark.slow  # tier-1 wall trim (round 20); ci.sh full-suite lane runs it
 def test_flagship_multitask_sharded(tmp_path):
   """The headline configuration in one run: dmlab30 multi-task (bandit
   stand-ins), PopArt, pixel control, instruction encoder, batch 8 over
@@ -231,6 +232,7 @@ def test_flagship_multitask_sharded(tmp_path):
   assert 'InstructionEncoder_0' in flat
 
 
+@pytest.mark.slow  # tier-1 wall trim (round 20); ci.sh full-suite lane runs it
 def test_dryrun_multichip_self_provisions():
   """Exactly the driver's call pattern for MULTICHIP_rN.json: import the
   module and call dryrun_multichip(8) programmatically, with NO device

@@ -6,6 +6,8 @@ policy, trajectory batching with the overlap frame, the jitted IMPALA
 step, and that on a learnable task the policy actually improves.
 """
 
+import pytest
+
 import numpy as np
 
 import jax
@@ -154,6 +156,7 @@ def test_bandit_learning_improves_return():
   assert late > 0.6, late
 
 
+@pytest.mark.slow  # tier-1 wall trim (round 20); ci.sh full-suite lane runs it
 def test_cue_memory_learning_requires_recurrence():
   """The LSTM core end-to-end: the cue is visible only on the FIRST
   frame of each 2-step episode; the rewarded action happens on the

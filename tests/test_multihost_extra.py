@@ -16,6 +16,8 @@ OS processes joining jax.distributed over gloo; every assert here
 reads child stdout or on-disk artifacts.
 """
 
+import pytest
+
 import json
 import os
 import sys
@@ -29,6 +31,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
 import trace_report  # noqa: E402
 
 
+@pytest.mark.slow  # tier-1 wall trim (round 20); ci.sh full-suite lane runs it
 def test_sdc_mismatch_rolls_back_across_processes(tmp_path):
   """2 processes x 2 devices, pure-DP 4-way mesh: one replica's
   fingerprint lane is perturbed mid-run (the replica_divergence drill,
@@ -64,6 +67,7 @@ def test_sdc_mismatch_rolls_back_across_processes(tmp_path):
     assert 'rollback' in kinds, (fname, kinds)
 
 
+@pytest.mark.slow  # tier-1 wall trim (round 20); ci.sh full-suite lane runs it
 def test_cross_host_trace_spans_join(tmp_path):
   """The mixed topology (remote actor host over TCP into process 0,
   local fleet on process 1) under default-ON tracing: spans whose hops

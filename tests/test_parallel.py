@@ -6,6 +6,8 @@ actual sharded train step runs over 8 (virtual) devices, and
 DP-sharded training must match single-device training numerically.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -73,6 +75,7 @@ def test_dp_sharded_step_matches_single_device():
                                rtol=5e-4, atol=5e-6)
 
 
+@pytest.mark.slow  # tier-1 wall trim (round 20); ci.sh full-suite lane runs it
 def test_tp_sharded_step_runs_and_matches():
   """(data=4, model=2) mesh with TP on Dense kernels — same numerics."""
   agent = ImpalaAgent(num_actions=A, torso='shallow')
@@ -113,6 +116,7 @@ def test_tp_sharded_step_runs_and_matches():
                'test_tp_sharded_step_runs_and_matches',
         strict=False)),
 ])
+@pytest.mark.slow  # tier-1 wall trim (round 20); ci.sh full-suite lane runs it
 def test_full_feature_sharded_matches_single_device(model_parallelism):
   """VERDICT r5 weak #2: the full-feature config (PopArt ON + pixel
   control ON) had ZERO coverage under a sharded mesh — PopArt's
@@ -200,6 +204,7 @@ def test_pallas_vtrace_sharded_step_matches_single_device():
                                rtol=5e-4, atol=5e-6)
 
 
+@pytest.mark.slow  # tier-1 wall trim (round 20); ci.sh full-suite lane runs it
 def test_aot_memory_fit_mechanics():
   """The compiled v5e-16 HBM fit check (parallel/fit.py, ISSUE-3):
   abstract-lower + compile the full-feature step over a pure-DP mesh
@@ -293,8 +298,11 @@ def test_sharded_eval_inference_spans_devices():
   agent = ImpalaAgent(num_actions=A, torso='shallow',
                       use_instruction=False)
   params = init_params(agent, jax.random.PRNGKey(0), OBS)
+  # The timeout must never fire before all 8 threads enqueue: a
+  # partial flush takes the unsharded path and the devices_last_call
+  # assertion below reads 0 (seen on a loaded single-core host).
   cfg = Config(inference_min_batch=8, inference_max_batch=8,
-               inference_timeout_ms=5000)
+               inference_timeout_ms=60000)
   mesh = mesh_lib.make_mesh(model_parallelism=1)
   server = InferenceServer(agent, params, cfg, seed=3, mesh=mesh)
   try:
@@ -327,7 +335,13 @@ def test_sharded_eval_inference_spans_devices():
     for t in threads:
       t.join(timeout=120)
     assert all(r is not None for r in results)
-    # The merged call actually spanned the mesh.
+    # The merged call actually spanned the mesh. The completion
+    # thread unparks the callers BEFORE it records the stat, so give
+    # it a bounded window to get scheduled (flaked on a 1-core host).
+    deadline = time.time() + 20
+    while (server.stats()['devices_last_call'] == 0
+           and time.time() < deadline):
+      time.sleep(0.01)
     assert server.stats()['devices_last_call'] == 8
     assert server.stats()['mean_batch'] == 8.0
   finally:

@@ -1649,3 +1649,129 @@ def test_crc_probation_probe_failure_is_terminal():
   assert p.recoveries == 0
   # An ordinary ack after quarantine-verdict changes nothing.
   assert p.on_ack() is False
+
+
+def _wait_for(predicate, timeout=5.0, what='condition'):
+  deadline = time.monotonic() + timeout
+  while time.monotonic() < deadline:
+    if predicate():
+      return
+    time.sleep(0.02)
+  raise AssertionError(f'timed out waiting for {what}')
+
+
+def test_membership_join_reconnect_and_drain():
+  """v9 elastic membership: the FIRST hello carrying a host identity
+  is a join (event + counter); a re-hello with the SAME identity is a
+  reconnect, not a second join; a 'leave'-announced exit unwinds as
+  host_left(reason='drain'). Events drain exactly once."""
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1')
+  addr = f'127.0.0.1:{server.port}'
+  host_id = 'hostA:111:task0'
+  try:
+    c1 = remote.RemoteActorClient(addr, connect_timeout_secs=10)
+    c1.handshake({'protocol': remote.PROTOCOL_VERSION}, host=host_id)
+    assert server.live_hosts() == 1
+    assert server.membership() == [host_id]
+    events = server.drain_membership_events()
+    assert events == [{'kind': 'host_joined', 'host': host_id,
+                       'reattach': False}]
+    assert server.drain_membership_events() == []  # exactly once
+
+    # Same identity, second connection: the ledger re-points, no event.
+    c2 = remote.RemoteActorClient(addr, connect_timeout_secs=10)
+    c2.handshake({'protocol': remote.PROTOCOL_VERSION}, host=host_id)
+    assert server.live_hosts() == 1
+    assert server.drain_membership_events() == []
+    # The superseded connection closing must NOT evict the live one.
+    c1.close()
+    time.sleep(0.3)
+    assert server.live_hosts() == 1
+    assert server.drain_membership_events() == []
+
+    # Announced drain: bye_ack, then the unwind records 'drain'.
+    assert c2.send_leave() is True
+    c2.close()
+    _wait_for(lambda: server.live_hosts() == 0, what='drain unwind')
+    events = server.drain_membership_events()
+    assert events == [{'kind': 'host_left', 'host': host_id,
+                       'reason': 'drain'}]
+    stats = server.stats()
+    assert stats['live_hosts'] == 0
+    assert stats['hosts_joined'] == 1
+    assert stats['hosts_left'] == 1
+  finally:
+    server.close()
+    buffer.close()
+
+
+def test_membership_unannounced_death_is_lost():
+  """A host that dies without a leave announcement unwinds as
+  host_left(reason='lost') — the signal the driver turns into the
+  durable incident an operator pages on."""
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1')
+  try:
+    c = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                 connect_timeout_secs=10)
+    c.handshake({'protocol': remote.PROTOCOL_VERSION},
+                host='hostB:222:task1')
+    assert server.live_hosts() == 1
+    c.close()  # abrupt: no leave frame, socket just goes away
+    _wait_for(lambda: server.live_hosts() == 0, what='loss unwind')
+    events = server.drain_membership_events()
+    assert [e['kind'] for e in events] == ['host_joined', 'host_left']
+    assert events[1]['reason'] == 'lost'
+  finally:
+    server.close()
+    buffer.close()
+
+
+def test_membership_hostless_hello_and_legacy_leave():
+  """Compat floor: a hello WITHOUT a host identity (v8-and-older
+  actors) never enters the ledger, and send_leave against a server
+  that answers ('error', unknown kind) returns False instead of
+  raising — the drain path is best-effort by contract."""
+  buffer = ring_buffer.TrajectoryBuffer(4)
+  server = remote.TrajectoryIngestServer(
+      buffer, {'w': np.zeros(1)}, host='127.0.0.1')
+  try:
+    c = remote.RemoteActorClient(f'127.0.0.1:{server.port}',
+                                 connect_timeout_secs=10)
+    c.handshake({'protocol': remote.PROTOCOL_VERSION})  # no host=
+    assert server.live_hosts() == 0
+    assert server.drain_membership_events() == []
+    c.close()
+  finally:
+    server.close()
+    buffer.close()
+
+  # An "old learner" that doesn't know the 'leave' kind: the client
+  # swallows the error-reply RuntimeError and reports not-acked.
+  lis = socket.socket()
+  lis.bind(('127.0.0.1', 0))
+  lis.listen(1)
+  port = lis.getsockname()[1]
+
+  def _legacy_server():
+    conn, _ = lis.accept()
+    try:
+      kind, _ = remote._recv_msg(conn)
+      assert kind == 'leave'
+      remote._send_msg(conn, ('error', "unknown message kind 'leave'"))
+    finally:
+      conn.close()
+
+  t = threading.Thread(target=_legacy_server, daemon=True)
+  t.start()
+  c = remote.RemoteActorClient(f'127.0.0.1:{port}',
+                               connect_timeout_secs=10)
+  try:
+    assert c.send_leave() is False
+  finally:
+    c.close()
+    lis.close()
+    t.join(timeout=5)

@@ -310,6 +310,194 @@ def test_checkpoint_sharding_manifest_and_resharded_restore(tmp_path):
   ckpt.close()
 
 
+
+# --- elastic resharding edge cases (round 20) --------------------------
+
+
+def _abstract(state):
+  return jax.tree_util.tree_map(
+      lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+
+
+def test_layout_violations_name_the_structural_reason():
+  """The three refusal stories, each named: a spec axis the mesh does
+  not carry, a cut dim past the leaf's rank, and a dim that does not
+  divide the axis width."""
+  registry = sharding_lib.ShardingRegistry((
+      (r'.*rank$', P(None, None, sharding_lib.MODEL_AXIS)),
+      (r'.*odd$', P(None, sharding_lib.MODEL_AXIS)),
+      (r'.*', P()),
+  ), rule_set='layout-test')
+  from jax.sharding import Mesh
+  devs = np.array(jax.devices()[:2])
+  data_only = Mesh(devs, ('data',))
+  tp_mesh = Mesh(devs.reshape(1, 2), ('data', 'model'))
+  tree = {'a_rank': jnp.zeros((4, 4)),   # spec cuts dim 2, rank 2
+          'b_odd': jnp.zeros((4, 7)),    # 7 % 2 != 0
+          'c_fine': jnp.zeros((4, 4))}
+
+  on_tp = dict(registry.layout_violations(tree, tp_mesh))
+  assert set(on_tp) == {'a_rank', 'b_odd'}
+  assert 'rank' in on_tp['a_rank']
+  assert 'does not divide' in on_tp['b_odd']
+
+  # On a mesh with no model axis at all, every model cut is refused
+  # with the missing-axis story (checked before rank/width).
+  on_dp = dict(registry.layout_violations(tree, data_only))
+  assert set(on_dp) == {'a_rank', 'b_odd'}
+  assert "'model'" in on_dp['b_odd']
+
+
+def test_check_layout_exempts_leaves_saved_replicated():
+  """The manifest-aware exemption: a leaf the SAVE already degraded
+  to replicated (odd dims under `_guard`) must not refuse a restore —
+  the restore loses nothing the checkpoint still had."""
+  registry = sharding_lib.ShardingRegistry((
+      (r'.*odd$', P(None, sharding_lib.MODEL_AXIS)),
+      (r'.*', P()),
+  ), rule_set='layout-test')
+  mesh = mesh_lib.make_mesh(model_parallelism=2)
+  tree = {'w_odd': jnp.zeros((4, 7))}
+  with pytest.raises(sharding_lib.ShardingLayoutError, match='w_odd'):
+    registry.check_layout(tree, mesh, what='param')
+  # Recorded replicated at save: exempt, no raise.
+  registry.check_layout(tree, mesh, what='param',
+                        saved_specs={'w_odd': str(P())})
+  # Recorded SHARDED at save: the refusal stands.
+  with pytest.raises(sharding_lib.ShardingLayoutError,
+                     match='does not divide'):
+    registry.check_layout(
+        tree, mesh, what='param',
+        saved_specs={'w_odd': str(P(None, sharding_lib.MODEL_AXIS))})
+
+
+def test_restore_resharded_strict_refusal_and_escape(tmp_path):
+  """Checkpoint-level strict gate: a leaf saved SHARDED whose cut the
+  target topology cannot honor refuses with the structural error;
+  strict=False accepts the documented replicated degradation."""
+  registry = sharding_lib.ShardingRegistry((
+      (r'.*kernel$', P(None, sharding_lib.MODEL_AXIS)),
+      (r'.*', P()),
+  ), rule_set='layout-test')
+  params = {'Dense_0': {'kernel': jnp.ones((4, 6)),   # 6 % 2 == 0
+                        'bias': jnp.zeros((6,))}}
+  cfg = Config(batch_size=8)
+  state = learner_lib.make_train_state(params, cfg)
+  save_mesh = mesh_lib.make_mesh(model_parallelism=2)
+
+  ckpt = checkpoint_lib.Checkpointer(str(tmp_path / 'ckpt'),
+                                     save_interval_secs=0,
+                                     registry=registry, mesh=save_mesh)
+  assert ckpt.save(state, step=1)
+  ckpt.wait_until_finished()
+  manifest = ckpt.read_sharding_manifest(1)
+  assert (manifest['specs']['Dense_0/kernel'] ==
+          str(P(None, sharding_lib.MODEL_AXIS)))
+
+  # model=4 cannot honor the 6-wide cut (6 % 4 != 0): strict refuses
+  # with the leaf and the reason on the error.
+  target_mesh = mesh_lib.make_mesh(model_parallelism=4)
+  with pytest.raises(sharding_lib.ShardingLayoutError,
+                     match='Dense_0/kernel'):
+    ckpt.restore_resharded(_abstract(state), registry, target_mesh)
+
+  # Non-strict: the `_guard` degradation (replicated) is accepted —
+  # values intact, placement replicated on the NEW mesh.
+  restored = ckpt.restore_resharded(_abstract(state), registry,
+                                    target_mesh, strict=False)
+  assert restored is not None
+  kernel = restored.params['Dense_0']['kernel']
+  assert kernel.sharding.spec == P()
+  assert kernel.sharding.mesh.shape == target_mesh.shape
+  np.testing.assert_array_equal(np.asarray(kernel),
+                                np.asarray(params['Dense_0']['kernel']))
+  ckpt.close()
+
+
+def test_resharded_opt_state_follows_param_specs(tmp_path):
+  """Across topologies the optimizer moments land EXACTLY where their
+  params land (the round-19 cloning contract, now exercised by the
+  2→4 analogue): restore a model=2 checkpoint onto a model=4 mesh and
+  every param-shaped moment leaf carries the param's sharding."""
+  registry = sharding_lib.ShardingRegistry((
+      (r'.*kernel$', P(None, sharding_lib.MODEL_AXIS)),
+      (r'.*', P()),
+  ), rule_set='layout-test')
+  params = {'Dense_0': {'kernel': jnp.ones((4, 8)),   # 8 % 4 == 0
+                        'bias': jnp.zeros((8,))}}
+  cfg = Config(batch_size=8)
+  state = learner_lib.make_train_state(params, cfg)
+  save_mesh = mesh_lib.make_mesh(model_parallelism=2)
+  ckpt = checkpoint_lib.Checkpointer(str(tmp_path / 'ckpt'),
+                                     save_interval_secs=0,
+                                     registry=registry, mesh=save_mesh)
+  assert ckpt.save(state, step=1)
+  ckpt.wait_until_finished()
+
+  target_mesh = mesh_lib.make_mesh(model_parallelism=4)
+  restored = ckpt.restore_resharded(_abstract(state), registry,
+                                    target_mesh)
+  assert restored is not None
+  kernel_sh = restored.params['Dense_0']['kernel'].sharding
+  assert kernel_sh.spec == P(None, sharding_lib.MODEL_AXIS)
+  assert dict(kernel_sh.mesh.shape) == dict(target_mesh.shape)
+  # Every param-shaped moment subtree cloned the param placements.
+  pdef = jax.tree_util.tree_structure(restored.params)
+  expected = jax.tree_util.tree_map(lambda x: x.sharding,
+                                    restored.params)
+  moment_trees = [
+      sub for sub in jax.tree_util.tree_leaves(
+          restored.opt_state,
+          is_leaf=lambda x: jax.tree_util.tree_structure(x) == pdef
+          if not isinstance(x, jax.Array) else False)
+      if jax.tree_util.tree_structure(sub) == pdef]
+  assert moment_trees  # the rmsprop chain carries param-shaped moments
+  for sub in moment_trees:
+    got = jax.tree_util.tree_map(lambda x: x.sharding, sub)
+    assert (jax.tree_util.tree_leaves(got) ==
+            jax.tree_util.tree_leaves(expected))
+  # Counters stay replicated.
+  assert restored.update_steps.sharding.spec == P()
+  ckpt.close()
+
+
+def test_same_topology_restore_stays_byte_identical(tmp_path):
+  """Regression guard for the elastic gate: when the live mesh equals
+  the manifest's, the driver takes the UNCHANGED restore_latest path
+  and the restored bytes equal the saved bytes exactly."""
+  agent = ImpalaAgent(num_actions=A, torso='shallow')
+  params = init_params(agent, jax.random.PRNGKey(0), OBS)
+  cfg = Config(batch_size=8, model_parallelism=2)
+  mesh = mesh_lib.make_mesh(model_parallelism=2)
+  registry = sharding_lib.from_config(cfg)
+  state = train_parallel.make_sharded_train_state(params, cfg, mesh,
+                                                  registry=registry)
+  ckpt = checkpoint_lib.Checkpointer(str(tmp_path / 'ckpt'),
+                                     save_interval_secs=0,
+                                     registry=registry, mesh=mesh)
+  assert ckpt.save(state, step=3)
+  ckpt.wait_until_finished()
+
+  # The driver's gate reads the manifest's mesh: same topology →
+  # topology_delta None → restore_latest (no resharding detour).
+  from scalable_agent_tpu.parallel import distributed
+  assert ckpt.saved_mesh_shape() == {'data': 4, 'model': 2}
+  assert distributed.topology_delta(ckpt.saved_mesh_shape(),
+                                    mesh) is None
+  delta = distributed.topology_delta(
+      ckpt.saved_mesh_shape(), mesh_lib.make_mesh(model_parallelism=1))
+  assert delta is not None and delta['saved_mesh'] == {'data': 4,
+                                                       'model': 2}
+
+  restored = ckpt.restore_latest(state)
+  assert restored is not None
+  for a, b in zip(jax.tree_util.tree_leaves(restored),
+                  jax.tree_util.tree_leaves(state)):
+    assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+    assert a.sharding == b.sharding
+  ckpt.close()
+
+
 def test_spec_table_digest_is_content_addressed():
   specs = {'a/kernel': "PartitionSpec(None, 'model')",
            'b/bias': 'PartitionSpec()'}
@@ -324,6 +512,7 @@ def test_spec_table_digest_is_content_addressed():
 # --- the 2D {data, model} flagship parity gate -------------------------
 
 
+@pytest.mark.slow  # tier-1 wall trim (round 20); ci.sh full-suite lane runs it
 def test_2d_mesh_deep_agent_parity_gate():
   """The flagship on a real 2D mesh: the deep ResNet + LSTM agent
   (torso='deep', the reference architecture) trains 3 steps on a

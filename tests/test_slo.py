@@ -594,6 +594,7 @@ def test_clean_driver_run_all_pass_verdict_zero_captures(tmp_path):
       p.name.startswith('slo_') for p in diag.iterdir())
 
 
+@pytest.mark.slow  # tier-1 wall trim (round 20); ci.sh full-suite lane runs it
 def test_violating_run_fails_verdict_with_triggered_capture(tmp_path):
   """A page-severity burn mid-run lands the failing verdict AND all
   three capture artifacts (flight dump, trace slice, bounded profiler

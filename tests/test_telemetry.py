@@ -178,7 +178,12 @@ def test_tracer_remote_clock_uses_commit_version(tmp_path):
 def test_tracer_untagged_unrolls_counted(tmp_path):
   tracer = telemetry.PipelineTracer(str(tmp_path))
   try:
-    tracer.on_batch([_tiny_unroll(1)], n_fresh=1)  # never tagged
+    u = _tiny_unroll(1)  # never tagged
+    # The id-keyed sidecar documents one benign hazard: a freed
+    # unroll from an earlier test can leave a stale tag at this
+    # object's reused address. Drop any alias so 'never tagged' holds.
+    telemetry.pop_unroll(u)
+    tracer.on_batch([u], n_fresh=1)
     assert tracer.stats()['untagged_unrolls'] == 1
   finally:
     tracer.close()

@@ -15,6 +15,7 @@ from scalable_agent_tpu import observability as obs
 from scripts import to_tensorboard
 
 
+@pytest.mark.slow  # tier-1 wall trim (round 20); ci.sh full-suite lane runs it
 def test_scalars_and_histograms_round_trip(tmp_path):
   writer = obs.SummaryWriter(str(tmp_path))
   writer.scalar('loss/total', 1.5, step=1)

@@ -7,6 +7,8 @@ Pixel control is a TPU-build extension (SURVEY §2.12 — planned, not in
 the reference); ground truth is Jaderberg et al. 2017 §3.1.
 """
 
+import pytest
+
 import numpy as np
 
 import jax
@@ -177,6 +179,7 @@ def test_integer_rewards_auto_and_forced_paths():
     unreal.pixel_control_rewards(f32, 4, integer_path=True)
 
 
+@pytest.mark.slow  # tier-1 wall trim (round 20); ci.sh full-suite lane runs it
 def test_head_impl_golden_parity_fwd_and_grad():
   """`d2s` and `deconv` share ONE param tree (same names/shapes/init)
   and must produce the same Q-map AND the same gradients through it —
