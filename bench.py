@@ -2548,6 +2548,236 @@ def bench_mesh2d(smoke):
   }
 
 
+def bench_serving(smoke):
+  """The multi-tenant serving-plane instrument (round 21): price every
+  lever the serving PR added, so its defaults are accepted/rejected on
+  measurement (the repo's discipline).
+
+  Rows:
+  - codec: wire bytes f32/bf16/int8 (the publish fan-out payload),
+    int8 quantize/dequantize round-trip error, and the PARITY GATE —
+    greedy action agreement between fp32 serving and int8-resident
+    serving on identical inputs + identical RNG (the gate the int8
+    default flip will be judged by).
+  - publish blackout: update_params wall time per codec — int8 pays
+    an on-device quantize per publish; the row says what that costs.
+  - resident versions: an N=3-resident server under A/B traffic —
+    per-version serve counters prove ≥2 versions SERVED (not merely
+    stored).
+  - shadow: divergence gauge ~0.0 when the shadow IS the live params,
+    > 0 when the shadow is a different network (sanity both ways — a
+    gauge that can't move is not a gauge).
+  - version-flip blackout: first policy call after an int8 publish,
+    AOT-cold vs AOT-warm. The quantized tree changes leaf dtypes, so
+    the cold flip pays a full retrace ON the serve path; serving_aot
+    pre-compiles at publish time and the flip serves warm.
+  - routed: ServingRouter over two in-process replicas (channel =
+    serve_remote, no sockets — prices the ROUTER, not the wire), plus
+    a kill-one failover check.
+  """
+  import numpy as np
+  import jax
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.models import ImpalaAgent, init_params
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+  from scalable_agent_tpu.runtime import codec
+  from scalable_agent_tpu.runtime.inference import (InferenceServer,
+                                                    percentile_ms)
+  from scalable_agent_tpu.runtime.routing import ServingRouter
+  from scalable_agent_tpu.structs import StepOutput, StepOutputInfo
+
+  h, w = (72, 96) if not smoke else (24, 32)
+  torso = 'deep' if not smoke else 'shallow'
+  reps = 200 if not smoke else 30
+  batch = 8 if not smoke else 4
+  num_actions = 9
+  obs_spec = {'frame': (h, w, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+  agent = ImpalaAgent(num_actions=num_actions, torso=torso,
+                      use_instruction=False)
+  params = init_params(agent, jax.random.PRNGKey(0), obs_spec)
+  params_b = init_params(agent, jax.random.PRNGKey(1), obs_spec)
+  rng = np.random.RandomState(0)
+
+  def payload(server, b=batch):
+    sizes = [int(np.shape(c)[-1])
+             for c in server.initial_core_state()]
+    return {
+        'prev_action': rng.randint(0, num_actions, (b,)).astype(np.int32),
+        'reward': np.zeros((b,), np.float32),
+        'done': np.zeros((b,), np.bool_),
+        'frame': rng.randint(0, 255, (b, h, w, 3)).astype(np.uint8),
+        'instr': np.zeros((b, MAX_INSTRUCTION_LEN), np.int32),
+        'core_c': np.zeros((b, sizes[0]), np.float32),
+        'core_h': np.zeros((b, sizes[1]), np.float32),
+    }
+
+  def make_server(**over):
+    cfg = Config(inference_min_batch=0, inference_max_batch=max(16, batch),
+                 inference_timeout_ms=5, inference_state_cache=False,
+                 **over)
+    return InferenceServer(agent, params, cfg, seed=7, pad_batch_to=1,
+                           fleet_size=1)
+
+  results = {}
+
+  # --- codec rows: wire bytes, round-trip error, publish blackout.
+  f32_b, bf16_b, int8_b = codec.wire_sizes(jax.device_get(params))
+  q = codec.quantize_np(jax.device_get(params))
+  results['wire_bytes'] = {
+      'f32': f32_b, 'bf16': bf16_b, 'int8': int8_b,
+      'int8_vs_f32': round(int8_b / f32_b, 3),
+      'int8_vs_bf16': round(int8_b / bf16_b, 3),
+      'roundtrip_max_abs_err': float(codec.max_abs_error(q)),
+  }
+
+  def publish_blackout(codec_name):
+    server = make_server(publish_codec=codec_name)
+    times = []
+    for k in range(8 if smoke else 32):
+      fresh = jax.tree_util.tree_map(lambda a: a + 0, params)
+      t0 = time.perf_counter()
+      server.update_params(fresh, version=k + 1)
+      times.append(time.perf_counter() - t0)
+    server.close()
+    return {'p50_ms': round(percentile_ms(sorted(times), 0.5, 1e3), 2),
+            'p99_ms': round(percentile_ms(sorted(times), 0.99, 1e3), 2)}
+
+  results['publish_blackout'] = {name: publish_blackout(name)
+                                 for name in ('f32', 'int8')}
+
+  # --- parity gate: fp32 vs int8-resident serving, same inputs, same
+  # per-call RNG (both servers fold the same dedicated base key).
+  s_f32 = make_server()
+  s_int8 = make_server(publish_codec='int8')
+  s_f32.update_params(params, version=1)
+  s_int8.update_params(params, version=1)
+  pay = payload(s_f32)
+  out_a = s_f32.serve_remote(pay)
+  out_b = s_int8.serve_remote(pay)
+  results['int8_parity'] = {
+      'greedy_agreement': round(float(codec.greedy_agreement(
+          out_a['logits'], out_b['logits'])), 4),
+      'logits_max_abs_err': float(np.max(np.abs(
+          out_a['logits'] - out_b['logits']))),
+  }
+
+  # --- routed: two in-process replicas; price the router itself.
+  class _LocalChannel:
+    def __init__(self, server):
+      self._server = server
+      self.dead = False
+
+    def supports_infer(self):
+      return True
+
+    def remote_infer(self, req):
+      if self.dead:
+        raise ConnectionError('replica killed')
+      return self._server.serve_remote(req), {}
+
+    def close(self):
+      pass
+
+  channels = {'a:0': _LocalChannel(s_f32), 'b:0': _LocalChannel(s_int8)}
+  router = ServingRouter(['a:0', 'b:0'],
+                         connect_fn=lambda addr: channels[addr])
+  direct = []
+  for _ in range(reps):
+    t0 = time.perf_counter()
+    s_f32.serve_remote(pay)
+    direct.append(time.perf_counter() - t0)
+  routed = []
+  for _ in range(reps):
+    t0 = time.perf_counter()
+    router.infer(pay)
+    routed.append(time.perf_counter() - t0)
+  channels['a:0'].dead = True
+  # Several requests so the rotation is GUARANTEED to pick the dead
+  # replica at least once — the row must price the failover path, not
+  # a lucky pick of the survivor.
+  survived = all(router.infer(pay) is not None for _ in range(4))
+  rstats = router.stats()
+  router.close()
+  results['routed'] = {
+      'direct_p50_ms': round(percentile_ms(sorted(direct), 0.5, 1e3), 3),
+      'routed_p50_ms': round(percentile_ms(sorted(routed), 0.5, 1e3), 3),
+      'failover_survived': bool(survived),
+      'route_failovers': rstats['route_failovers'],
+      'serves': {r['address']: r['serves'] for r in rstats['replicas']},
+  }
+  s_f32.close()
+  s_int8.close()
+
+  # --- resident versions under A/B + shadow traffic.
+  server = make_server(serving_resident_versions=3,
+                       serving_ab_fraction=0.25,
+                       serving_shadow_fraction=1.0)
+  server.update_params(params, version=1)
+  server.update_params(jax.tree_util.tree_map(lambda a: a + 0, params),
+                       version=2)  # live v2, shadow auto = v1 (equal)
+  frame = rng.randint(0, 255, (h, w, 3)).astype(np.uint8)
+  instr = np.zeros((MAX_INSTRUCTION_LEN,), np.int32)
+
+  def drive_policy(n):
+    state = server.initial_core_state()
+    prev = np.int32(0)
+    for step in range(n):
+      env_out = StepOutput(
+          reward=np.float32(0.0),
+          info=StepOutputInfo(np.float32(0), np.int32(0)),
+          done=np.bool_(False),
+          observation=(frame, instr))
+      out, state = server.policy(prev, env_out, state)
+      prev = np.int32(out.action)
+
+  drive_policy(reps)
+  div_equal = server.stats()['shadow_divergence']
+  server.update_params(params_b, version=3)  # live v3, shadow = v2
+  drive_policy(reps)
+  snap = server.stats()
+  results['resident'] = {
+      'resident_versions': snap['resident_versions'],
+      'live_version': snap['live_version'],
+      'serve_counts': snap['serve_counts'],
+      'ab_calls': snap['ab_calls'],
+      'shadow_calls': snap['shadow_calls'],
+      'shadow_divergence_equal': div_equal,
+      'shadow_divergence_different': snap['shadow_divergence'],
+  }
+  server.close()
+
+  # --- version-flip blackout: int8 publish flips the resident leaf
+  # dtypes; cold pays the retrace on the first serve, warm (AOT
+  # pre-compile at publish) does not.
+  def flip_blackout(aot):
+    server = make_server(publish_codec='int8', serving_aot=aot)
+    server.warmup(obs_spec, sizes=[1])
+    times = []
+    for k in range(3):
+      server.update_params(
+          jax.tree_util.tree_map(lambda a: a + 0, params_b),
+          version=k + 1)
+      t0 = time.perf_counter()
+      state = server.initial_core_state()
+      env_out = StepOutput(
+          reward=np.float32(0.0),
+          info=StepOutputInfo(np.float32(0), np.int32(0)),
+          done=np.bool_(False),
+          observation=(frame, instr))
+      server.policy(np.int32(0), env_out, state)
+      times.append((time.perf_counter() - t0) * 1e3)
+    stats = server.stats()
+    server.close()
+    return {'first_flip_ms': round(times[0], 2),
+            'steady_p99_ms': round(max(times[1:]), 2),
+            'aot_misses': stats['aot_misses'],
+            'aot_compiled': stats['aot_compiled']}
+
+  results['flip_blackout'] = {'cold': flip_blackout(False),
+                              'warm': flip_blackout(True)}
+  return results
+
+
 def main():
   # Child half of the multihost stage: a fresh interpreter dispatched
   # by bench_multihost — must run before any jax/backend setup below.
@@ -2709,6 +2939,21 @@ def main():
     })
     return
 
+  # BENCH_ONLY=serving: just the multi-tenant serving-plane rows (the
+  # scripts/ci.sh serving lane — resident versions, int8 parity +
+  # wire bytes, flip blackout AOT warm/cold, router overhead).
+  if os.environ.get('BENCH_ONLY') == 'serving':
+    serving = bench_serving(smoke)
+    _emit({
+        'metric': 'serving_int8_greedy_agreement',
+        'value': serving['int8_parity']['greedy_agreement'],
+        'unit': ('argmax action agreement, int8-resident vs fp32 '
+                 'serving, identical inputs+RNG%s'
+                 % (' (SMOKE)' if smoke else '')),
+        'serving': serving,
+    })
+    return
+
   rows = bench_synthetic(smoke)
   cfg = rows['config']
   stats = rows['synthetic']
@@ -2753,6 +2998,9 @@ def main():
   mesh2d_rows = None
   if os.environ.get('BENCH_SKIP_MESH2D') != '1':
     mesh2d_rows = bench_mesh2d(smoke)
+  serving_rows = None
+  if os.environ.get('BENCH_SKIP_SERVING') != '1':
+    serving_rows = bench_serving(smoke)
 
   baseline_per_chip = 200_000.0 / 16.0  # north star / v5e-16 chips
   out = {
@@ -2804,6 +3052,8 @@ def main():
     out['multihost'] = mh_rows
   if mesh2d_rows is not None:
     out['mesh2d'] = mesh2d_rows
+  if serving_rows is not None:
+    out['serving'] = serving_rows
   _emit(out)
 
 

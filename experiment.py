@@ -373,10 +373,14 @@ flags.DEFINE_integer('replay_max_staleness',
                      'admission; this gates re-serving). 0 = defer '
                      'to max_unroll_staleness; both 0 = no bound.')
 flags.DEFINE_enum('publish_codec', _DEFAULTS.publish_codec,
-                  ['bf16', 'f32'],
+                  ['bf16', 'f32', 'int8'],
                   'Wire codec for served param snapshots: bf16 '
                   '(default) halves learner weight egress, actors '
-                  'upcast on receipt; f32 ships exact float32.')
+                  'upcast on receipt; f32 ships exact float32; int8 '
+                  'absmax-quantizes (runtime/codec.py, wire v10 — '
+                  'v<=9 peers still get bf16) and stores resident '
+                  'serving versions quantized. Parity-gated on '
+                  'greedy action agreement (bench serving stage).')
 flags.DEFINE_integer('ingest_workers', _DEFAULTS.ingest_workers,
                      'Validate/commit workers behind the remote-'
                      'ingest reader threads (0 = auto).')
@@ -510,6 +514,38 @@ flags.DEFINE_bool('lock_order_check', _DEFAULTS.lock_order_check,
                   'analysis/lock_cycles counter. Default off in '
                   'production; tests/chaos run armed '
                   '(docs/STATIC_ANALYSIS.md).')
+flags.DEFINE_integer('serving_resident_versions',
+                     _DEFAULTS.serving_resident_versions,
+                     'Policy versions resident concurrently in the '
+                     'inference version table (1 = the classic '
+                     'single snapshot). Re-publishing a resident '
+                     'version flips live without a tree copy; LRU '
+                     'eviction spares pinned + live entries.')
+flags.DEFINE_float('serving_hbm_budget_mb',
+                   _DEFAULTS.serving_hbm_budget_mb,
+                   'Optional byte budget (MB) over resident serving '
+                   'versions; 0 = count cap only.')
+flags.DEFINE_float('serving_ab_fraction',
+                   _DEFAULTS.serving_ab_fraction,
+                   'Fraction of merged inference calls served by the '
+                   'A/B candidate version (newest non-live resident '
+                   'unless set_ab pins one).')
+flags.DEFINE_float('serving_shadow_fraction',
+                   _DEFAULTS.serving_shadow_fraction,
+                   'Fraction of merged calls also replayed against '
+                   'the shadow version (pure step, no RNG/arena '
+                   'effects) and scored on greedy agreement into '
+                   'the serving/shadow_divergence gauge.')
+flags.DEFINE_bool('serving_aot', _DEFAULTS.serving_aot,
+                  'Pre-compile serving steps per (batch bucket, '
+                  'params structure) at publish/warmup so a version '
+                  'flip never pays first-call compile on the serve '
+                  'path. Off pending chip rows (docs/PERF.md).')
+flags.DEFINE_string('serving_replicas', _DEFAULTS.serving_replicas,
+                    'Comma-separated learner replica addresses an '
+                    'actor host routes inference over (wire v10 '
+                    'health-weighted round-robin; drains on leave). '
+                    "'' = host-local inference.")
 flags.DEFINE_bool('health_watchdog', _DEFAULTS.health_watchdog,
                   'Learner failure domain (health.py): skip '
                   'non-finite updates on device, roll back to the '

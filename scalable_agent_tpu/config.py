@@ -575,6 +575,40 @@ class Config:
   # passes this flag and asserts zero cycles), so every storm doubles
   # as a race hunt. ---
   lock_order_check: bool = False
+  # --- Multi-tenant serving plane (round 21; docs/INFERENCE.md). ---
+  # Policy versions resident concurrently in the InferenceServer's
+  # version table. 1 (default) reproduces the single-snapshot
+  # behaviour exactly; >1 keeps older publishes resident (LRU
+  # eviction of unpinned non-live entries) so a re-publish of a
+  # resident version flips live WITHOUT a tree copy — the rollback/
+  # A/B substrate.
+  serving_resident_versions: int = 1
+  # Optional byte budget over resident entries, MB (0 = count cap
+  # only). Eviction honours pins and never evicts the live entry.
+  serving_hbm_budget_mb: float = 0.0
+  # Fraction of merged inference calls served by the A/B candidate
+  # (the newest non-live resident, or set_ab's explicit version).
+  # Granularity is the MERGED call — the C++ batcher folds many
+  # actors into one call, so per-request assignment does not exist at
+  # this layer.
+  serving_ab_fraction: float = 0.0
+  # Fraction of merged calls ALSO replayed against the shadow version
+  # through a pure step (no key chain, no arena writes) and scored on
+  # greedy action agreement vs live — the serving/shadow_divergence
+  # gauge. Costs one extra forward per sampled call.
+  serving_shadow_fraction: float = 0.0
+  # Pre-compile serving steps per (batch bucket, params structure) at
+  # publish/warmup time (the jit lower/compile AOT seam) so a version
+  # flip or warmed bucket never pays first-call compile on the serve
+  # path. DEFAULT OFF pending chip rows per the docs/PERF.md
+  # accept/reject discipline (bench.py serving stage measures the
+  # flip-blackout delta every round).
+  serving_aot: bool = False
+  # Comma-separated learner replica addresses ('host:port,...') an
+  # actor host routes inference over (runtime/routing.py: health-
+  # weighted round-robin, drain on leave, wire v10). '' = no routed
+  # serving (params are fetched and inference stays host-local).
+  serving_replicas: str = ''
 
   @property
   def frames_per_step(self):
@@ -593,8 +627,12 @@ class Config:
       return 'bfloat16'
     if self.publish_codec == 'f32':
       return ''
+    if self.publish_codec == 'int8':
+      # Round 21: absmax-int8 wire blobs (runtime/codec.py), protocol
+      # v10 — v<=9 subscribers are negotiated down to bf16 blobs.
+      return 'int8'
     raise ValueError(
-        f"publish_codec must be 'bf16' or 'f32', got "
+        f"publish_codec must be 'bf16', 'f32' or 'int8', got "
         f'{self.publish_codec!r}')
 
   @property
@@ -1134,6 +1172,38 @@ def validate_distributed(config: Config,
         'mutates params OUTSIDE the collective train step, so hosts '
         'with different idle patterns would diverge — the driver '
         'disables it (supports_filler) and parks idle slices instead')
+  return warnings
+
+
+def validate_serving(config: Config) -> List[str]:
+  """Validate the multi-tenant serving knob group (round 21); raises
+  ValueError on hard errors, returns warnings (same contract as the
+  other validate_* groups — driver.train and run_remote_actor call it
+  before spin-up)."""
+  warnings = []
+  if config.serving_resident_versions < 1:
+    raise ValueError(f'serving_resident_versions must be >= 1, got '
+                     f'{config.serving_resident_versions}')
+  if config.serving_hbm_budget_mb < 0:
+    raise ValueError(f'serving_hbm_budget_mb must be >= 0, got '
+                     f'{config.serving_hbm_budget_mb}')
+  for name in ('serving_ab_fraction', 'serving_shadow_fraction'):
+    value = getattr(config, name)
+    if not 0.0 <= value <= 1.0:
+      raise ValueError(f'{name} must be in [0, 1], got {value}')
+  if (config.serving_resident_versions == 1
+      and (config.serving_ab_fraction > 0
+           or config.serving_shadow_fraction > 0)):
+    warnings.append(
+        'serving_ab_fraction/serving_shadow_fraction > 0 with '
+        'serving_resident_versions=1: there is never a non-live '
+        'resident candidate, so A/B and shadow traffic will not fire '
+        '— raise serving_resident_versions')
+  if config.serving_replicas and not config.learner_address:
+    warnings.append(
+        'serving_replicas set without learner_address: routed '
+        'inference replicas are an ACTOR-host knob — the learner '
+        'role ignores it')
   return warnings
 
 

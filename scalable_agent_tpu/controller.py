@@ -263,6 +263,18 @@ DEFAULT_RULES = (
          clear_margin=0.05,
          description='producers fully parked: request a smaller pod '
                      '(PAL shrink direction, arXiv 2110.01101)'),
+    # Serving-plane overload (round 21): the multi-tenant serving
+    # latency objective burning means the shared inference step is
+    # saturated — by local batcher traffic, routed v10 batches, or
+    # both. Same response as the unroll-latency rule and through the
+    # SAME actuator (per-actuator ownership keeps the two rules from
+    # fighting: whichever burns first holds the cooldown): shed
+    # admissions instead of queueing them.
+    Rule(objective='serving_latency_p99_ms', actuator='admission',
+         to='shed', revert_to='block', cooldown_secs=120.0,
+         clear_margin=10000.0,
+         description='serving-plane overload: flip admission '
+                     'block->shed'),
 )
 
 

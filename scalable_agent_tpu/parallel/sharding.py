@@ -436,3 +436,37 @@ def data_sharding(mesh: Mesh) -> NamedSharding:
   """Leading-dim data-axis placement (inference batch rows, SDC probe
   vectors)."""
   return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def quantized_specs(quantized_tree, plain_specs):
+  """Specs for an int8-quantized param tree (round 21 publish codec),
+  cloned from the PLAIN tree's registry specs: each `codec.Int8Leaf`
+  keeps the original leaf's spec on `q` (same shape, so the rule that
+  matched the f32 leaf is still the right placement) and replicates
+  the scalar `scale` — the codec stays inside the registry's
+  one-source-of-truth contract instead of inventing placements.
+
+  `quantized_tree` is the encoded tree (Int8Leaf nodes where f32
+  leaves were); `plain_specs` is `registry.param_specs(params)` over
+  the ORIGINAL tree. Registry rules key on the plain tree's paths, so
+  the clone — not a re-match against the deeper quantized paths — is
+  what keeps regex rules working unchanged."""
+  from scalable_agent_tpu.runtime import codec
+
+  def one(leaf, spec):
+    if isinstance(leaf, codec.Int8Leaf):
+      return codec.Int8Leaf(spec, P())
+    return spec
+
+  return jax.tree_util.tree_map(
+      one, quantized_tree, plain_specs,
+      is_leaf=lambda x: isinstance(x, codec.Int8Leaf))
+
+
+def quantized_shardings(quantized_tree, plain_specs, mesh: Mesh):
+  """`quantized_specs` resolved to NamedShardings on `mesh` (the
+  device_put placement of an int8-resident version-table entry on a
+  sharded serving mesh)."""
+  return jax.tree_util.tree_map(
+      lambda spec: NamedSharding(mesh, spec),
+      quantized_specs(quantized_tree, plain_specs))

@@ -73,6 +73,33 @@ waiter directly, so eval/respawn churn can never starve live actor
 traffic. `close()` answers every parked waiter with `InferenceClosed`
 (never leaves them blocked forever) and counts worker threads that
 missed their join deadline (stats()['unjoined_threads']).
+
+Round 21 (multi-tenant serving plane, docs/INFERENCE.md): the single
+resident params snapshot generalizes to a VERSION TABLE —
+`config.serving_resident_versions` policy versions resident
+concurrently (LRU eviction of unpinned, non-live entries under the
+count cap and the optional `serving_hbm_budget_mb` byte budget), with
+per-version serve counters, A/B assignment
+(`serving_ab_fraction` of merged calls served by the newest non-live
+candidate — assignment is at merged-call granularity because the C++
+batcher merges rows from many actors into one call), and SHADOW
+traffic: `serving_shadow_fraction` of merged calls are ALSO replayed
+against a shadow version through a PURE step (no key chain, no arena
+scatter) and scored against live on GREEDY action agreement — the
+`serving/shadow_divergence` gauge (sampled actions would differ by
+RNG alone, so only argmax isolates the version delta). A version
+re-published while still resident flips live WITHOUT a tree copy
+(stats()['version_flips']); `publish_codec=int8` stores table entries
+quantized (runtime/codec.py — ~4x more resident versions per byte,
+dequantized in-graph by the serving step). `serving_aot=True`
+pre-compiles serving steps per (batch-bucket, params-structure) at
+publish time via the jit lower/compile seam (parallel/fit.py's AOT
+pattern), so a version flip to a new dtype structure — or a warmed
+bucket under a flipped structure — never pays first-call compile on
+the serve path (misses fall back to the jit cache and count
+stats()['aot_misses']). `serve_remote` serves carry-passing batches
+from the same table for the wire-v10 routed inference service
+(runtime/routing.py).
 """
 
 import collections
@@ -86,14 +113,28 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from scalable_agent_tpu import telemetry
 from scalable_agent_tpu.analysis.runtime import guarded_by, make_lock
 from scalable_agent_tpu.observability import LatencyReservoir
 from scalable_agent_tpu.ops import dynamic_batching
+from scalable_agent_tpu.runtime import codec as codec_lib
 from scalable_agent_tpu.runtime import faults as faults_lib
 from scalable_agent_tpu.runtime.remote import Backoff
 from scalable_agent_tpu.structs import AgentOutput, StepOutput
 
 log = logging.getLogger('scalable_agent_tpu')
+
+# Serving-plane telemetry (round 21; docs/OBSERVABILITY.md inventory).
+# Merged-call service latency also feeds the serving_latency_p99_ms
+# SLO objective — admission is its actuator (controller.DEFAULT_RULES).
+_SERVE_LATENCY = telemetry.histogram('serving/latency_ms')
+_SHADOW_DIVERGENCE = telemetry.gauge('serving/shadow_divergence')
+_SHADOW_CALLS = telemetry.counter('serving/shadow_calls')
+_AB_CALLS = telemetry.counter('serving/ab_calls')
+_EVICTIONS = telemetry.counter('serving/evictions')
+_VERSION_FLIPS = telemetry.counter('serving/version_flips')
+_RESIDENT_VERSIONS = telemetry.gauge('serving/resident_versions')
+_AOT_MISSES = telemetry.counter('serving/aot_misses')
 
 # Admission priority classes (lower = served first): a released slot
 # is handed to the best-priority parked waiter, so background churn
@@ -143,6 +184,31 @@ def _next_power_of_two(n):
   while p < n:
     p *= 2
   return p
+
+
+def _tree_nbytes(tree):
+  """Leaf-byte total WITHOUT a device transfer (jax and numpy arrays
+  both expose .nbytes) — the version table's HBM-budget accounting
+  runs on every publish, so it must not device_get the tree."""
+  total = 0
+  for leaf in jax.tree_util.tree_leaves(tree):
+    nbytes = getattr(leaf, 'nbytes', None)
+    if nbytes is None:
+      nbytes = np.asarray(leaf).nbytes
+    total += int(nbytes)
+  return total
+
+
+def _params_fingerprint(params):
+  """Hashable structure key for the AOT executable table: treedef +
+  per-leaf dtypes. Two versions with the same fingerprint share
+  compiled steps (the common case: every fp32 publish); an int8
+  publish (Int8Leaf nodes change the treedef AND the dtypes) maps to
+  its own executables."""
+  leaves, treedef = jax.tree_util.tree_flatten(params)
+  return (treedef,
+          tuple(str(getattr(l, 'dtype', type(l).__name__))
+                for l in leaves))
 
 
 def percentile_ms(sorted_secs_or_ms, q, scale=1.0):
@@ -200,6 +266,33 @@ class _SlotHandle:
             f'released={self.released})')
 
 
+class _VersionEntry:
+  """One resident policy version in the serving table: the (owned,
+  possibly int8-quantized) params copy, its publish key, the pin
+  flag eviction honours, the per-version serve counter, its leaf
+  bytes (the HBM-budget accounting) and the LRU tick."""
+
+  __slots__ = ('key', 'params', 'pinned', 'serves', 'nbytes', 'tick')
+
+  def __init__(self, key, params, nbytes, tick):
+    self.key = key
+    self.params = params
+    self.pinned = False
+    self.serves = 0
+    self.nbytes = nbytes
+    self.tick = tick
+
+  def label(self):
+    """Stable stats() key: the numeric publish version, 'anon-N' for
+    None-version publishes (the dedup-less always-publish path), or
+    '<seed>' for the constructor's by-reference sentinel entry."""
+    if isinstance(self.key, int):
+      return self.key
+    if isinstance(self.key, tuple) and self.key and self.key[0] == 'anon':
+      return f'anon-{self.key[1]}'
+    return '<seed>'
+
+
 class InferenceServer:
   """Serves a batched policy for host actor threads.
 
@@ -235,8 +328,25 @@ class InferenceServer:
   # _stats_lock (the admission path), _key_lock -> _arena_lock
   # (dispatch), _params_lock -> _stats_lock (publish-skip). Nothing
   # takes _slot_lock after any other lock.
-  _params: guarded_by('_params_lock')
-  _published_version_key: guarded_by('_params_lock')
+  # Round 21: the version table and its A/B + shadow assignment state
+  # live under _params_lock (the picker runs where the old single-
+  # snapshot read ran); the AOT executable table under _aot_lock; the
+  # routed-serving key counter under _remote_lock. None of the new
+  # locks nests inside (or outside) another serving lock.
+  _versions: guarded_by('_params_lock')
+  _live_key: guarded_by('_params_lock')
+  _serve_tick: guarded_by('_params_lock')
+  _anon_seq: guarded_by('_params_lock')
+  _ab_fraction: guarded_by('_params_lock')
+  _ab_key: guarded_by('_params_lock')
+  _ab_acc: guarded_by('_params_lock')
+  _shadow_fraction: guarded_by('_params_lock')
+  _shadow_key: guarded_by('_params_lock')
+  _shadow_acc: guarded_by('_params_lock')
+  _aot: guarded_by('_aot_lock')
+  _warm_meta: guarded_by('_aot_lock')
+  _warm_buckets: guarded_by('_aot_lock')
+  _remote_calls: guarded_by('_remote_lock')
   _key: guarded_by('_key_lock')
   _arena: guarded_by('_arena_lock')
   _free: guarded_by('_slot_lock')
@@ -262,6 +372,12 @@ class InferenceServer:
   _unjoined_threads: guarded_by('_stats_lock')
   _latencies: guarded_by('_stats_lock')
   _chain_recoveries: guarded_by('_stats_lock')
+  _version_flips: guarded_by('_stats_lock')
+  _evictions: guarded_by('_stats_lock')
+  _ab_calls: guarded_by('_stats_lock')
+  _shadow_calls: guarded_by('_stats_lock')
+  _shadow_divergence: guarded_by('_stats_lock')
+  _aot_misses: guarded_by('_stats_lock')
 
   def __init__(self, agent, params, config, seed=0, mesh=None,
                pad_batch_to=None, fleet_size=None):
@@ -301,12 +417,59 @@ class InferenceServer:
       params = jax.device_put(params, self._replicated)
     else:
       self._dp = 1
-    self._params = params
     self._params_lock = make_lock('inference._params_lock')
-    # Sentinel: never equal to any caller-supplied publish version, so
-    # the first update_params always lands (see update_params).
-    self._published_version_key = object()
+    # --- Serving version table (round 21; module docstring). The
+    # constructor's params enter BY REFERENCE under a sentinel key no
+    # caller-supplied version can equal, so the FIRST update_params
+    # always lands a fresh owned copy (donation safety — see
+    # update_params; the sentinel is process memory on purpose and
+    # does NOT survive a checkpoint restore, tests/test_serving.py
+    # pins why).
+    self._resident_cap = max(1, int(
+        getattr(config, 'serving_resident_versions', 1)))
+    self._hbm_budget_bytes = int(
+        float(getattr(config, 'serving_hbm_budget_mb', 0.0)) * 1e6)
+    self._quantize_resident = (
+        getattr(config, 'publish_codec', 'bf16') == 'int8')
+    self._serve_tick = 0
+    self._anon_seq = 0
+    self._versions = collections.OrderedDict()
+    seed_key = object()
+    self._live_key = seed_key
+    self._versions[seed_key] = _VersionEntry(
+        seed_key, params, _tree_nbytes(params), 0)
+    # A/B + shadow assignment (merged-call granularity — the batcher
+    # merges many actors into one call, so per-request assignment
+    # does not exist at this layer).
+    self._ab_fraction = float(
+        getattr(config, 'serving_ab_fraction', 0.0))
+    self._ab_key = None      # None = auto: newest non-live resident
+    self._ab_acc = 0.0
+    self._shadow_fraction = float(
+        getattr(config, 'serving_shadow_fraction', 0.0))
+    self._shadow_key = None  # None = auto: newest non-live resident
+    self._shadow_acc = 0.0
+    # Per-bucket AOT serving executables (round 21): (padded bucket,
+    # params-structure fingerprint) -> compiled step. Populated by
+    # _precompile_params at publish/warmup time; _dispatch falls back
+    # to the jit cache (and counts the miss) when absent.
+    self._serving_aot = bool(getattr(config, 'serving_aot', False))
+    self._aot_lock = make_lock('inference._aot_lock')
+    self._aot = {}
+    self._warm_meta = None
+    self._warm_buckets = set()
+    # Routed-serving (wire v10) RNG: a dedicated per-call fold chain,
+    # so cross-host requests never perturb the local fleet's key.
+    self._remote_lock = make_lock('inference._remote_lock')
+    self._remote_calls = 0
+    self._remote_base_key = jax.random.PRNGKey(seed + 424_243)
     self._stats_lock = make_lock('inference._stats_lock')
+    self._version_flips = 0
+    self._evictions = 0
+    self._ab_calls = 0
+    self._shadow_calls = 0
+    self._shadow_divergence = 0.0
+    self._aot_misses = 0
     self._calls = 0
     self._merged_requests = 0
     self._params_version = 0
@@ -368,6 +531,11 @@ class InferenceServer:
 
     def _apply(params, sub, prev_action, reward, done, frame, instr,
                core_c, core_h):
+      # Int8-resident versions (publish_codec=int8) dequantize HERE,
+      # in-graph: XLA fuses the per-leaf multiply into the step, so
+      # serving a quantized version costs no host round trip. Identity
+      # for plain trees.
+      params = codec_lib.dequantize_tree(params)
       env_output = StepOutput(
           reward=reward[None], info=None, done=done[None],
           observation=(frame[None], instr[None]))
@@ -423,6 +591,39 @@ class InferenceServer:
             (self._batch_sharding,) * 5
       self._step = jax.jit(step, in_shardings=in_shardings,
                            out_shardings=out_shardings)
+
+    # Shadow step (round 21): PURE — no key split chained back, no
+    # arena scatter — so replaying a merged call against a shadow
+    # version can never perturb the live fleet's RNG stream or
+    # carries. Scored on GREEDY agreement downstream, so the fixed
+    # sample key is irrelevant to the gauge.
+    def shadow_carry(params, prev_action, reward, done, frame, instr,
+                     core_c, core_h):
+      sub = jax.random.PRNGKey(0)
+      _, logits, _, _, _ = _apply(params, sub, prev_action, reward,
+                                  done, frame, instr, core_c, core_h)
+      return logits
+
+    def shadow_cache(params, arena_c, arena_h, slot_ids, prev_action,
+                     reward, done, frame, instr):
+      sub = jax.random.PRNGKey(0)
+      core_c = arena_c[slot_ids]
+      core_h = arena_h[slot_ids]
+      _, logits, _, _, _ = _apply(params, sub, prev_action, reward,
+                                  done, frame, instr, core_c, core_h)
+      return logits
+
+    self._shadow_step = jax.jit(
+        shadow_cache if self._state_cache else shadow_carry)
+    # Routed-serving step (serve_remote): always carry-passing — the
+    # remote caller owns its carry; a cross-host request must never
+    # consume a local arena slot.
+    self._remote_step = jax.jit(carry_step)
+    # AOT lower/compile inputs (see _precompile_params): the key's
+    # spec is fixed at construction; _step is the jit object lowered.
+    self._key_spec = jax.ShapeDtypeStruct(
+        np.shape(jax.random.PRNGKey(0)),
+        np.asarray(jax.random.PRNGKey(0)).dtype)
 
     # --- Pipelined dispatch plane: the C++ batcher merges concurrent
     # policy() calls; the dispatch thread copies each merged batch
@@ -593,6 +794,12 @@ class InferenceServer:
       self._arena = arena
       self._num_slots = new
     self._free.extend(range(old, new))
+    # Cache-mode AOT executables bake the arena shape into their
+    # compiled programs — all stale after a grow. Drop them; the next
+    # publish/warmup repopulates at the new shape. Lock order:
+    # _slot_lock -> _aot_lock (this path only).
+    with self._aot_lock:
+      self._aot.clear()
     with self._stats_lock:
       self._arena_grows += 1
     log.warning(
@@ -658,21 +865,49 @@ class InferenceServer:
     self._staging_calls[padded] += 1
     return ring[i]
 
-  def _dispatch(self, params, inputs):
+  def _aot_lookup(self, params, inputs):
+    """The pre-compiled serving executable for this (padded bucket,
+    params structure), or None — in which case _dispatch falls back to
+    the jit cache and the miss is counted (a miss on the serve path is
+    exactly the first-call compile stall the AOT table exists to
+    remove)."""
+    padded = int(np.shape(inputs[0])[0])
+    k = (padded, _params_fingerprint(params))
+    with self._aot_lock:
+      compiled = self._aot.get(k)
+    if compiled is None:
+      with self._stats_lock:
+        self._aot_misses += 1
+      _AOT_MISSES.inc()
+    return compiled
+
+  def _dispatch(self, params, inputs, shadow_params=None):
     """Dispatch one padded batch through the jitted step, chaining the
     device-resident key (and arena) — returns the (async) caller-
-    visible output arrays."""
+    visible output arrays plus the shadow version's logits (or None).
+    The shadow step runs BEFORE the live step so both read the same
+    pre-step arena carries."""
     step = self._step  # read per call: tests monkeypatch it
+    compiled = (self._aot_lookup(params, inputs)
+                if self._serving_aot else None)
+    fn = compiled if compiled is not None else step
     with self._key_lock:
       if self._state_cache:
         with self._arena_lock:
-          outs = step(params, self._key, *self._arena, *inputs)
+          shadow_out = None
+          if shadow_params is not None:
+            shadow_out = self._shadow_step(
+                shadow_params, *self._arena, *inputs)
+          outs = fn(params, self._key, *self._arena, *inputs)
           self._key = outs[0]
           self._arena = (outs[1], outs[2])
-          return outs[3:]
-      outs = step(params, self._key, *inputs)
+          return outs[3:], shadow_out
+      shadow_out = None
+      if shadow_params is not None:
+        shadow_out = self._shadow_step(shadow_params, *inputs)
+      outs = fn(params, self._key, *inputs)
       self._key = outs[0]
-      return outs[1:]
+      return outs[1:], shadow_out
 
   def _dispatch_loop(self):
     while True:
@@ -707,7 +942,8 @@ class InferenceServer:
           self._calls += 1
           self._merged_requests += n
         with self._params_lock:
-          params = self._params
+          params, _ = self._pick_live_locked()
+          shadow_params = self._pick_shadow_locked()
         inputs = tuple(bufs)
         if self._mesh is not None:
           # Explicit placement: under multi-process JAX, jit refuses
@@ -717,7 +953,8 @@ class InferenceServer:
           inputs = jax.device_put(inputs, self._batch_sharding)
         self._sem.acquire()
         try:
-          payload = self._dispatch(params, inputs)
+          payload, shadow_out = self._dispatch(
+              params, inputs, shadow_params)
           with self._stats_lock:
             self._inflight += 1
             self._inflight_peak = max(self._inflight_peak,
@@ -725,7 +962,7 @@ class InferenceServer:
         except BaseException:
           self._sem.release()
           raise
-        self._completion_q.put((batch_id, n, t0, payload))
+        self._completion_q.put((batch_id, n, t0, payload, shadow_out))
       except Exception as e:  # propagate to the parked callers
         self._batcher.set_error(batch_id, f'{type(e).__name__}: {e}')
 
@@ -734,7 +971,7 @@ class InferenceServer:
       item = self._completion_q.get()
       if item is None:
         return
-      batch_id, n, t0, payload = item
+      batch_id, n, t0, payload, shadow_out = item
       try:
         # Observability for the sharded-eval contract: how many
         # devices the last merged call actually spanned (read before
@@ -750,20 +987,49 @@ class InferenceServer:
         host = jax.device_get(payload)
         self._batcher.set_outputs(
             batch_id, [np.asarray(o)[:n] for o in host])
+        if shadow_out is not None:
+          # Shadow scoring AFTER the callers are answered: the gauge
+          # must never add device_get latency to the live path. Logits
+          # sit at payload index 1 in both step modes.
+          try:
+            live_logits = np.asarray(host[1])[:n]
+            shadow_logits = np.asarray(jax.device_get(shadow_out))[:n]
+            divergence = 1.0 - codec_lib.greedy_agreement(
+                live_logits, shadow_logits)
+            with self._stats_lock:
+              self._shadow_calls += 1
+              if self._shadow_calls == 1:
+                self._shadow_divergence = divergence
+              else:
+                # EWMA: the gauge tracks RECENT divergence, so a
+                # shadow flip mid-run shows up within ~10 samples.
+                self._shadow_divergence = (
+                    0.9 * self._shadow_divergence + 0.1 * divergence)
+              ewma = self._shadow_divergence
+            _SHADOW_CALLS.inc()
+            _SHADOW_DIVERGENCE.set(ewma)
+          except Exception:
+            log.exception('inference: shadow scoring failed')
       except Exception as e:
-        try:
-          self._batcher.set_error(batch_id, f'{type(e).__name__}: {e}')
-        except Exception:
-          pass
         # A failed execution poisons everything CHAINED from its
         # outputs — the device key, and in cache mode the arena —
-        # which _dispatch already swapped in. Re-anchor them, so one
-        # transient device failure fails THIS batch's callers, not
-        # every call forever.
-        self._recover_chain()
+        # which _dispatch already swapped in. Re-anchor them BEFORE
+        # answering the parked callers: an unparked caller retries
+        # immediately, and that retry's dispatch must never inherit
+        # the poisoned chain (on a loaded 1-core host the retry used
+        # to win the race and fail on the poisoned key). set_error is
+        # in the finally so a recovery failure can't strand callers.
+        try:
+          self._recover_chain()
+        finally:
+          try:
+            self._batcher.set_error(batch_id, f'{type(e).__name__}: {e}')
+          except Exception:
+            pass
       finally:
         self._sem.release()
       lat_ms = (time.perf_counter() - t0) * 1e3
+      _SERVE_LATENCY.observe(lat_ms)
       with self._stats_lock:
         self._inflight -= 1
         self._devices_last_call = devices
@@ -870,7 +1136,7 @@ class InferenceServer:
         continue
       padded_done.add(padded)
       with self._params_lock:
-        params = self._params
+        params = self._versions[self._live_key].params
       inputs = (
           np.zeros((padded,), np.int32),
           np.zeros((padded,), np.float32),
@@ -886,9 +1152,22 @@ class InferenceServer:
       else:
         inputs = inputs + tuple(
             np.zeros((padded, s), np.float32) for s in self._core_sizes)
+      # Record the input meta + warmed bucket for the AOT table —
+      # _precompile_params re-derives argument specs from these when a
+      # NEW params structure publishes later (the version-flip-
+      # without-compile guarantee needs exactly this memo).
+      with self._aot_lock:
+        if self._warm_meta is None:
+          self._warm_meta = tuple(
+              (a.dtype, tuple(a.shape[1:])) for a in inputs)
+        self._warm_buckets.add(padded)
+      if self._serving_aot:
+        # Pre-compile BEFORE dispatching, so warmup itself serves
+        # from the AOT table (aot_misses stays 0 end to end).
+        self._precompile_params(params)
       if self._mesh is not None:
         inputs = jax.device_put(inputs, self._batch_sharding)
-      payload = self._dispatch(params, inputs)
+      payload, _ = self._dispatch(params, inputs)
       jax.block_until_ready(payload)
 
   def stats(self):
@@ -920,6 +1199,19 @@ class InferenceServer:
       admission_timeouts = self._admission_timeouts
       arena_grows = self._arena_grows
       unjoined = self._unjoined_threads
+      version_flips = self._version_flips
+      evictions = self._evictions
+      ab_calls = self._ab_calls
+      shadow_calls = self._shadow_calls
+      shadow_divergence = self._shadow_divergence
+      aot_misses = self._aot_misses
+    with self._params_lock:
+      resident = len(self._versions)
+      live_label = self._versions[self._live_key].label()
+      serve_counts = {str(e.label()): e.serves
+                      for e in self._versions.values()}
+    with self._aot_lock:
+      aot_compiled = len(self._aot)
     with self._slot_lock:
       waitlist_depth = len(self._waiters)
       admission = self._admission
@@ -951,42 +1243,327 @@ class InferenceServer:
         'arena_grows': arena_grows,
         'waitlist_depth': waitlist_depth,
         'unjoined_threads': unjoined,
+        # Serving version table (round 21): per-version counters keyed
+        # by entry label, plus the A/B + shadow + AOT planes.
+        'resident_versions': resident,
+        'live_version': live_label,
+        'serve_counts': serve_counts,
+        'version_flips': version_flips,
+        'evictions': evictions,
+        'ab_calls': ab_calls,
+        'shadow_calls': shadow_calls,
+        'shadow_divergence': round(shadow_divergence, 6),
+        'aot_misses': aot_misses,
+        'aot_compiled': aot_compiled,
     }
 
+  # -- serving version table (round 21) --
+
+  def _newest_nonlive_locked(self):
+    """The most recently PUBLISHED non-live resident entry (insertion
+    order, not serve recency) — the auto A/B candidate and the auto
+    shadow version. Called with _params_lock held."""
+    for key in reversed(self._versions):
+      if key != self._live_key:
+        return self._versions[key]
+    return None
+
+  def _entry_for_locked(self, key_or_none):
+    if key_or_none is None:
+      return self._newest_nonlive_locked()
+    return self._versions.get(key_or_none)
+
+  def _pick_live_locked(self):
+    """Pick this merged call's serving params under _params_lock: the
+    live entry, or — serving_ab_fraction of calls, via a deterministic
+    accumulator — the A/B candidate (set_ab's key, else the newest
+    non-live resident). Bumps the entry's serve counter + LRU tick.
+    Returns (params, entry key)."""
+    self._serve_tick += 1
+    entry = self._versions[self._live_key]
+    if self._ab_fraction > 0.0:
+      cand = self._entry_for_locked(self._ab_key)
+      if cand is not None and cand.key != self._live_key:
+        self._ab_acc += self._ab_fraction
+        if self._ab_acc >= 1.0:
+          self._ab_acc -= 1.0
+          entry = cand
+          with self._stats_lock:
+            self._ab_calls += 1
+          _AB_CALLS.inc()
+    entry.serves += 1
+    entry.tick = self._serve_tick
+    return entry.params, entry.key
+
+  def _pick_shadow_locked(self):
+    """The shadow version's params for this merged call, or None —
+    sampled at serving_shadow_fraction by the same accumulator
+    scheme. The shadow is set_shadow's key, else the newest non-live
+    resident; never the live entry (zero divergence by construction
+    would only dilute the gauge)."""
+    if self._shadow_fraction <= 0.0:
+      return None
+    entry = self._entry_for_locked(self._shadow_key)
+    if entry is None or entry.key == self._live_key:
+      return None
+    self._shadow_acc += self._shadow_fraction
+    if self._shadow_acc < 1.0:
+      return None
+    self._shadow_acc -= 1.0
+    return entry.params
+
+  def _install_locked(self, key, params):
+    """Insert an OWNED params copy as the live entry, then evict LRU
+    unpinned non-live entries past the count cap / byte budget.
+    Called with _params_lock held."""
+    self._serve_tick += 1
+    self._versions[key] = _VersionEntry(
+        key, params, _tree_nbytes(params), self._serve_tick)
+    self._versions.move_to_end(key)
+    self._live_key = key
+    self._evict_locked()
+    _RESIDENT_VERSIONS.set(float(len(self._versions)))
+
+  def _evict_locked(self):
+    while True:
+      over_count = len(self._versions) > self._resident_cap
+      over_bytes = (
+          self._hbm_budget_bytes > 0 and len(self._versions) > 1
+          and sum(e.nbytes for e in self._versions.values())
+          > self._hbm_budget_bytes)
+      if not (over_count or over_bytes):
+        return
+      victim = None
+      for e in self._versions.values():
+        if e.key == self._live_key or e.pinned:
+          continue
+        if victim is None or e.tick < victim.tick:
+          victim = e
+      if victim is None:
+        # Every resident entry is live or pinned: the budget cannot
+        # be honoured without breaking a pin — keep them and say so.
+        log.warning(
+            'serving version table over budget (%d resident) but '
+            'every entry is live/pinned — nothing evictable',
+            len(self._versions))
+        return
+      del self._versions[victim.key]
+      with self._stats_lock:
+        self._evictions += 1
+      _EVICTIONS.inc()
+      log.info('serving: evicted resident version %s (LRU; %d left)',
+               victim.label(), len(self._versions))
+
+  def _precompile_params(self, params):
+    """AOT-compile the serving step for `params`' structure across
+    every warmed bucket (the jit .lower(...).compile() seam —
+    parallel/fit.py's AOT pattern), so a later flip to this version
+    never pays first-call compile on the serve path. Runs on the
+    PUBLISHER's thread; a no-op before the first warmup() (no input
+    meta recorded yet) and for already-compiled (bucket, structure)
+    keys."""
+    with self._aot_lock:
+      meta = self._warm_meta
+      buckets = sorted(self._warm_buckets)
+    if meta is None:
+      return
+    fingerprint = _params_fingerprint(params)
+    params_sds = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(np.shape(l), l.dtype), params)
+    arena_sds = ()
+    if self._state_cache:
+      with self._arena_lock:
+        arena_sds = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in self._arena)
+    for padded in buckets:
+      cache_key = (padded, fingerprint)
+      with self._aot_lock:
+        if cache_key in self._aot:
+          continue
+      in_sds = tuple(
+          jax.ShapeDtypeStruct((padded,) + trail, dtype)
+          for dtype, trail in meta)
+      try:
+        compiled = self._step.lower(
+            params_sds, self._key_spec, *arena_sds, *in_sds).compile()
+      except Exception:
+        log.exception(
+            'serving AOT compile failed (bucket %d) — the jit cache '
+            'covers it at first-call cost', padded)
+        return
+      with self._aot_lock:
+        self._aot[cache_key] = compiled
+
   def update_params(self, params, version=None):
-    """Publish a new weight snapshot.
+    """Publish a weight snapshot into the serving version table.
 
-    Copies each leaf: the learner's train step DONATES its state, so
-    the caller's buffers will be invalidated by the next update — a
-    zero-copy swap would hand actors deleted buffers ("Buffer has been
-    deleted or donated"). The copy is dispatched before any subsequent
-    donation, so it's race-free. On the mesh path the explicit copy
-    also matters: device_put alone is a NO-OP (aliased buffers) when
-    the input already carries the target sharding.
+    Copy semantics: a NEW entry copies each leaf — the learner's train
+    step DONATES its state, so the caller's buffers will be
+    invalidated by the next update; a zero-copy swap would hand actors
+    deleted buffers ("Buffer has been deleted or donated"). The copy
+    is dispatched before any subsequent donation, so it's race-free.
+    On the mesh path the explicit copy also matters: device_put alone
+    is a NO-OP (aliased buffers) when the input already carries the
+    target sharding.
 
-    Args:
-      params: the snapshot pytree.
-      version: optional caller-side version of the snapshot. When it
-        matches the last published version the whole-tree copy is
-        SKIPPED (counted in stats()['publishes_skipped']) — the
-        republish of an unchanged snapshot (remote refetch cadences,
-        redundant publish cadences) must not cost a tree copy. None =
-        always publish (the safe default for callers with no version).
+    Version semantics (round 21):
+      - version == the LIVE entry's key: skipped entirely (counted in
+        stats()['publishes_skipped']) — republishing an unchanged
+        snapshot must not cost a tree copy.
+      - version RESIDENT but not live: flips live to that entry with
+        NO copy (stats()['version_flips']) — the rollback/promote
+        path the table exists for.
+      - otherwise: copy (quantize first when publish_codec=int8),
+        AOT-precompile if enabled (BEFORE the flip, off the serve
+        path), install as live, evict LRU past the caps.
+      - version=None: always a fresh anonymous entry (the safe
+        default for callers with no version).
+
+    Restore caveat (round 21 satellite; tests/test_serving.py pins
+    it): the table — dedup keys included — is process memory BY
+    DESIGN. A server rebuilt after a checkpoint restore re-copies on
+    the first publish of any version, including a numeric version it
+    published before the restart: the constructor holds its params by
+    reference under a sentinel key, and the first publish must land
+    an owned copy for the donation safety above. A dedup key that
+    survived restore would skip that copy and hand actors the
+    learner's donated buffers.
     """
     if version is not None:
       with self._params_lock:
-        if self._published_version_key == version:
+        if version == self._live_key:
           with self._stats_lock:
             self._publishes_skipped += 1
           return
+        if version in self._versions:
+          self._serve_tick += 1
+          entry = self._versions[version]
+          entry.tick = self._serve_tick
+          self._versions.move_to_end(version)
+          self._live_key = version
+          with self._stats_lock:
+            self._version_flips += 1
+            self._params_version += 1
+          _VERSION_FLIPS.inc()
+          return
     params = jax.tree_util.tree_map(jnp.copy, params)
+    if self._quantize_resident:
+      params = codec_lib.quantize_device(params)
     if self._mesh is not None:
       params = jax.device_put(params, self._replicated)
+    if self._serving_aot:
+      # Compile for this structure BEFORE the entry goes live: the
+      # publisher's thread eats the compile, never a serving call.
+      self._precompile_params(params)
     with self._params_lock:
-      self._params = params
-      self._published_version_key = version
+      key = version
+      if key is None:
+        self._anon_seq += 1
+        key = ('anon', self._anon_seq)
+      self._install_locked(key, params)
     with self._stats_lock:
       self._params_version += 1
+
+  def pin_version(self, version, pinned=True):
+    """Pin (or unpin) a resident version: pinned entries are exempt
+    from LRU eviction — the rollback anchor. Returns True if the
+    version was resident."""
+    with self._params_lock:
+      entry = self._versions.get(version)
+      if entry is None:
+        return False
+      entry.pinned = bool(pinned)
+      return True
+
+  def set_live(self, version):
+    """Flip serving to an already-resident version without a publish
+    (stats()['version_flips']). Raises KeyError if not resident."""
+    with self._params_lock:
+      if version not in self._versions:
+        raise KeyError(f'version {version!r} is not resident')
+      if version == self._live_key:
+        return
+      self._serve_tick += 1
+      entry = self._versions[version]
+      entry.tick = self._serve_tick
+      self._versions.move_to_end(version)
+      self._live_key = version
+      with self._stats_lock:
+        self._version_flips += 1
+        self._params_version += 1
+      _VERSION_FLIPS.inc()
+
+  def set_ab(self, version, fraction):
+    """Route `fraction` of merged calls to `version` (None = the
+    newest non-live resident). Fraction 0 disables A/B."""
+    fraction = float(fraction)
+    if not 0.0 <= fraction <= 1.0:
+      raise ValueError(f'ab fraction {fraction} outside [0, 1]')
+    with self._params_lock:
+      self._ab_key = version
+      self._ab_fraction = fraction
+      self._ab_acc = 0.0
+
+  def set_shadow(self, version, fraction):
+    """Replay `fraction` of merged calls against `version` (None =
+    the newest non-live resident) and score greedy agreement vs live
+    into the serving/shadow_divergence gauge. Fraction 0 disables."""
+    fraction = float(fraction)
+    if not 0.0 <= fraction <= 1.0:
+      raise ValueError(f'shadow fraction {fraction} outside [0, 1]')
+    with self._params_lock:
+      self._shadow_key = version
+      self._shadow_fraction = fraction
+      self._shadow_acc = 0.0
+
+  def resident_versions(self):
+    """[(label, serves, pinned, live?)] for every resident entry, in
+    publish order — the bench's per-version counter rows."""
+    with self._params_lock:
+      return [(e.label(), e.serves, e.pinned, e.key == self._live_key)
+              for e in self._versions.values()]
+
+  _REMOTE_ORDER = ('prev_action', 'reward', 'done', 'frame', 'instr',
+                   'core_c', 'core_h')
+
+  def serve_remote(self, payload):
+    """Serve one CARRY-PASSING batch for the wire-v10 routed inference
+    service (runtime/remote.py 'infer' requests — the driver attaches
+    this as the ingest server's serving seam).
+
+    `payload` is a dict of batch-leading arrays: prev_action [B]
+    int32, reward [B] f32, done [B] bool, frame [B,H,W,C] uint8,
+    instr [B,L] int32, core_c/core_h [B,H] f32. Returns the result
+    dict (action, logits, baseline, core_c, core_h, version label).
+
+    Carry-passing even on a state-cache server: the remote caller
+    owns its carry — a cross-host request must never consume a local
+    arena slot. RNG is a per-call fold_in of a dedicated base key, so
+    routed traffic never perturbs the local fleet's key chain. One
+    compiled program per distinct batch size: route fixed-size
+    batches, or accept the first-call compile."""
+    t0 = time.perf_counter()
+    inputs = tuple(np.asarray(payload[k]) for k in self._REMOTE_ORDER)
+    with self._params_lock:
+      params, key = self._pick_live_locked()
+      label = self._versions[key].label()
+    with self._remote_lock:
+      self._remote_calls += 1
+      count = self._remote_calls
+    sub = jax.random.fold_in(self._remote_base_key, count)
+    if self._mesh is not None:
+      inputs = jax.device_put(inputs, self._replicated)
+    outs = self._remote_step(params, sub, *inputs)
+    action, logits, baseline, new_c, new_h = jax.device_get(outs[1:])
+    _SERVE_LATENCY.observe((time.perf_counter() - t0) * 1e3)
+    return {
+        'action': np.asarray(action),
+        'logits': np.asarray(logits),
+        'baseline': np.asarray(baseline),
+        'core_c': np.asarray(new_c),
+        'core_h': np.asarray(new_h),
+        'version': label,
+    }
 
   def policy(self, prev_action, env_output, core_state):
     """`runtime.actor.Actor`-contract policy: scalars in, scalars out.

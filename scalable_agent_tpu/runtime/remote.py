@@ -798,15 +798,37 @@ class Backoff:
 #     ('error', unknown kind) — the draining client tolerates that
 #     and closes anyway (the exit is best-effort-announced, never
 #     gated on the server's vintage).
-PROTOCOL_VERSION = 9
+# v10 (round 21): multi-tenant serving plane, v5..v9-COMPATIBLE both
+# ways (the same negotiation pattern — everything turns OFF per
+# connection for older peers):
+#   - blob kind 'params_int8': with --publish_codec=int8 the param
+#     lane serves absmax-quantized snapshots (runtime/codec.py
+#     Int8Leaf trees — ~4x smaller than f32 on the wire; the v7
+#     params_digest covers the WIRE form, q and scales). Negotiated
+#     PER SUBSCRIBER: 'hello_params' client-info now always carries
+#     'protocol', and a v<=9 subscriber keeps receiving the cached
+#     bf16 blob — both encodings are built once per publish, never
+#     per subscriber.
+#   - 'infer' on the trajectory lane: ('infer', payload) → ('infer_ok',
+#     result, notice) serves one carry-passing inference batch from
+#     the learner's resident version table (InferenceServer
+#     .serve_remote — the TorchBeast decoupled-serving seam,
+#     arXiv:1910.03552) when the learner attached a serving fn;
+#     ('error', 'serving not attached') otherwise. The notice dict
+#     carries {'draining': bool} so routers (runtime/routing.py)
+#     drain a replica's share BEFORE the connection dies. Old servers
+#     answer ('error', unknown kind) — the router treats that peer as
+#     not routable, exactly like a v<=9 handshake.
+PROTOCOL_VERSION = 10
 
 # Handshakes accepted without negotiation failure: v5 peers get the
 # round-9 wire exactly (no heartbeats, no busy keepalives, no epoch
 # checks), v6 peers the round-11 wire (no CRC trailers, no digest
 # checks), v7 peers the round-12 wire (no trace stamps), v8 peers the
-# round-13 wire (no membership ledger entries); everything else about
+# round-13 wire (no membership ledger entries), v9 peers the round-20
+# wire (bf16 param blobs, no routed inference); everything else about
 # the lanes is unchanged.
-_COMPATIBLE_PROTOCOLS = (5, 6, 7, 8, 9)
+_COMPATIBLE_PROTOCOLS = (5, 6, 7, 8, 9, 10)
 
 # Bound on the reader→worker handoff queue. The request→reply
 # lockstep already implies at most one in-flight unroll per live
@@ -1157,6 +1179,17 @@ class _Conn:
       if trailer is not None:
         self._write(trailer)
 
+  def send_oob(self, obj) -> None:
+    """Ship `obj` as an out-of-band frame (pickle-5 skeleton + raw
+    array buffers — arrays never pass through the pickler): the v10
+    routed-inference reply path, whose payload is batch arrays. The
+    trailer rides only when this conn negotiated v7 CRC, mirroring
+    the cached-blob convention (_make_blob)."""
+    segments = _oob_frame_segments(obj)
+    trailer = (_CRC.pack(_segments_crc(segments))
+               if self.crc else None)
+    self.send_segments(segments, trailer)
+
   def try_send(self, obj, timeout: float = 2.0) -> bool:
     """Bounded best-effort send: never blocks shutdown behind a stuck
     peer (a handler mid-sendall of a large snapshot holds send_lock;
@@ -1198,7 +1231,10 @@ class _ParamLane:
   def __init__(self, blob_fn, chunk_bytes: int = 128 * 1024,
                idle_timeout_secs: float = 0.0,
                watchdog: Optional[ThreadWatchdog] = None):
-    self._blob_fn = blob_fn  # () -> (cached frame segments, trailer)
+    # (subscriber protocol) -> (cached frame segments, trailer): the
+    # v10 codec negotiation — an int8 publisher still hands v<=9
+    # subscribers the cached bf16 blob.
+    self._blob_fn = blob_fn
     self._chunk = chunk_bytes
     self._idle_timeout = float(idle_timeout_secs)
     self._watchdog = watchdog
@@ -1230,23 +1266,26 @@ class _ParamLane:
   class _Sub:
     """Per-subscriber state: request parse buffer + outgoing chunks."""
 
-    def __init__(self, sock, crc: bool = False):
+    def __init__(self, sock, crc: bool = False, proto: int = 5):
       self.sock = sock
       self.crc = crc  # v7: trailers on replies, verified on requests
+      self.proto = proto  # v10: which cached blob encoding it gets
       self.rbuf = bytearray()
       self.out: List[memoryview] = []  # remaining reply bytes
       self.last_recv = time.monotonic()  # idle-reaping clock
 
-  def adopt(self, sock: socket.socket, crc: bool = False) -> bool:
+  def adopt(self, sock: socket.socket, crc: bool = False,
+            proto: int = 5) -> bool:
     """Hand a connected socket to the lane (called from the accept
     handler once the peer said 'hello_params'). False if closing.
     `crc`: the hello_params negotiation — this subscriber's replies
     carry the blob's cached v7 trailer and its requests are
-    trailer-verified."""
+    trailer-verified. `proto`: the subscriber's offered protocol —
+    selects which cached blob encoding it fetches (v10: int8)."""
     with self._lock:
       if self._closed:
         return False
-      self._pending_adopts.append((sock, crc))
+      self._pending_adopts.append((sock, crc, proto))
     try:
       self._wake_w.send(b'x')
     except OSError:
@@ -1341,6 +1380,7 @@ class _ParamLane:
             isinstance(msg[1], dict):
           sub.crc = bool(msg[1].get('crc')) and \
               msg[1].get('crc_algo') == integrity.CRC_ALGO
+          sub.proto = int(msg[1].get('protocol') or sub.proto)
         if kind == 'get_params':
           # v7 retry fetches MAY carry a digest-rejected notice: the
           # subscriber refused to install version N because its
@@ -1357,7 +1397,7 @@ class _ParamLane:
                 msg[1]['digest_rejected'])
           with self._lock:
             self._blobs_served += 1
-          segments, trailer = self._blob_fn()
+          segments, trailer = self._blob_fn(sub.proto)
           self._queue_segments(
               sub, tuple(segments) + ((trailer,) if sub.crc else ()))
         elif kind == 'ping':
@@ -1410,11 +1450,11 @@ class _ParamLane:
         if self._closed:
           return
         adopts, self._pending_adopts = self._pending_adopts, []
-      for sock, crc in adopts:
+      for sock, crc, proto in adopts:
         sock.setblocking(False)
         try:
           self._selector.register(sock, selectors.EVENT_READ,
-                                  self._Sub(sock, crc=crc))
+                                  self._Sub(sock, crc=crc, proto=proto))
         except (KeyError, ValueError, OSError):
           sock.close()
       # Idle/half-open subscriber reaping (round 11): a silent sub
@@ -1574,6 +1614,9 @@ class TrajectoryIngestServer:
   _version: guarded_by('_params_lock')
   _blob_version: guarded_by('_params_lock')
   _params_frame: guarded_by('_params_lock')
+  _params_frame_compat: guarded_by('_params_lock')
+  _serving_fn: guarded_by('_params_lock')
+  _draining: guarded_by('_params_lock')
   _serializations: guarded_by('_params_lock')
   _connections: guarded_by('_stats_lock')
   _param_subscribers: guarded_by('_stats_lock')
@@ -1595,9 +1638,14 @@ class TrajectoryIngestServer:
                idle_timeout_secs: float = 0.0,
                wire_crc: bool = True,
                trace: bool = True):
-    if wire_dtype not in (None, '', 'bfloat16'):
+    if wire_dtype not in (None, '', 'bfloat16', 'int8'):
       raise ValueError(f'unsupported wire_dtype {wire_dtype!r}')
     self._wire_bf16 = wire_dtype == 'bfloat16'
+    # v10 int8 codec (round 21): the cached blob pair — int8 for v10
+    # subscribers, bf16 for v<=9 (which cannot parse Int8Leaf trees
+    # reliably across codec revisions and never negotiated the lossy
+    # codec). Both built ONCE per publish.
+    self._wire_int8 = wire_dtype == 'int8'
     self._wire_crc = bool(wire_crc)
     # v8 trace spans (round 13; config.telemetry_trace): advertised as
     # a server-wide fact in the hello reply's server-info — v8 clients
@@ -1645,6 +1693,15 @@ class TrajectoryIngestServer:
     # version bump otherwise costs O(hosts × tree) pickles.
     self._serializations = 0
     self._params_frame = self._make_blob(self._version, params)
+    self._params_frame_compat = (
+        self._make_blob(self._version, params, compat=True)
+        if self._wire_int8 else None)
+    # Routed inference (v10): the learner attaches a serving fn
+    # (InferenceServer.serve_remote) via attach_serving; 'infer'
+    # requests answer ('error', ...) until then. set_draining flips
+    # the notice routers drain on.
+    self._serving_fn = None
+    self._draining = False
     self._stats_lock = make_lock('remote.IngestServer._stats_lock')
     # Round 13: the scattered per-module ints moved into the unified
     # metrics registry (telemetry.Counter — each has its own lock;
@@ -1737,7 +1794,8 @@ class TrajectoryIngestServer:
           target=self._reap_loop, name='ingest-reaper', daemon=True)
       self._reaper_thread.start()
 
-  def _make_blob(self, version, params) -> Tuple[List[bytes], bytes]:
+  def _make_blob(self, version, params,
+                 compat: bool = False) -> Tuple[List[bytes], bytes]:
     """One published version as (wire frame segments, CRC trailer):
     [head (length prefix + OOB tag + skeleton + buffer table), raw
     buffer, raw buffer, ...] plus the 4 trailer bytes v7 subscribers
@@ -1759,10 +1817,25 @@ class TrajectoryIngestServer:
     fault site fires between the digest and the pickle: the shipped
     frame is then self-consistent (its CRC trailer matches its bytes)
     and only the client's digest check can catch the damage — the
-    host-memory-rot shape."""
-    with self._params_lock:
-      self._serializations += 1  # test hook: must be once per version
-    if self._wire_bf16:
+    host-memory-rot shape.
+
+    v10 (round 21): with wire_dtype='int8' the primary blob is the
+    absmax-quantized tree (kind 'params_int8'; runtime/codec.py —
+    the digest covers the WIRE form, q arrays and scales, exactly
+    like the bf16 digest covers the cast tree). `compat=True` builds
+    the bf16 blob served to v<=9 subscribers instead — each publish
+    builds both ONCE; `compat` builds don't advance the
+    serializations clock (its contract is one count per VERSION, the
+    per-version cost the test hook watches)."""
+    if not compat:
+      with self._params_lock:
+        self._serializations += 1  # test hook: once per version
+    wire_int8 = self._wire_int8 and not compat
+    wire_bf16 = self._wire_bf16 or (self._wire_int8 and compat)
+    if wire_int8:
+      from scalable_agent_tpu.runtime import codec as codec_lib
+      params = codec_lib.quantize_np(params)
+    elif wire_bf16:
       import jax
       import ml_dtypes
       params = jax.tree_util.tree_map(
@@ -1794,7 +1867,12 @@ class TrajectoryIngestServer:
             # seeing it stamps trace contexts on its unroll frames.
             'trace': self._trace,
             'params_digest': integrity.digest_record(digest)}
-    kind = 'params_bf16' if self._wire_bf16 else 'params'
+    if wire_int8:
+      kind = 'params_int8'
+    elif wire_bf16:
+      kind = 'params_bf16'
+    else:
+      kind = 'params'
     segments = _oob_frame_segments((kind, version, params, info))
     return segments, _CRC.pack(_segments_crc(segments))
 
@@ -1812,9 +1890,12 @@ class TrajectoryIngestServer:
       self._version += 1
       version = self._version
     blob = self._make_blob(version, params)
+    compat = (self._make_blob(version, params, compat=True)
+              if self._wire_int8 else None)
     with self._params_lock:
       if version > self._blob_version:
         self._params_frame = blob
+        self._params_frame_compat = compat
         self._blob_version = version
     return version
 
@@ -2151,16 +2232,37 @@ class TrajectoryIngestServer:
         self._connections += 1
       t.start()
 
-  def _snapshot_frame(self) -> Tuple[List[bytes], bytes]:
+  def _snapshot_frame(
+      self, proto: int = PROTOCOL_VERSION) -> Tuple[List[bytes], bytes]:
     """(cached frame segments, cached CRC trailer) of the current
-    published version — the trailer ships only to v7 CRC peers."""
+    published version — the trailer ships only to v7 CRC peers.
+    `proto` selects the encoding (v10 codec negotiation): a v<=9
+    peer of an int8 publisher gets the cached bf16 compat blob."""
     with self._params_lock:
+      if self._wire_int8 and proto < 10:
+        return self._params_frame_compat
       return self._params_frame
 
-  def snapshot_nbytes(self) -> int:
+  def snapshot_nbytes(self, proto: int = PROTOCOL_VERSION) -> int:
     """Wire size of the current cached snapshot frame (bench +
     egress-arithmetic hook; the 4 trailer bytes are noise)."""
-    return sum(len(s) for s in self._snapshot_frame()[0])
+    return sum(len(s) for s in self._snapshot_frame(proto)[0])
+
+  def attach_serving(self, fn) -> None:
+    """Attach the routed-inference seam (v10): `fn(payload dict) ->
+    result dict`, normally InferenceServer.serve_remote. 'infer'
+    requests answer ('error', 'serving not attached') until this is
+    called; None detaches."""
+    with self._params_lock:
+      self._serving_fn = fn
+
+  def set_draining(self, draining: bool = True) -> None:
+    """Flip the drain notice 'infer' replies carry — routers
+    (runtime/routing.py) shift a replica's share away BEFORE its
+    connections die (the PR 17 leave convention, serving-plane
+    edition)."""
+    with self._params_lock:
+      self._draining = bool(draining)
 
   def _serve(self, conn: _Conn, addr):
     log.info('remote actor connected from %s', addr)
@@ -2260,7 +2362,7 @@ class TrajectoryIngestServer:
             if fresh:
               self._hosts_joined.inc()
               log.info('host %s JOINED the pod (%s)', host_id, addr)
-          segments, trailer = self._snapshot_frame()
+          segments, trailer = self._snapshot_frame(conn.protocol)
           conn.send_segments(segments,
                              trailer if conn.crc else None)
           conn.crc = crc_next
@@ -2290,12 +2392,20 @@ class TrajectoryIngestServer:
                      and bool(sub_info.get('crc'))
                      and sub_info.get('crc_algo') ==
                      integrity.CRC_ALGO)
-          adopted = self._param_lane.adopt(conn.sock, crc=sub_crc)
+          # v10: the subscriber's offered protocol picks its blob
+          # encoding; absent (v<=9 hello_params, or the bare legacy
+          # tuple), fall back to the trajectory-lane handshake's
+          # protocol, else to the conservative bf16/f32 blob.
+          sub_proto = conn.protocol
+          if isinstance(sub_info, dict) and sub_info.get('protocol'):
+            sub_proto = int(sub_info['protocol'])
+          adopted = self._param_lane.adopt(conn.sock, crc=sub_crc,
+                                           proto=sub_proto)
           return
         elif kind == 'get_params':
           # Legacy/in-band path (pre-v5 peers, protocol tests): served,
           # but production clients fetch over the param lane.
-          segments, trailer = self._snapshot_frame()
+          segments, trailer = self._snapshot_frame(conn.protocol)
           conn.send_segments(segments,
                              trailer if conn.crc else None)
         elif kind == 'unroll':
@@ -2356,6 +2466,30 @@ class TrajectoryIngestServer:
               'registry': telemetry.registry().snapshot(),
               'ingest': self.stats(),
           }))
+        elif kind == 'infer':
+          # v10 routed inference: one carry-passing batch served from
+          # the learner's resident version table (attach_serving).
+          # Runs ON the reader thread — the request→reply lockstep
+          # means one in-flight infer per connection, and routers open
+          # a dedicated connection per replica, so the trajectory
+          # lane's acks never queue behind a forward pass here. The
+          # notice dict's 'draining' flag is how a replica's share
+          # drains BEFORE its socket dies.
+          with self._params_lock:
+            serving_fn = self._serving_fn
+            draining = self._draining
+          if serving_fn is None:
+            conn.send(('error', 'serving not attached'))
+          else:
+            try:
+              result = serving_fn(msg[1])
+            except Exception as e:
+              log.exception('routed inference request failed')
+              conn.send(('error',
+                         f'infer failed: {type(e).__name__}: {e}'))
+            else:
+              conn.send_oob(('infer_ok', result,
+                             {'draining': draining}))
         else:
           conn.send(('error', f'unknown message kind {kind!r}'))
       # Loop-condition exit on a closing server: same contract as
@@ -2765,6 +2899,12 @@ class RemoteActorClient:
           lambda x: x.astype(np.float32)
           if getattr(x, 'dtype', None) == ml_dtypes.bfloat16 else x,
           tree)
+    elif reply[0] == 'params_int8':
+      # v10 int8 blobs (runtime/codec.py): the digest above covered
+      # the WIRE form (q arrays + scales); the host decode to f32
+      # happens only after it verified.
+      from scalable_agent_tpu.runtime import codec as codec_lib
+      tree = codec_lib.dequantize_np(tree)
     return version, tree
 
   def handshake(self, contract, prior_epoch: Optional[int] = None,
@@ -2858,12 +2998,17 @@ class RemoteActorClient:
       # — every subsequent frame on the lane carries trailers both
       # ways. The lane's state is PINNED at open: a later handshake
       # flipping self._crc must not desynchronize a cached sub.
+      # v10: the info dict ALWAYS carries 'protocol' — the lane picks
+      # this subscriber's blob encoding from it (an int8 publisher
+      # hands v<=9 subscribers the bf16 compat blob); a v<=9 server
+      # reads only the crc keys and ignores the rest.
       if self._crc:
         _send_msg(sock, ('hello_params',
                          {'protocol': PROTOCOL_VERSION, 'crc': True,
                           'crc_algo': integrity.CRC_ALGO}))
       else:
-        _send_msg(sock, ('hello_params',))
+        _send_msg(sock, ('hello_params',
+                         {'protocol': PROTOCOL_VERSION}))
       self._param_sock = sock
       self._param_sock_crc = self._crc
     lane_crc = self._param_sock_crc
@@ -2992,6 +3137,28 @@ class RemoteActorClient:
     if reply[0] != 'stats':
       raise ProtocolError(f'expected stats, got {reply[0]!r}')
     return reply[1]
+
+  def supports_infer(self) -> bool:
+    """True when the handshaken server advertised protocol >= 10 —
+    the routed-inference capability gate (routing.py skips pre-v10
+    replicas instead of burning a request on the 'error' reply)."""
+    return int(self.server_info.get('protocol') or 0) >= 10
+
+  def remote_infer(self, payload: dict) -> Tuple[dict, dict]:
+    """One routed inference batch (v10): ship `payload` (the
+    InferenceServer.serve_remote dict — batch-leading numpy arrays)
+    out-of-band, return (result dict, notice dict). The notice
+    carries 'draining' — routing.py drains this replica's share when
+    it flips. Raises RuntimeError against a server with no serving
+    attached (or a pre-v10 server: 'error', unknown kind)."""
+    reply = self._rpc(('infer', payload), oob=True)
+    if reply[0] == 'error':
+      raise RuntimeError(f'routed inference refused: {reply[1]}')
+    if reply[0] != 'infer_ok':
+      raise ProtocolError(f'expected infer_ok, got {reply[0]!r}')
+    notice = reply[2] if len(reply) > 2 and isinstance(reply[2], dict) \
+        else {}
+    return reply[1], notice
 
   def send_leave(self) -> bool:
     """Announce a DELIBERATE exit (v9 drain): the learner records

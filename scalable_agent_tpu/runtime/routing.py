@@ -1,0 +1,354 @@
+"""Cross-host serving router (round 21): actor-side request routing.
+
+An actor host that offloads inference (TorchBeast-style decoupled
+serving) no longer binds to a single learner-host replica: this module
+spreads `remote_infer` batches over every learner host that advertises
+the v10 serving capability, so one slow or dead replica costs its
+share of the traffic and nothing else.
+
+Design:
+
+- **Smooth weighted round-robin** (the nginx algorithm): every pick
+  adds each candidate's weight to its running credit, serves the
+  highest credit, then subtracts the total weight from the winner.
+  Unlike naive weighted RR this interleaves — a 5:1:1 weight split
+  yields A A B A A C A..., not A A A A A B C — so a fast replica's
+  extra share never arrives as a burst that re-creates the queueing
+  it was meant to absorb.
+- **Health-weighted**: each success folds the observed latency into a
+  per-replica EWMA, and the weight is the inverse of that EWMA — a
+  replica running 3x slower organically receives ~1/3 of the traffic
+  without any operator knob.
+- **Failover with probation**: a transport or server error marks the
+  replica down for `probation_secs` and the request retries on the
+  next pick, so a SIGKILLed replica costs at most one in-flight
+  request per connection. Probation expiry makes the replica pickable
+  again (the next pick redials it); repeated failure just re-arms the
+  window — no thundering reconnect loop.
+- **Draining**: the v10 infer reply's notice dict carries 'draining'
+  once the server has begun shutdown. The router stops NEW picks to a
+  draining replica immediately (its in-flight result is still valid —
+  drain is an advisory, not an error) and `apply_membership` turns the
+  PR 17 ledger's host_left/host_joined events into removals/adds, so
+  elastic pod changes reshape the serving plane without a restart.
+
+The router never owns the wire: `connect_fn(address)` returns any
+channel exposing `remote_infer(payload) -> (result, notice)`,
+`supports_infer() -> bool`, and `close()` — production uses
+`connect_serving` below (a RemoteActorClient handshake, which rides
+the learner's existing listener and, by never offering a 'host'
+identity, stays OUT of the membership ledger), tests use fakes.
+"""
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from absl import logging as log
+
+from scalable_agent_tpu import telemetry
+
+_ROUTE_MS = telemetry.histogram('serving/route_ms')
+_ROUTE_ERRORS = telemetry.counter('serving/route_errors')
+_ROUTE_FAILOVERS = telemetry.counter('serving/route_failovers')
+_ROUTE_REPLICAS = telemetry.gauge('serving/route_replicas')
+
+
+class NoReplicasAvailable(RuntimeError):
+  """Every replica is down, draining, or departed — the caller backs
+  off and retries (or falls back to local inference); the router never
+  blocks waiting for one to recover."""
+
+
+class _Replica:
+  """Routing state for one serving replica.
+
+  All fields except `io_lock` are guarded by the router's `_lock`;
+  the channel's REQUEST traffic (request/reply lockstep on one
+  socket) is serialized by `io_lock` alone, so a slow infer on one
+  replica never holds the pick path for the others.
+  """
+
+  __slots__ = ('address', 'channel', 'weight', 'current', 'ewma_ms',
+               'serves', 'errors', 'draining', 'down_until', 'left',
+               'io_lock')
+
+  def __init__(self, address: str):
+    self.address = address
+    self.channel = None          # lazy: dialed on first pick
+    self.weight = 1.0            # inverse-EWMA health weight
+    self.current = 0.0           # smooth-RR running credit
+    self.ewma_ms: Optional[float] = None
+    self.serves = 0
+    self.errors = 0
+    self.draining = False
+    self.down_until = 0.0        # monotonic deadline; 0 = up
+    self.left = False            # departed via membership/note_left
+    self.io_lock = threading.Lock()
+
+
+class ServingRouter:
+  """Spread `infer` calls over N serving replicas (see module doc).
+
+  Thread-safe: picks and bookkeeping run under one router lock;
+  dials and the infer RPCs themselves run outside it (per-replica
+  `io_lock` keeps each channel's request/reply framing intact).
+  """
+
+  # EWMA smoothing for per-replica latency; 0.2 ≈ the last ~5 calls
+  # dominate, so a recovering replica earns its weight back in a few
+  # requests instead of dragging an hour of history.
+  _EWMA_ALPHA = 0.2
+
+  def __init__(self, addresses: Sequence[str],
+               connect_fn: Callable[[str], object],
+               probation_secs: float = 5.0,
+               clock: Callable[[], float] = time.monotonic):
+    self._connect_fn = connect_fn
+    self._probation = float(probation_secs)
+    self._clock = clock
+    self._lock = threading.Lock()
+    # guarded_by _lock: _replicas (and every _Replica field except
+    # io_lock), _route_errors, _route_failovers.
+    self._replicas: Dict[str, _Replica] = {}
+    self._route_errors = 0
+    self._route_failovers = 0
+    for addr in addresses:
+      self._replicas[str(addr)] = _Replica(str(addr))
+    _ROUTE_REPLICAS.set(len(self._replicas))
+
+  # -- pick / serve ------------------------------------------------
+
+  def _available_locked(self) -> List[_Replica]:
+    now = self._clock()
+    return [r for r in self._replicas.values()
+            if not r.left and not r.draining
+            and (r.down_until == 0.0 or r.down_until <= now)]
+
+  # Weight-spread bound for the pick: no replica's effective share
+  # drops below 1/_MAX_SPREAD of the fastest's. Without it a one-off
+  # slow reply poisons the EWMA into exile — the measured case is the
+  # warm-up request eating a ~470 ms first-call compile (weight 0.002
+  # vs 0.36), after which the replica gets ~1/180 of the picks and
+  # the EWMA never sees enough traffic to recover. Floored at 1/10 it
+  # keeps ~9% share and re-earns its weight in a handful of replies.
+  _MAX_SPREAD = 10.0
+
+  def _pick_locked(self) -> Optional[_Replica]:
+    """Smooth weighted RR over the currently-available replicas."""
+    avail = self._available_locked()
+    if not avail:
+      return None
+    floor = max(r.weight for r in avail) / self._MAX_SPREAD
+    total = 0.0
+    best = None
+    for rep in avail:
+      w = max(rep.weight, floor)
+      rep.current += w
+      total += w
+      if best is None or rep.current > best.current:
+        best = rep
+    best.current -= total
+    return best
+
+  def infer(self, payload: dict) -> Tuple[dict, dict]:
+    """Route one inference batch; returns (result, notice).
+
+    Tries each available replica at most once (failover on transport/
+    server errors counts `serving/route_failovers`); raises
+    NoReplicasAvailable when the pool is exhausted. A 'draining'
+    notice drains the replica AFTER returning its (valid) result.
+    """
+    attempts = 0
+    last_err: Optional[Exception] = None
+    # Upper-bound the failover walk by the pool size at entry; the
+    # pick itself re-evaluates availability each round, so replicas
+    # marked down mid-walk are not retried.
+    with self._lock:
+      max_attempts = max(1, len(self._replicas))
+    while attempts < max_attempts:
+      with self._lock:
+        rep = self._pick_locked()
+      if rep is None:
+        break
+      attempts += 1
+      try:
+        result, notice = self._call(rep, payload)
+      except (ConnectionError, OSError, RuntimeError, EOFError) as e:
+        last_err = e
+        self._mark_down(rep, e)
+        if attempts < max_attempts:
+          with self._lock:
+            self._route_failovers += 1
+          _ROUTE_FAILOVERS.inc()
+        continue
+      if notice.get('draining'):
+        self.note_draining(rep.address)
+      return result, notice
+    raise NoReplicasAvailable(
+        f'no serving replica available after {attempts} attempt(s)'
+        + (f' (last error: {last_err})' if last_err else ''))
+
+  def _call(self, rep: _Replica, payload: dict) -> Tuple[dict, dict]:
+    with rep.io_lock:
+      channel = rep.channel
+      if channel is None:
+        channel = self._connect_fn(rep.address)
+        if hasattr(channel, 'supports_infer') and \
+            not channel.supports_infer():
+          self._close_channel(channel)
+          raise RuntimeError(
+              f'replica {rep.address} pre-dates wire v10 '
+              '(no routed-inference capability)')
+        with self._lock:
+          rep.channel = channel
+      t0 = self._clock()
+      result, notice = channel.remote_infer(payload)
+      lat_ms = (self._clock() - t0) * 1000.0
+    _ROUTE_MS.observe(lat_ms)
+    with self._lock:
+      rep.serves += 1
+      if rep.ewma_ms is None:
+        rep.ewma_ms = lat_ms
+      else:
+        rep.ewma_ms = ((1.0 - self._EWMA_ALPHA) * rep.ewma_ms
+                       + self._EWMA_ALPHA * lat_ms)
+      # Inverse-latency health weight, normalized so the fastest
+      # possible replica (ewma <= 1ms) sits at 1.0 — the SAME weight
+      # an unmeasured replica starts with. Unmeasured must tie the
+      # fastest, not trail it: otherwise the first replica to answer
+      # a sub-millisecond call starves the rest before they are ever
+      # probed.
+      rep.weight = 1.0 / max(rep.ewma_ms, 1.0)
+    return result, notice if isinstance(notice, dict) else {}
+
+  def _mark_down(self, rep: _Replica, err: Exception):
+    log.warning('serving replica %s failed (%s): probation %.1fs',
+                rep.address, err, self._probation)
+    _ROUTE_ERRORS.inc()
+    with self._lock:
+      rep.errors += 1
+      rep.down_until = self._clock() + self._probation
+      self._route_errors += 1
+      channel, rep.channel = rep.channel, None
+    self._close_channel(channel)
+
+  @staticmethod
+  def _close_channel(channel):
+    if channel is None:
+      return
+    try:
+      channel.close()
+    except (OSError, RuntimeError):
+      pass
+
+  # -- membership --------------------------------------------------
+
+  def add_replica(self, address: str):
+    """Add (or resurrect) a replica; a departed address re-joins with
+    fresh health state — its old EWMA belonged to the old process."""
+    address = str(address)
+    with self._lock:
+      rep = self._replicas.get(address)
+      if rep is None or rep.left:
+        self._replicas[address] = _Replica(address)
+      n = len([r for r in self._replicas.values() if not r.left])
+    _ROUTE_REPLICAS.set(n)
+
+  def note_draining(self, address: str):
+    """Stop NEW picks to `address` (v10 drain notice)."""
+    with self._lock:
+      rep = self._replicas.get(str(address))
+      if rep is not None and not rep.draining:
+        rep.draining = True
+        log.info('serving replica %s draining: removed from rotation',
+                 address)
+
+  def note_left(self, address: str):
+    """Remove `address` from the pool (membership host_left)."""
+    channel = None
+    with self._lock:
+      rep = self._replicas.get(str(address))
+      if rep is not None and not rep.left:
+        rep.left = True
+        channel, rep.channel = rep.channel, None
+      n = len([r for r in self._replicas.values() if not r.left])
+    self._close_channel(channel)
+    _ROUTE_REPLICAS.set(n)
+
+  def apply_membership(self, events: Sequence[Dict],
+                       address_of: Optional[Callable[[str], Optional[str]]]
+                       = None):
+    """Fold PR 17 ledger events into the pool: host_joined adds,
+    host_left removes. `address_of(host_id)` maps a ledger identity to
+    a serving address (None = this host serves no traffic — skipped);
+    without it the host identity is assumed to BE the address."""
+    for ev in events:
+      host = ev.get('host')
+      if host is None:
+        continue
+      addr = address_of(host) if address_of is not None else str(host)
+      if addr is None:
+        continue
+      kind = ev.get('kind')
+      if kind == 'host_joined':
+        self.add_replica(addr)
+      elif kind == 'host_left':
+        self.note_left(addr)
+
+  # -- introspection / lifecycle -----------------------------------
+
+  def stats(self) -> Dict:
+    with self._lock:
+      replicas = [{
+          'address': r.address,
+          'serves': r.serves,
+          'errors': r.errors,
+          'weight': round(r.weight, 3),
+          'ewma_ms': (round(r.ewma_ms, 3)
+                      if r.ewma_ms is not None else None),
+          'draining': r.draining,
+          'left': r.left,
+          'down': bool(r.down_until
+                       and r.down_until > self._clock()),
+      } for r in self._replicas.values()]
+      return {
+          'replicas': replicas,
+          'available': len(self._available_locked()),
+          'route_errors': self._route_errors,
+          'route_failovers': self._route_failovers,
+      }
+
+  def close(self):
+    with self._lock:
+      channels = [r.channel for r in self._replicas.values()]
+      for r in self._replicas.values():
+        r.channel = None
+    for channel in channels:
+      self._close_channel(channel)
+
+
+def connect_serving(address: str, contract,
+                    connect_timeout_secs: float = 60.0,
+                    wire_crc: bool = True):
+  """Dial one serving replica: a RemoteActorClient handshake on the
+  learner's existing listener. The hello offers NO 'host' identity,
+  so this connection never enters the replica's membership ledger —
+  routed-inference fan-out must not read as pod growth. Raises
+  RuntimeError against a pre-v10 replica (the router treats that as a
+  dead pick and moves on)."""
+  from scalable_agent_tpu.runtime import remote  # cycle-free at call time
+  client = remote.RemoteActorClient(
+      address, connect_timeout_secs=connect_timeout_secs,
+      wire_crc=wire_crc)
+  try:
+    client.handshake(contract)
+    if not client.supports_infer():
+      raise RuntimeError(
+          f'replica {address} speaks protocol '
+          f"{client.server_info.get('protocol')} < 10: no routed "
+          'inference')
+  except BaseException:
+    client.close()
+    raise
+  return client

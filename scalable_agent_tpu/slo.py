@@ -263,6 +263,18 @@ DEFAULT_OBJECTIVES = (
               kind='rate', comparison='==', target=0.0,
               severity='info',
               description='tracer FIFO overflows'),
+    # Serving plane (round 21, multi-tenant serving): end-to-end
+    # service latency of the shared inference step — every decoupled-
+    # serving client (local C++ batcher callers AND v10 routed
+    # cross-host batches) lands in this histogram. Burning past the
+    # target is overload the admission actuator can shed (the routed
+    # chaos storm asserts this objective stays green through a
+    # replica kill).
+    Objective(name='serving_latency_p99_ms', metric='serving/latency_ms',
+              field='p99', comparison='<=', target=30000.0,
+              severity='ticket',
+              description='inference serve latency p99 (ms), local '
+                          'and routed'),
 )
 
 

@@ -145,6 +145,19 @@ no restart, no pause, verdict green, zero human knob-turning. The
 full run adds a SIGTERM drain cycle (host_left reason='drain' via the
 v9 'leave' announcement) and heals that too.
 
+Round 21 adds the ROUTED storm (`run_routed_storm`,
+CHAOS_STORM=routed — its own invocation, not part of 'all'): an
+actor-side ServingRouter spreads v10 routed-inference traffic over
+two serving replicas (ingest listener + InferenceServer each, real
+sockets). Mid-run one replica is SIGKILLed: the router must fail the
+request over, put the corpse on probation, and keep every subsequent
+batch served — zero starvation (NoReplicasAvailable never raised
+after warm-up) and the routed-latency SLO verdict green. The full
+run adds a drain cycle: a replacement replica joins the rotation,
+the old one is SIGTERM'd and its 'draining' notice must pull it out
+of the rotation BEFORE it exits (drain is an advisory handoff, not
+an error).
+
 Writes CHAOS_OUT (default CHAOS.json at the repo root). Invocation:
 
     python scripts/chaos.py               # all storms, ~4-6 min CPU
@@ -155,6 +168,8 @@ Writes CHAOS_OUT (default CHAOS.json at the repo root). Invocation:
     CHAOS_STORM=corruption python scripts/chaos.py # just the integrity
     CHAOS_STORM=controller python scripts/chaos.py # just the controller
     CHAOS_STORM=elastic   python scripts/chaos.py  # pod membership
+                                                   # (not part of 'all')
+    CHAOS_STORM=routed    python scripts/chaos.py  # serving router
                                                    # (not part of 'all')
     CHAOS_SEED=7 python scripts/chaos.py  # different garbage bytes
 
@@ -1920,6 +1935,294 @@ def run_elastic_storm(logdir: str, smoke: bool = SMOKE,
   return results, errors
 
 
+def _spawn_replica_child(overrides, port):
+  """A serving replica as a child process: ingest listener + local
+  InferenceServer with the v10 serving seam attached — the
+  learner-host role minus the train loop (the storm prices routing
+  and failover, not learning). Prints 'REPLICA_READY <json>' (bound
+  port + core-state sizes) once serving; SIGTERM flips the draining
+  notice and exits ~6 s later (the drain handoff window)."""
+  env = dict(os.environ)
+  env['JAX_PLATFORMS'] = 'cpu'
+  existing = env.get('PYTHONPATH', '')
+  env['PYTHONPATH'] = (REPO + os.pathsep + existing if existing
+                       else REPO)
+  body = (
+      'import json, os, signal, sys, threading, time\n'
+      'import numpy as np\n'
+      'import jax\n'
+      'from scalable_agent_tpu.config import Config\n'
+      'from scalable_agent_tpu.models import ImpalaAgent, init_params\n'
+      'from scalable_agent_tpu.models.instruction import '
+      'MAX_INSTRUCTION_LEN\n'
+      'from scalable_agent_tpu.runtime import remote, ring_buffer\n'
+      'from scalable_agent_tpu.runtime.inference import '
+      'InferenceServer\n'
+      'cfg = Config(**json.loads(sys.argv[1]))\n'
+      'num_actions = 9\n'
+      'agent = ImpalaAgent(num_actions=num_actions, torso=cfg.torso,\n'
+      '                    use_instruction=False)\n'
+      "obs_spec = {'frame': (cfg.height, cfg.width, 3),\n"
+      "            'instr_len': MAX_INSTRUCTION_LEN}\n"
+      'params = init_params(agent, jax.random.PRNGKey(0), obs_spec)\n'
+      'server = InferenceServer(agent, params, cfg, seed=7,\n'
+      '                         fleet_size=1, pad_batch_to=1)\n'
+      'server.update_params(params, version=1)\n'
+      'ingest = remote.TrajectoryIngestServer(\n'
+      '    ring_buffer.TrajectoryBuffer(2), jax.device_get(params),\n'
+      "    host='127.0.0.1', port=int(sys.argv[2]),\n"
+      '    contract=remote.trajectory_contract(cfg, agent,\n'
+      '                                        num_actions),\n'
+      '    wire_dtype=cfg.resolved_wire_dtype)\n'
+      'ingest.attach_serving(server.serve_remote)\n'
+      'core = [int(np.shape(c)[-1])\n'
+      '        for c in server.initial_core_state()]\n'
+      "print('REPLICA_READY ' + json.dumps(\n"
+      "    {'port': ingest.port, 'core': core}), flush=True)\n"
+      'def _term(signum, frame):\n'
+      '  ingest.set_draining()\n'
+      '  threading.Timer(6.0, lambda: os._exit(0)).start()\n'
+      'signal.signal(signal.SIGTERM, _term)\n'
+      'while True:\n'
+      '  time.sleep(0.5)\n')
+  return subprocess.Popen(
+      [sys.executable, '-c', body, json.dumps(overrides), str(port)],
+      cwd=REPO, env=env, stdout=subprocess.PIPE,
+      stderr=subprocess.STDOUT, text=True)
+
+
+def run_routed_storm(logdir: str, smoke: bool = SMOKE,
+                     seed: int = SEED):
+  """The routed-serving drill (round 21); returns (results, errors).
+
+  Two serving replicas (real sockets, wire v10), one actor-side
+  ServingRouter pumping inference batches through them. Mid-run one
+  replica is SIGKILLed. Asserts: the router failed over (probation,
+  not a crash), every post-kill batch was still served (zero
+  NoReplicasAvailable), both replicas had served before the kill (the
+  rotation was real), and the routed-latency SLO objective — judged
+  by the SAME evaluator production uses — never burned. The full run
+  adds the drain handoff: a replacement joins, the survivor is
+  SIGTERM'd, and its 'draining' notice must pull it from the rotation
+  while its in-flight traffic completes."""
+  import signal as signal_lib
+  import threading
+
+  import numpy as np
+
+  from scalable_agent_tpu import slo as slo_lib
+  from scalable_agent_tpu import telemetry
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.models import ImpalaAgent
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+  from scalable_agent_tpu.runtime import remote
+  from scalable_agent_tpu.runtime import routing
+
+  os.makedirs(logdir, exist_ok=True)
+  cfg_kwargs = dict(
+      height=24, width=32, torso='shallow', use_instruction=False,
+      inference_min_batch=0, inference_max_batch=8,
+      inference_timeout_ms=5, inference_state_cache=False,
+      unroll_length=5, batch_size=2, seed=seed)
+  cfg = Config(**cfg_kwargs)
+  num_actions = 9
+  agent = ImpalaAgent(num_actions=num_actions, torso=cfg.torso,
+                      use_instruction=False)
+  contract = remote.trajectory_contract(cfg, agent, num_actions)
+
+  ports = [_free_port(), _free_port()]
+  children = {i: _spawn_replica_child(cfg_kwargs, p)
+              for i, p in enumerate(ports)}
+  sinks = {i: [] for i in children}
+
+  def _tail(proc, sink):
+    for line in proc.stdout:
+      sink.append(line)
+
+  def _watch(idx):
+    threading.Thread(target=_tail, args=(children[idx], sinks[idx]),
+                     daemon=True).start()
+
+  for i in children:
+    _watch(i)
+
+  def _ready_info(idx, deadline):
+    while time.monotonic() < deadline:
+      for line in list(sinks[idx]):
+        if line.startswith('REPLICA_READY '):
+          return json.loads(line[len('REPLICA_READY '):])
+      if children[idx].poll() is not None:
+        return None
+      time.sleep(0.2)
+    return None
+
+  t0 = time.monotonic()
+  errors = []
+  timeline = []
+  results = {'smoke': smoke, 'timeline': timeline}
+  router = None
+  try:
+    # CPU jit compile dominates replica startup; be generous.
+    deadline = time.monotonic() + 180.0
+    infos = {i: _ready_info(i, deadline) for i in children}
+    if any(v is None for v in infos.values()):
+      dead = [i for i, v in infos.items() if v is None]
+      errors.append(
+          f'replica(s) {dead} never became ready: '
+          + ' | '.join(''.join(sinks[i])[-500:] for i in dead))
+      return results, errors
+    core = infos[0]['core']
+    addrs = {i: f"127.0.0.1:{infos[i]['port']}" for i in infos}
+    timeline.append({'event': 'replicas_ready',
+                     'wall': round(time.monotonic() - t0, 2)})
+
+    # Short dial timeout: post-probation redials of a SIGKILLed
+    # replica must fail fast (connection refused), not eat the
+    # production 60 s backoff window inside the router's io_lock.
+    def connect_fn(addr):
+      return routing.connect_serving(addr, contract,
+                                     connect_timeout_secs=1.5)
+
+    router = routing.ServingRouter(list(addrs.values()), connect_fn,
+                                   probation_secs=3.0)
+    rng = np.random.RandomState(seed)
+    b = 2
+    payload = {
+        'prev_action': np.zeros((b,), np.int32),
+        'reward': np.zeros((b,), np.float32),
+        'done': np.zeros((b,), np.bool_),
+        'frame': rng.randint(0, 255,
+                             (b, 24, 32, 3)).astype(np.uint8),
+        'instr': np.zeros((b, MAX_INSTRUCTION_LEN), np.int32),
+        'core_c': np.zeros((b, core[0]), np.float32),
+        'core_h': np.zeros((b, core[1]), np.float32),
+    }
+
+    # Warm-up: every replica must serve at least once (each pays its
+    # serve_remote first-call compile here, OFF the judged window).
+    warm_deadline = time.monotonic() + 120.0
+    while time.monotonic() < warm_deadline:
+      router.infer(payload)
+      serves = {r['address']: r['serves']
+                for r in router.stats()['replicas']}
+      if all(v > 0 for v in serves.values()):
+        break
+    else:
+      errors.append(f'warm-up starved a replica: {router.stats()}')
+      return results, errors
+    timeline.append({'event': 'warm',
+                     'wall': round(time.monotonic() - t0, 2)})
+
+    # The judged pump: the routed-latency objective production ships
+    # (slo.py serving_latency_p99_ms is the server-side half; this is
+    # the actor-side route view) over THIS process's registry.
+    objective = slo_lib.Objective(
+        name='routed_latency_p99_ms', metric='serving/route_ms',
+        field='p99', comparison='<=', target=5000.0,
+        severity='ticket', fast_window_secs=2.0,
+        slow_window_secs=30.0,
+        description='actor-side routed inference latency p99 (ms)')
+    evaluator = slo_lib.SloEvaluator([objective])
+    starvation = 0
+    served = {'pre_kill': 0, 'post_kill': 0, 'post_drain': 0}
+    phase = ['pre_kill']
+    last_obs = [0.0]
+
+    def _pump(secs):
+      end = time.monotonic() + secs
+      while time.monotonic() < end:
+        try:
+          router.infer(payload)
+          served[phase[0]] += 1
+        except routing.NoReplicasAvailable:
+          nonlocal_starvation[0] += 1
+        now = time.time()
+        if now - last_obs[0] >= 0.25:
+          last_obs[0] = now
+          evaluator.observe(telemetry.registry().snapshot(), now)
+        time.sleep(0.02)
+
+    nonlocal_starvation = [0]
+    _pump(4.0)
+    victim = children[0]
+    victim.kill()                      # SIGKILL: no draining notice
+    timeline.append({'event': 'sigkill', 'replica': addrs[0],
+                     'wall': round(time.monotonic() - t0, 2)})
+    phase[0] = 'post_kill'
+    _pump(8.0)
+
+    if not smoke:
+      # Drain handoff: replacement joins, survivor drains out.
+      new_port = _free_port()
+      children[2] = _spawn_replica_child(cfg_kwargs, new_port)
+      sinks[2] = []
+      _watch(2)
+      info = _ready_info(2, time.monotonic() + 180.0)
+      if info is None:
+        errors.append('replacement replica never became ready')
+        return results, errors
+      addrs[2] = f"127.0.0.1:{info['port']}"
+      router.add_replica(addrs[2])
+      timeline.append({'event': 'replacement_joined',
+                       'wall': round(time.monotonic() - t0, 2)})
+      children[1].send_signal(signal_lib.SIGTERM)
+      timeline.append({'event': 'sigterm_drain', 'replica': addrs[1],
+                       'wall': round(time.monotonic() - t0, 2)})
+      phase[0] = 'post_drain'
+      _pump(8.0)
+
+    starvation = nonlocal_starvation[0]
+    rstats = router.stats()
+    verdict = evaluator.verdict()
+    with open(os.path.join(logdir, 'SLO_VERDICT.json'), 'w') as f:
+      json.dump(verdict, f, indent=2, sort_keys=True)
+    results.update({
+        'wall_secs': round(time.monotonic() - t0, 2),
+        'served': dict(served),
+        'starvation': starvation,
+        'router': rstats,
+        'slo_verdict': {'pass': verdict.get('pass'),
+                        'violations': verdict.get('violations')},
+    })
+
+    # --- The headline: the kill cost its in-flight request at most;
+    # everything after was served, and the verdict stayed green.
+    if served['post_kill'] == 0:
+      errors.append('no traffic served after the SIGKILL')
+    if starvation:
+      errors.append(f'router starved {starvation}x '
+                    '(NoReplicasAvailable after warm-up)')
+    if rstats['route_failovers'] < 1:
+      errors.append('the kill never exercised the failover path '
+                    f'(failovers={rstats["route_failovers"]})')
+    if not verdict.get('pass'):
+      errors.append(f"routed SLO verdict FAILED: "
+                    f"{verdict.get('violations')}")
+    by_addr = {r['address']: r for r in rstats['replicas']}
+    if not smoke:
+      if served['post_drain'] == 0:
+        errors.append('no traffic served after the drain')
+      drained = by_addr.get(addrs[1], {})
+      if not drained.get('draining'):
+        errors.append(f'the SIGTERM\'d replica never advertised '
+                      f'draining: {drained}')
+      if by_addr.get(addrs[2], {}).get('serves', 0) == 0:
+        errors.append('the replacement replica never served')
+    return results, errors
+  finally:
+    if router is not None:
+      router.close()
+    for p in children.values():
+      if p.poll() is None:
+        p.terminate()
+    for p in children.values():
+      try:
+        p.communicate(timeout=20)
+      except subprocess.TimeoutExpired:
+        p.kill()
+        p.communicate()
+
+
 def _run_corruption_subprocess():
   """CHAOS_STORM=all path: the corruption storm needs its own process
   (XLA device-count flags must precede the jax import, and the other
@@ -1974,6 +2277,13 @@ def main():
     with tempfile.TemporaryDirectory(prefix='chaos_elastic_') as logdir:
       results['elastic'], elastic_errors = run_elastic_storm(logdir)
     errors += [f'elastic: {e}' for e in elastic_errors]
+  if which == 'routed':
+    # Dedicated invocation only (the ci.sh serving lane): replica
+    # startup is real-process jit compile — folding it into
+    # CHAOS_STORM=all would stretch the default storm budget.
+    with tempfile.TemporaryDirectory(prefix='chaos_routed_') as logdir:
+      results['routed'], routed_errors = run_routed_storm(logdir)
+    errors += [f'routed: {e}' for e in routed_errors]
   if which == 'corruption':
     with tempfile.TemporaryDirectory(prefix='chaos_corr_') as logdir:
       results['corruption'], corruption_errors = \
@@ -2001,6 +2311,8 @@ def main():
                         results.get('controller', {}).get('wall_secs'),
                     'elastic_wall_secs':
                         results.get('elastic', {}).get('wall_secs'),
+                    'routed_wall_secs':
+                        results.get('routed', {}).get('wall_secs'),
                     'corruption_wall_secs':
                         results.get('corruption', {}).get('wall_secs'),
                     'violations': errors,

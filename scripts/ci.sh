@@ -303,4 +303,17 @@ XLA_FLAGS='--xla_force_host_platform_device_count=8' \
   BENCH_SMOKE=1 BENCH_ONLY=mesh2d python bench.py
 python scripts/lint.py --check sharding-registry
 
+echo '== serving lane (round 21: the multi-tenant serving plane — the'
+echo '   version-table/codec/AOT/routing/wire-v10 unit suite + the'
+echo '   slow-marked 3-process routed drill, then the serving bench'
+echo '   rows (int8 parity gate + wire bytes + publish/flip blackout'
+echo '   + resident split) and the routed chaos storm: SIGKILL a'
+echo '   serving replica under judged traffic, the router fails over'
+echo '   with zero starvation and a green routed-latency verdict'
+echo '   — <120 s CPU) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
+  -p no:cacheprovider
+BENCH_SMOKE=1 BENCH_ONLY=serving python bench.py
+CHAOS_SMOKE=1 CHAOS_STORM=routed python scripts/chaos.py
+
 echo 'CI OK'
