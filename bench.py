@@ -37,6 +37,7 @@ so a clipped tail still ends on one complete object.
 import json
 import os
 import tempfile
+import threading
 import time
 
 
@@ -2778,6 +2779,121 @@ def bench_serving(smoke):
   return results
 
 
+def bench_population(smoke):
+  """The population engine (round 22; population.py, docs/PERF.md
+  r22). Two measured claims:
+
+  1. Curriculum tax: the SAME fused Anakin procgen run with
+     --curriculum=uniform vs --curriculum=regret — the prioritized
+     sampler, per-level EMA fold, and score-table carry all live
+     INSIDE the jitted step (zero host round trips per level
+     decision), so the acceptance gate is fps within 5% of uniform.
+     The regret row also reports the per-level telemetry (entropy,
+     levels visited) so the row shows the curriculum actually DROVE
+     the distribution, not just cost nothing.
+  2. Padding waste: a mixed-suite request stream (16x16 cue-scale
+     frames + 24x32 gridworld-scale frames, 2:1) through the REAL
+     C++ batcher behind ops/dynamic_batching.FamilyBatcher —
+     per-obs-spec-family queues merge rows at their exact shape, so
+     padded bytes == useful bytes; the reported waste_ratio is what
+     the SAME stream would have paid under naive pad-to-fleet-max
+     (the measured elimination claim).
+  """
+  import numpy as np
+  import jax
+  from scalable_agent_tpu.config import Config
+  from scalable_agent_tpu.ops import dynamic_batching
+  from scalable_agent_tpu.parallel import anakin
+  from scalable_agent_tpu.parallel import mesh as mesh_lib
+
+  n_dev = len(jax.devices())
+  steps = 200 if not smoke else 3
+  t = 20 if not smoke else 3
+  b = 256 if not smoke else 8
+  b = max(b - b % n_dev, n_dev)
+  mesh = mesh_lib.make_mesh() if n_dev > 1 else None
+  out = {'devices': n_dev,
+         'config': 'procgen, shallow, 24x32, T=%d, B=%d, %d step(s)'
+                   % (t, b, steps)}
+
+  for mode in ('uniform', 'regret'):
+    cfg = Config(env_backend='procgen', batch_size=b,
+                 unroll_length=t, num_action_repeats=1,
+                 episode_length=12, height=24, width=32,
+                 torso='shallow',
+                 compute_dtype='bfloat16' if not smoke else 'float32',
+                 use_instruction=False, use_py_process=False,
+                 learning_rate=2e-3, entropy_cost=3e-3,
+                 discounting=0.9, total_environment_frames=10**9,
+                 curriculum=mode, procgen_num_levels=8, seed=0)
+    _, history, fps = anakin.run(cfg, steps, mesh=mesh)
+    row = {'env_frames_per_sec': round(fps, 1), 'batch_size': b}
+    if mode != 'uniform':
+      last = history[-1]
+      row.update({
+          'curriculum_entropy': round(
+              float(last['curriculum_entropy']), 3),
+          'levels_visited': int(last['curriculum_levels_visited']),
+          'score_max': round(float(last['curriculum_score_max']), 4),
+      })
+    out[mode] = row
+  overhead = 1.0 - (out['regret']['env_frames_per_sec'] /
+                    max(out['uniform']['env_frames_per_sec'], 1e-9))
+  out['curriculum_overhead_fraction'] = round(overhead, 4)
+  out['curriculum_gate'] = {'threshold': 0.05,
+                            'pass': bool(overhead <= 0.05)}
+
+  # --- Mixed-suite padding waste through the real batcher. ---
+  def make_fn(key):
+    def handler(*arrays):
+      # Row-wise reduce: enough work to exercise the padded staging
+      # without turning the row into a compute bench.
+      return [np.ascontiguousarray(
+          arrays[0].reshape(arrays[0].shape[0], -1).sum(-1))]
+    return handler
+
+  fb = dynamic_batching.FamilyBatcher(
+      make_fn, minimum_batch_size=1, maximum_batch_size=256,
+      timeout_ms=2)
+  small = np.zeros((1, 16, 16, 3), np.uint8)
+  large = np.zeros((1, 24, 32, 3), np.uint8)
+  requests = 600 if not smoke else 60
+  workers = 6
+  errors = []
+
+  def pump(worker):
+    try:
+      for i in range(requests // workers):
+        # 2:1 small:large — the heterogeneous composition a mixed
+        # cue+gridworld fleet produces.
+        fb(small if (worker + i) % 3 else large)
+    except Exception as exc:  # pragma: no cover - surfaced below
+      errors.append(exc)
+
+  threads = [threading.Thread(target=pump, args=(w,))
+             for w in range(workers)]
+  start = time.perf_counter()
+  for th in threads:
+    th.start()
+  for th in threads:
+    th.join()
+  elapsed = time.perf_counter() - start
+  stats = fb.padding_stats()
+  fb.close()
+  if errors:
+    raise errors[0]
+  out['padding'] = {
+      'requests': requests,
+      'families': int(stats['families']),
+      'rows_per_sec': round(stats['rows'] / max(elapsed, 1e-9), 1),
+      'useful_bytes': stats['useful_bytes'],
+      'bucketed_bytes': stats['bucketed_bytes'],
+      'max_shape_bytes': stats['max_shape_bytes'],
+      'waste_ratio': round(stats['waste_ratio'], 4),
+  }
+  return out
+
+
 def main():
   # Child half of the multihost stage: a fresh interpreter dispatched
   # by bench_multihost — must run before any jax/backend setup below.
@@ -2939,6 +3055,21 @@ def main():
     })
     return
 
+  # BENCH_ONLY=population: just the population-engine rows (the
+  # scripts/ci.sh population lane — curriculum on/off fused fps with
+  # the <=5% gate, and the mixed-suite padding-waste row).
+  if os.environ.get('BENCH_ONLY') == 'population':
+    pop = bench_population(smoke)
+    _emit({
+        'metric': 'curriculum_overhead_fraction',
+        'value': pop.get('curriculum_overhead_fraction'),
+        'unit': ('fused-loop fps fraction lost with the in-graph '
+                 'regret curriculum on, gate <= 0.05%s'
+                 % (' (SMOKE)' if smoke else '')),
+        'population': pop,
+    })
+    return
+
   # BENCH_ONLY=serving: just the multi-tenant serving-plane rows (the
   # scripts/ci.sh serving lane — resident versions, int8 parity +
   # wire bytes, flip blackout AOT warm/cold, router overhead).
@@ -3001,6 +3132,9 @@ def main():
   serving_rows = None
   if os.environ.get('BENCH_SKIP_SERVING') != '1':
     serving_rows = bench_serving(smoke)
+  pop_rows = None
+  if os.environ.get('BENCH_SKIP_POPULATION') != '1':
+    pop_rows = bench_population(smoke)
 
   baseline_per_chip = 200_000.0 / 16.0  # north star / v5e-16 chips
   out = {
@@ -3054,6 +3188,8 @@ def main():
     out['mesh2d'] = mesh2d_rows
   if serving_rows is not None:
     out['serving'] = serving_rows
+  if pop_rows is not None:
+    out['population'] = pop_rows
   _emit(out)
 
 
@@ -3233,6 +3369,22 @@ def _headline(out):
         'step_ms_ratio': m2d.get('step_ms_ratio'),
         'dp_step_ms': (m2d.get('dp') or {}).get('step_ms'),
         'mesh2d_step_ms': (m2d.get('mesh2d') or {}).get('step_ms')}
+  # The population-engine rows (round 22): curriculum tax vs the <=5%
+  # gate + the mixed-suite padding-waste elimination — the clip-safe
+  # record the --curriculum default flip is judged on.
+  pop = out.get('population')
+  if pop:
+    head['population'] = {
+        'curriculum_overhead_fraction':
+            pop.get('curriculum_overhead_fraction'),
+        'curriculum_gate_pass': (pop.get('curriculum_gate')
+                                 or {}).get('pass'),
+        'uniform_fps': (pop.get('uniform') or {}).get(
+            'env_frames_per_sec'),
+        'regret_fps': (pop.get('regret') or {}).get(
+            'env_frames_per_sec'),
+        'padding_waste_ratio': (pop.get('padding') or {}).get(
+            'waste_ratio')}
   return head
 
 

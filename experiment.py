@@ -598,6 +598,60 @@ flags.DEFINE_integer('process_id', _DEFAULTS.process_id,
                      "This process's index in [0, num_processes); -1 "
                      'defers to max(--task, 0) (the reference\'s '
                      '--task spelling).')
+flags.DEFINE_enum('curriculum', _DEFAULTS.curriculum,
+                  ['uniform', 'regret', 'td'],
+                  'In-graph auto-curriculum over the procgen level '
+                  'set (population.py): uniform keeps the reference '
+                  'draw; regret prioritizes positive value loss per '
+                  'level (the PLR proxy), td prioritizes |TD error|. '
+                  'Sampler + score update ride INSIDE the fused '
+                  'anakin step — zero host round trips per level '
+                  'decision.')
+flags.DEFINE_float('curriculum_temperature',
+                   _DEFAULTS.curriculum_temperature,
+                   'Softmax temperature over per-level scores.')
+flags.DEFINE_float('curriculum_eps', _DEFAULTS.curriculum_eps,
+                   'Uniform mixing floor of the curriculum sampler '
+                   '(every level keeps nonzero visitation — the '
+                   'staleness escape hatch).')
+flags.DEFINE_float('curriculum_alpha', _DEFAULTS.curriculum_alpha,
+                   'Per-level score EMA step size.')
+flags.DEFINE_float('curriculum_decay', _DEFAULTS.curriculum_decay,
+                   'Per-fused-step score decay for levels the batch '
+                   'did not visit (stale scores lose authority).')
+flags.DEFINE_integer('procgen_num_levels', _DEFAULTS.procgen_num_levels,
+                     'Procgen level-set size (the curriculum\'s '
+                     'support); honored by both runtimes.')
+flags.DEFINE_float('procgen_wall_density', _DEFAULTS.procgen_wall_density,
+                   'Bernoulli wall rate of each procgen layout; '
+                   'raising it past ~0.35 makes some levels '
+                   'goal-unreachable (the skewed-difficulty regime '
+                   'the regret curriculum exploits).')
+flags.DEFINE_string('fleet_tasks', _DEFAULTS.fleet_tasks,
+                    "Heterogeneous fleet spec, e.g. "
+                    "'bandit:2,gridworld:1': one fleet's actors "
+                    'split across jittable suites by weight '
+                    '(largest-remainder apportionment = the per-task '
+                    "frame budget). '' = single-task (unchanged).")
+flags.DEFINE_integer('pbt_population', _DEFAULTS.pbt_population,
+                     'Minimal PBT (population.py): >= 2 trains that '
+                     'many anakin learner replicas under one driver '
+                     'invocation with within-suite exploit/explore '
+                     'over (learning_rate, entropy_cost); 0 = off.')
+flags.DEFINE_integer('pbt_round_frames', _DEFAULTS.pbt_round_frames,
+                     'Frames each member trains between PBT decision '
+                     'points (0 = auto: a quarter of the per-member '
+                     'budget).')
+flags.DEFINE_string('pbt_suites', _DEFAULTS.pbt_suites,
+                    'Comma-separated jittable backends assigned '
+                    "round-robin to population members; '' = the "
+                    "run's own env_backend.")
+flags.DEFINE_float('pbt_quantile', _DEFAULTS.pbt_quantile,
+                   'Bottom/top fraction per suite for exploit '
+                   'decisions (in (0, 0.5]).')
+flags.DEFINE_float('pbt_perturb', _DEFAULTS.pbt_perturb,
+                   'Explore step: each inherited hyper multiplies or '
+                   'divides by this factor (fair coin).')
 
 FLAGS = flags.FLAGS
 

@@ -537,7 +537,8 @@ def documented_summary_tags(ctx: CheckContext) -> Set[str]:
   end = doc.find(SUMMARY_BLOCK_END)
   if start < 0 or end < 0:
     return set()
-  return set(re.findall(r'`([a-z0-9_]+)`', doc[start:end]))
+  # Tags may be namespaced with '/' (e.g. population/best_return).
+  return set(re.findall(r'`([a-z0-9_/]+)`', doc[start:end]))
 
 
 @checker('summary-scalars',

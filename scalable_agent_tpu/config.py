@@ -609,6 +609,68 @@ class Config:
   # weighted round-robin, drain on leave, wire v10). '' = no routed
   # serving (params are fetched and inference stays host-local).
   serving_replicas: str = ''
+  # --- Population engine (round 22; population.py,
+  # docs/PARALLELISM.md §population). ---
+  # In-graph auto-curriculum over the procgen level set (anakin
+  # runtime AND the hybrid filler — both reach the core through
+  # anakin.make_env_core): 'uniform' keeps the reference draw;
+  # 'regret' EMAs positive value loss per level (the PLR proxy,
+  # arXiv 2010.03934); 'td' EMAs |TD error|. Sampler and score update
+  # both live INSIDE the fused device step — zero host round trips
+  # per level decision. DEFAULT stays 'uniform' per the measured
+  # accept/reject discipline: bench.py's population stage measures
+  # the curriculum fps delta every round, and the regret default flip
+  # is parked in ROADMAP housekeeping (b) pending chip rows.
+  curriculum: str = 'uniform'             # uniform | regret | td
+  curriculum_temperature: float = 1.0     # score-softmax temperature
+  curriculum_eps: float = 0.1             # uniform mixing floor — every
+                                          # level keeps >0 visitation
+                                          # (the staleness escape hatch)
+  curriculum_alpha: float = 0.3           # per-level score EMA step
+  curriculum_decay: float = 0.995         # unvisited-level score decay
+                                          # per fused step (staleness)
+  # Procgen level-set size (envs/jittable.ProcgenCore) — the
+  # curriculum's support. Both runtimes honor it (the host wrapper
+  # receives it through the factory), so the anakin-vs-fleet parity
+  # gate holds at any value.
+  procgen_num_levels: int = 8
+  # Procgen wall density: the Bernoulli rate of the per-level wall
+  # mask. 0.25 (the prior hard-coded value) keeps most levels
+  # solvable; raising it makes a growing fraction of layouts
+  # goal-unreachable — the skewed-difficulty regime where curriculum
+  # prioritization structurally beats uniform sampling (unlearnable
+  # levels' regret scores decay to zero, so the sampler stops paying
+  # for them; uniform keeps wasting 1/n of every batch per dead
+  # level).
+  procgen_wall_density: float = 0.25
+  # Heterogeneous fleet composition (fleet runtime, round 22): '' =
+  # single-task (unchanged). 'bandit:2,gridworld:1' runs ONE fleet
+  # whose actors split across jittable suites by largest-remainder
+  # weight apportionment (population.plan_actor_assignment — the
+  # per-task frame budget IS the actor share), with per-task PopArt
+  # statistics and per-task return curves riding the existing
+  # level-id machinery. All tasks share the model's frame shape
+  # (config.height x width); obs-spec FAMILY bucketing in the dynamic
+  # batcher keeps mixed shapes merge-local (ops/dynamic_batching.
+  # FamilyBatcher).
+  fleet_tasks: str = ''
+  # Minimal PBT across learner replicas (round 22; population.py,
+  # arXiv 1711.09846): 0 = off; >= 2 trains that many independent
+  # anakin-runtime members under ONE driver invocation
+  # (<logdir>/member_<k>), suites assigned round-robin from
+  # pbt_suites. Every pbt_round_frames frames per member, process 0
+  # ranks members WITHIN their suite (cross-suite returns are not
+  # commensurable) and bottom-quantile members inherit a top-quantile
+  # donor's weights through the checkpoint ladder (verified save ->
+  # re-verified restore) with (learning_rate, entropy_cost) perturbed
+  # by pbt_perturb — each exploit is a durable pbt_exploit incident.
+  pbt_population: int = 0
+  pbt_round_frames: int = 0               # frames/member/round (0 =
+                                          # auto: 1/4 of the budget)
+  pbt_suites: str = ''                    # comma-separated jittable
+                                          # backends; '' = env_backend
+  pbt_quantile: float = 0.25              # exploit bottom/top fraction
+  pbt_perturb: float = 1.2                # explore factor (x or /)
 
   @property
   def frames_per_step(self):
@@ -691,6 +753,25 @@ class Config:
     if self.level_name == 'dmlab30':
       return True
     return self.level_name.startswith(('language_', 'psychlab_'))
+
+  @property
+  def resolved_pbt_suites(self) -> List[str]:
+    """The population's suite list: the explicit comma list, else the
+    run's own backend repeated — members then differ only in hypers
+    (classic single-task PBT)."""
+    if self.pbt_suites:
+      return [s.strip() for s in self.pbt_suites.split(',')
+              if s.strip()]
+    return [self.env_backend]
+
+  @property
+  def resolved_pbt_round_frames(self) -> int:
+    """Frames each member trains between PBT decision points (0-auto:
+    a quarter of the per-member budget — 4 rounds, enough for one
+    exploit to propagate and still show post-exploit learning)."""
+    if self.pbt_round_frames > 0:
+      return self.pbt_round_frames
+    return max(self.total_environment_frames // 4, 1)
 
 
 def validate_replay(config: Config) -> List[str]:
@@ -1204,6 +1285,142 @@ def validate_serving(config: Config) -> List[str]:
         'serving_replicas set without learner_address: routed '
         'inference replicas are an ACTOR-host knob — the learner '
         'role ignores it')
+  return warnings
+
+
+def validate_population(config: Config) -> List[str]:
+  """Validate the population knob group (round 22); raises ValueError
+  on hard errors, returns warnings (same contract as the other
+  validate_* groups — driver.train AND driver.evaluate call it before
+  spin-up). Covers the three population axes: curriculum, mixed
+  fleets, PBT."""
+  warnings = []
+  # --- Curriculum. ---
+  if config.curriculum not in ('uniform', 'regret', 'td'):
+    raise ValueError(f'curriculum must be uniform|regret|td, got '
+                     f'{config.curriculum!r}')
+  if config.curriculum_temperature <= 0:
+    raise ValueError(f'curriculum_temperature must be > 0, got '
+                     f'{config.curriculum_temperature}')
+  if not 0.0 <= config.curriculum_eps <= 1.0:
+    raise ValueError(f'curriculum_eps must be in [0, 1], got '
+                     f'{config.curriculum_eps}')
+  if not 0.0 < config.curriculum_alpha <= 1.0:
+    raise ValueError(f'curriculum_alpha must be in (0, 1], got '
+                     f'{config.curriculum_alpha}')
+  if not 0.0 < config.curriculum_decay <= 1.0:
+    raise ValueError(f'curriculum_decay must be in (0, 1], got '
+                     f'{config.curriculum_decay}')
+  if config.procgen_num_levels < 1:
+    raise ValueError(f'procgen_num_levels must be >= 1, got '
+                     f'{config.procgen_num_levels}')
+  if not 0.0 <= config.procgen_wall_density < 1.0:
+    raise ValueError(f'procgen_wall_density must be in [0, 1), got '
+                     f'{config.procgen_wall_density}')
+  if config.curriculum != 'uniform':
+    curriculum_backends = {config.env_backend}
+    if config.anakin_filler:
+      curriculum_backends.add(config.resolved_filler_backend)
+    if 'procgen' not in curriculum_backends:
+      warnings.append(
+          'curriculum=%s with env_backend=%r: only the procgen core '
+          'has a finite level-id space to prioritize — the sampler '
+          'is inert for this run' %
+          (config.curriculum, config.env_backend))
+    if config.unroll_length < 2:
+      warnings.append(
+          'curriculum=%s with unroll_length=1: a TD error needs two '
+          'consecutive value estimates, so no per-level signal can '
+          'accumulate (scores only decay) — use unroll_length >= 2' %
+          config.curriculum)
+    if config.curriculum_eps == 0:
+      warnings.append(
+          'curriculum_eps=0: no uniform mixing floor — a level whose '
+          'score collapses early may never be revisited, so its stale '
+          'score cannot recover (the decay then has nothing to rescue)')
+  # --- Heterogeneous fleets. ---
+  if config.fleet_tasks:
+    from scalable_agent_tpu import population as _population
+    tasks = _population.parse_fleet_tasks(config.fleet_tasks)
+    if not tasks:
+      raise ValueError(f'fleet_tasks={config.fleet_tasks!r} names no '
+                       'tasks')
+    names = [name for name, _ in tasks]
+    for name in names:
+      if name not in JITTABLE_BACKENDS:
+        raise ValueError(
+            f'fleet_tasks names {name!r}: mixed fleets compose the '
+            f'jittable suites ({", ".join(JITTABLE_BACKENDS)}) — '
+            'real simulators keep their own single-task fleets')
+    if 'cue_memory' in names and any(n in ('gridworld', 'procgen')
+                                     for n in names):
+      raise ValueError(
+          'fleet_tasks mixes cue_memory (a fixed 3-action task) with '
+          'gridworld/procgen (>= 4 movement actions): one shared '
+          'policy head cannot satisfy both — drop one side or widen '
+          'with bandit (any head width)')
+    if config.runtime == 'anakin':
+      warnings.append(
+          'fleet_tasks is a fleet-runtime feature (per-actor task '
+          'assignment); runtime=anakin runs env_backend=%r only — '
+          'the spec is ignored' % config.env_backend)
+    elif len(tasks) > config.num_actors:
+      raise ValueError(
+          f'fleet_tasks names {len(tasks)} tasks but num_actors='
+          f'{config.num_actors} cannot cover them at >= 1 actor each')
+    if not config.use_popart and len(tasks) > 1:
+      warnings.append(
+          'fleet_tasks mixes %d suites with use_popart=False: reward '
+          'scales will compete in one value head — consider '
+          '--use_popart' % len(tasks))
+  # --- PBT. ---
+  if config.pbt_population < 0:
+    raise ValueError(f'pbt_population must be >= 0, got '
+                     f'{config.pbt_population}')
+  if config.pbt_round_frames < 0:
+    raise ValueError(f'pbt_round_frames must be >= 0, got '
+                     f'{config.pbt_round_frames}')
+  if not 0.0 < config.pbt_quantile <= 0.5:
+    raise ValueError(f'pbt_quantile must be in (0, 0.5] (bottom and '
+                     f'top slices must not overlap), got '
+                     f'{config.pbt_quantile}')
+  if config.pbt_perturb <= 1.0:
+    raise ValueError(f'pbt_perturb must be > 1 (the explore factor '
+                     f'multiplies OR divides), got '
+                     f'{config.pbt_perturb}')
+  if config.pbt_population == 1:
+    warnings.append(
+        'pbt_population=1: a population of one has no donor to '
+        'exploit — PBT is off (use >= 2, ideally >= 2 per suite)')
+  if config.pbt_population >= 2:
+    if config.runtime != 'anakin':
+      raise ValueError(
+          'pbt_population >= 2 needs --runtime=anakin: population '
+          'members are fused-loop replicas (the fleet runtime owns '
+          'the host devices exclusively — replicas would contend)')
+    suites = config.resolved_pbt_suites
+    for suite in suites:
+      if suite not in JITTABLE_BACKENDS:
+        raise ValueError(
+            f'pbt_suites names {suite!r}: population members are '
+            f'anakin runs and need jittable backends '
+            f'({", ".join(JITTABLE_BACKENDS)})')
+    if 'cue_memory' in suites and any(s in ('gridworld', 'procgen')
+                                      for s in suites):
+      raise ValueError(
+          'pbt_suites mixes cue_memory (fixed 3-action) with '
+          'gridworld/procgen (>= 4 actions): members share one agent '
+          'architecture, so their policy heads must be one width')
+    if config.pbt_population < len(suites):
+      raise ValueError(
+          f'pbt_population={config.pbt_population} cannot cover '
+          f'{len(suites)} suites at >= 1 member each')
+    if config.pbt_population < 2 * len(suites):
+      warnings.append(
+          'pbt_population=%d over %d suite(s): some suites get a '
+          'single member, and exploit/explore only fires WITHIN a '
+          'suite — size the population at >= 2 per suite' %
+          (config.pbt_population, len(suites)))
   return warnings
 
 

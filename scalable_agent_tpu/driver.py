@@ -28,6 +28,7 @@ import inspect
 import json
 import logging
 import os
+import shutil
 import sys
 import threading
 import time
@@ -44,12 +45,14 @@ from scalable_agent_tpu import controller as controller_lib
 from scalable_agent_tpu import health as health_lib
 from scalable_agent_tpu import learner as learner_lib
 from scalable_agent_tpu import observability
+from scalable_agent_tpu import population as population_lib
 from scalable_agent_tpu import slo as slo_lib
 from scalable_agent_tpu import telemetry
 from scalable_agent_tpu.analysis import runtime as lock_check
 from scalable_agent_tpu.config import (Config, validate_controller,
                                        validate_distributed,
                                        validate_integrity,
+                                       validate_population,
                                        validate_replay,
                                        validate_runtime,
                                        validate_serving, validate_slo,
@@ -133,6 +136,17 @@ def make_fleet(config: Config, agent, policy, buffer, levels,
   level assignment, and fleet size). Actor i plays
   levels[(level_offset + i) % len] with env seed `seed_base + i + 1`.
 
+  Heterogeneous fleets (round 22): when config.fleet_tasks is set AND
+  `levels` is exactly its task-name list (train() arranges this), the
+  fleet mixes SUITES — actor i's task comes from the weighted
+  largest-remainder plan (population.plan_actor_assignment), its env
+  spec is built for THAT task's backend, and level_name_id is the
+  task index (one PopArt slot + one EpisodeStats curve per task). The
+  declared weights are the per-task frame budgets: actors produce at
+  the same rate, so actor share == frame share. Callers that pass
+  ordinary level lists (evaluate on one backend, remote actors) are
+  untouched.
+
   `initial_state_fn` builds each actor's policy core state, called
   fresh at every (re)spawn — pass the InferenceServer's
   `initial_core_state` so state-cache mode hands each actor a zeroed
@@ -155,13 +169,23 @@ def make_fleet(config: Config, agent, policy, buffer, levels,
   # respawns both run on the learner thread) — first spawn vs respawn
   # picks the admission priority class.
   spawns = collections.Counter()
+  task_plan = None
+  if config.fleet_tasks:
+    tasks = population_lib.parse_fleet_tasks(config.fleet_tasks)
+    if [name for name, _ in tasks] == list(levels):
+      task_plan = population_lib.plan_actor_assignment(tasks, n)
 
   def make_actor(i):
     idx = level_offset + i
+    if task_plan is not None:
+      # Task identity is a function of the SLOT (idx), not the spawn:
+      # a respawned actor rejoins its task's frame budget.
+      idx = task_plan[idx % len(task_plan)]
     level = levels[idx % len(levels)]
+    backend = level if task_plan is not None else None
     spec = factory.make_env_spec(config, level,
                                  seed=seed_base + i + 1,
-                                 is_test=is_test)
+                                 is_test=is_test, backend=backend)
     env, process = factory.build_environment(
         spec, use_py_process=config.use_py_process)
     # Fault-injection seam (runtime/faults.py): identity unless an
@@ -339,6 +363,17 @@ def train(config: Config, max_steps: Optional[int] = None,
   # instrumentation.
   if config.lock_order_check:
     lock_check.arm()
+  if config.pbt_population >= 2:
+    # PBT (round 22): the population loop owns the members' anakin
+    # runs end to end — dispatch before any fleet machinery exists
+    # (train_population validates the knob group itself, hard errors
+    # included: a non-anakin runtime is rejected there).
+    if fleet_factory is not None:
+      raise ValueError('fleet_factory is a fleet-runtime seam; PBT '
+                       'members are fused-loop anakin replicas')
+    return train_population(config, max_steps=max_steps,
+                            max_seconds=max_seconds,
+                            drain_event=drain_event)
   if config.runtime == 'anakin':
     if fleet_factory is not None:
       raise ValueError('fleet_factory is a fleet-runtime seam; '
@@ -354,7 +389,26 @@ def train(config: Config, max_steps: Optional[int] = None,
     raise ValueError('max_seconds is single-host only; bound multi-host '
                      'runs by max_steps/total_environment_frames')
   levels = factory.level_names(config)
-  spec0 = factory.make_env_spec(config, levels[0], seed=1)
+  fleet_tasks = population_lib.parse_fleet_tasks(config.fleet_tasks)
+  if fleet_tasks:
+    # Heterogeneous fleet (round 22): the task list REPLACES the level
+    # list — one PopArt slot and one EpisodeStats curve per TASK, and
+    # make_fleet recognizes this exact list and applies the weighted
+    # actor plan. One policy head serves every task, so the per-task
+    # action widths must agree (validate_population rejects the known
+    # conflicts; this catches default-width drift, e.g. bandit's 3 vs
+    # gridworld's 4 — pin --num_actions to resolve).
+    levels = [name for name, _ in fleet_tasks]
+    specs = [factory.make_env_spec(config, name, seed=1, backend=name)
+             for name in levels]
+    widths = sorted({s.num_actions for s in specs})
+    if len(widths) > 1:
+      raise ValueError(
+          f'fleet_tasks suites disagree on action width {widths}: one '
+          'shared policy head needs one width — set --num_actions')
+    spec0 = specs[0]
+  else:
+    spec0 = factory.make_env_spec(config, levels[0], seed=1)
   num_actions = spec0.num_actions
   agent = build_agent(config, num_actions, num_tasks=len(levels))
   params = init_params(agent, jax.random.PRNGKey(config.seed),
@@ -410,6 +464,12 @@ def train(config: Config, max_steps: Optional[int] = None,
   # Serving-plane knob group (round 21): multi-tenant residency,
   # A/B + shadow fractions, routed-inference topology cross-links.
   for warning in validate_serving(config):
+    log.warning('%s', warning)
+  # Population knob group (round 22): curriculum ranges, mixed-fleet
+  # composition, PBT topology — hard errors raise here (before the
+  # mesh/fleet spin-up below); cross-links (curriculum on a backend
+  # with no level space, multi-suite without PopArt) log.
+  for warning in validate_population(config):
     log.warning('%s', warning)
   # NOTE round 8: the fused Pallas V-trace is no longer rejected under
   # a mesh — the sharded step runs it shard_map'ped over the data axis
@@ -2255,7 +2315,8 @@ def train_anakin(config: Config, max_steps: Optional[int] = None,
                      '--model_parallelism')
   # Knob-group validation, same contract as train(): hard errors
   # raise before any spin-up cost; cross-links log.
-  for validate in (validate_runtime, validate_slo):
+  for validate in (validate_runtime, validate_slo,
+                   validate_population):
     for warning in validate(config):
       log.warning('%s', warning)
   if config.controller != 'off':
@@ -2356,6 +2417,29 @@ def train_anakin(config: Config, max_steps: Optional[int] = None,
       telemetry.gauge('driver/learner_plane_utilization',
                       fn=lambda: 1.0),
   ]
+  # Curriculum telemetry (round 22): the fused step already folds the
+  # per-level score/visit tables and their scalar digests into the
+  # stacked metrics; these registry gauges re-export the latest
+  # summary-read values so the SLO engine and scripts see them under
+  # registry names without an extra device sync (zero host round
+  # trips stays true — the dict updates at the summary cadence from
+  # the one-step-delayed read the loop does anyway).
+  curriculum_latest: Dict[str, float] = {}
+  if config.curriculum != 'uniform':
+    _loop_gauges += [
+        telemetry.gauge(
+            'curriculum/entropy',
+            fn=lambda: curriculum_latest.get('curriculum_entropy',
+                                             0.0)),
+        telemetry.gauge(
+            'curriculum/levels_visited',
+            fn=lambda: curriculum_latest.get(
+                'curriculum_levels_visited', 0.0)),
+        telemetry.gauge(
+            'curriculum/score_max',
+            fn=lambda: curriculum_latest.get('curriculum_score_max',
+                                             0.0)),
+    ]
   sync_every = anakin_lib._cpu_mesh_sync_every(mesh)
   pending_metrics = None
   prev_metrics = None
@@ -2485,8 +2569,12 @@ def train_anakin(config: Config, max_steps: Optional[int] = None,
         last_summary = now
         _, handle = (prev_metrics if prev_metrics is not None
                      else pending_metrics)
-        writer.scalars(observability.read_stacked_metrics(handle),
-                       step_now)
+        vals = observability.read_stacked_metrics(handle)
+        writer.scalars(vals, step_now)
+        if config.curriculum != 'uniform':
+          curriculum_latest.update(
+              {k: v for k, v in vals.items()
+               if k.startswith('curriculum_')})
         writer.scalar('env_frames_per_sec', fps_meter.fps(), step_now)
         if health is not None:
           hs = health.stats()
@@ -2539,6 +2627,32 @@ def train_anakin(config: Config, max_steps: Optional[int] = None,
                         step_final)
         except Exception:
           log.exception('final summary flush failed')
+      # Per-level curriculum artifact (round 22): the final score /
+      # visit tables plus the live sampling distribution — the
+      # machine-readable answer to "which levels got the frames"
+      # (scripts and the CI population lane read this, not summaries).
+      if (config.curriculum != 'uniform' and
+          hasattr(carry.env_state, 'level_scores')):
+        try:
+          scores = np.asarray(
+              jax.device_get(carry.env_state.level_scores))
+          visits = np.asarray(
+              jax.device_get(carry.env_state.level_visits))
+          probs = np.asarray(population_lib.level_probs(
+              scores, config.curriculum_temperature,
+              config.curriculum_eps))
+          curriculum_path = os.path.join(config.logdir,
+                                         'CURRICULUM_LEVELS.json')
+          with open(curriculum_path, 'w') as f:
+            json.dump({'curriculum': config.curriculum,
+                       'temperature': config.curriculum_temperature,
+                       'eps': config.curriculum_eps,
+                       'scores': [float(s) for s in scores],
+                       'visits': [float(v) for v in visits],
+                       'probs': [float(p) for p in probs]},
+                      f, indent=2)
+        except Exception:
+          log.exception('curriculum artifact write failed')
       unhealthy_exit = health is not None and bad_count_in_burst > 0
       if unhealthy_exit:
         log.warning('skipping final checkpoint: training was '
@@ -2554,6 +2668,260 @@ def train_anakin(config: Config, max_steps: Optional[int] = None,
       for gauge in _loop_gauges:
         telemetry.registry().unregister(gauge.name, gauge)
   return run
+
+
+def _member_return(member_dir: str, tag: str = 'mean_reward',
+                   tail: int = 5) -> float:
+  """A member's fitness: the mean of its last `tail` summary values
+  for `tag` (step-ordered). Summaries append across rounds, so the
+  tail reflects the round just finished. Missing/empty summaries
+  score 0.0 — a member that produced nothing never wins a round."""
+  vals = []
+  try:
+    with open(os.path.join(member_dir, 'summaries.jsonl')) as f:
+      for line in f:
+        try:
+          rec = json.loads(line)
+        except ValueError:
+          continue
+        if rec.get('tag') == tag and 'value' in rec:
+          vals.append((int(rec.get('step', 0)), float(rec['value'])))
+  except OSError:
+    return 0.0
+  if not vals:
+    return 0.0
+  vals.sort(key=lambda sv: sv[0])
+  return float(np.mean([v for _, v in vals[-tail:]]))
+
+
+def train_population(config: Config, max_steps: Optional[int] = None,
+                     max_seconds: Optional[float] = None,
+                     drain_event: Optional[threading.Event] = None
+                     ) -> TrainRun:
+  """Population-based training over Anakin learner replicas (round
+  22, PBT arXiv 1711.09846): ONE driver invocation trains
+  `pbt_population` members — each a full train_anakin run in
+  `<logdir>/member_<k>` with its own checkpoint ladder, summaries,
+  and SLO verdict — suites assigned round-robin from
+  `resolved_pbt_suites`, hypers (learning_rate, entropy_cost)
+  exploit/explored between rounds.
+
+  The schedule is round-synchronous and sequential on this host: each
+  round extends every member's frame budget by
+  `resolved_pbt_round_frames` (members RESUME from their own verified
+  checkpoints — the round boundary is just a host-side pause), then
+  the process-0-owned decision loop ranks WITHIN each suite
+  (cross-suite returns are not commensurable), and bottom-quantile
+  members inherit a donor's weights by copying its `checkpoints/`
+  directory through the PR 2 ladder — the loser's next restore
+  re-verifies the donor's content digests, so a torn copy is refused,
+  not trained on. Every exploit lands as a DURABLE `pbt_exploit`
+  incident (donor, returns, explored hypers) — the provenance chain
+  RUNBOOK.md's "which replica won and why" walks backwards.
+
+  Artifacts in the parent logdir: `population_summaries.jsonl` (one
+  row per member per round: suite, frames, mean return, live hypers —
+  the per-task return curves), `PBT_LOG.json` (the full decision
+  history + final winner), and `summaries.jsonl` population/* scalars
+  feeding the `per_task_return_floor` SLO objective via the
+  population/* gauges (registered after the first scoring pass; other
+  runs see no_data, never a violation).
+
+  `max_steps`/`max_seconds` bound each MEMBER run (the test seam);
+  `drain_event` stops cleanly at the next member/round boundary.
+  Returns the winning member's TrainRun.
+  """
+  for warning in validate_population(config):
+    log.warning('%s', warning)
+  if config.pbt_population < 2:
+    raise ValueError(f'train_population needs pbt_population >= 2, '
+                     f'got {config.pbt_population}')
+  suite_list = list(config.resolved_pbt_suites)
+  n = config.pbt_population
+  round_frames = config.resolved_pbt_round_frames
+  num_rounds = max(
+      1, -(-config.total_environment_frames // round_frames))
+  os.makedirs(config.logdir, exist_ok=True)
+  incidents = observability.EventLog(config.logdir)
+  writer = observability.SummaryWriter(config.logdir)
+  pop_path = os.path.join(config.logdir, 'population_summaries.jsonl')
+  rng = np.random.default_rng(config.seed)
+
+  # Member 0 carries the configured hypers unperturbed (the "control"
+  # arm); the rest start from an explored neighborhood so round 0
+  # already has diversity to select over.
+  members = []
+  for k in range(n):
+    hypers = {'learning_rate': config.learning_rate,
+              'entropy_cost': config.entropy_cost}
+    if k:
+      hypers = population_lib.pbt_explore(hypers, rng,
+                                          config.pbt_perturb)
+    members.append({'member': k, 'suite': suite_list[k % len(suite_list)],
+                    'hypers': hypers})
+
+  pop_stats: Dict[str, float] = {'exploits': 0.0}
+  pop_gauges: List = []
+
+  def _ensure_gauges():
+    # Registered lazily AFTER the first scoring pass: an objective
+    # over an absent gauge evaluates no_data (never violates), while
+    # a gauge registered before any member has a return would judge a
+    # placeholder. Member SLO engines from round 1 on DO see these
+    # (same process, same registry) — that is the point: the
+    # per-task floor is judged while the population still trains.
+    if pop_gauges:
+      return
+    pop_gauges.extend([
+        telemetry.gauge(
+            'population/task_return_min',
+            fn=lambda: pop_stats.get('task_return_min', 0.0)),
+        telemetry.gauge(
+            'population/best_return',
+            fn=lambda: pop_stats.get('best_return', 0.0)),
+        telemetry.gauge(
+            'population/exploits_total',
+            fn=lambda: pop_stats.get('exploits', 0.0)),
+    ])
+
+  pbt_log = {'population': n, 'suites': suite_list,
+             'round_frames': round_frames, 'num_rounds': num_rounds,
+             'quantile': config.pbt_quantile,
+             'perturb': config.pbt_perturb, 'rounds': [],
+             'winner': None}
+
+  def _write_pbt_log():
+    path = os.path.join(config.logdir, 'PBT_LOG.json')
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+      json.dump(pbt_log, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+  runs: Dict[int, TrainRun] = {}
+  returns = [0.0] * n
+  try:
+    for r in range(num_rounds):
+      if drain_event is not None and drain_event.is_set():
+        break
+      target = min((r + 1) * round_frames,
+                   config.total_environment_frames)
+      for m in members:
+        if drain_event is not None and drain_event.is_set():
+          break
+        k = m['member']
+        member_dir = os.path.join(config.logdir, f'member_{k:02d}')
+        member_config = dataclasses.replace(
+            config,
+            logdir=member_dir,
+            # Distinct, round-stable env/init seed per member; params
+            # beyond round 0 come from the member's own checkpoint.
+            seed=config.seed + 101 * k + 1,
+            env_backend=m['suite'],
+            total_environment_frames=target,
+            learning_rate=m['hypers']['learning_rate'],
+            entropy_cost=m['hypers']['entropy_cost'],
+            # Members are plain anakin runs: no recursive population,
+            # no fleet-runtime task mixing.
+            pbt_population=0,
+            fleet_tasks='')
+        runs[k] = train_anakin(member_config, max_steps=max_steps,
+                               max_seconds=max_seconds,
+                               drain_event=drain_event)
+        returns[k] = _member_return(member_dir)
+        row = {'wall_time': round(time.time(), 3), 'round': r,
+               'member': k, 'suite': m['suite'], 'frames': target,
+               'mean_return': returns[k]}
+        row.update({f'hyper_{h}': float(v)
+                    for h, v in sorted(m['hypers'].items())})
+        with open(pop_path, 'a') as f:
+          f.write(json.dumps(row, sort_keys=True) + '\n')
+
+      group_labels = [m['suite'] for m in members]
+      per_suite_best = {
+          s: max(returns[i] for i in range(n)
+                 if group_labels[i] == s)
+          for s in suite_list}
+      pop_stats['task_return_min'] = min(per_suite_best.values())
+      pop_stats['best_return'] = max(returns)
+      _ensure_gauges()
+      writer.scalar('population/task_return_min',
+                    pop_stats['task_return_min'], target)
+      writer.scalar('population/best_return',
+                    pop_stats['best_return'], target)
+
+      round_rec = {'round': r, 'target_frames': target,
+                   'returns': list(returns),
+                   'suites': list(group_labels),
+                   'hypers': [dict(m['hypers']) for m in members],
+                   'decisions': []}
+      final_round = (r == num_rounds - 1 or
+                     (drain_event is not None and
+                      drain_event.is_set()))
+      if not final_round:
+        # Exploit/explore only when another round will train on the
+        # result — mutating weights after the last round would ship
+        # an inherited-but-untrained population.
+        decisions = population_lib.pbt_decide(
+            returns, group_labels, rng,
+            quantile=config.pbt_quantile,
+            perturb=config.pbt_perturb,
+            hypers=[m['hypers'] for m in members])
+        for k, decision in enumerate(decisions):
+          if decision is None:
+            continue
+          donor = decision['donor']
+          src = os.path.join(config.logdir, f'member_{donor:02d}',
+                             'checkpoints')
+          dst = os.path.join(config.logdir, f'member_{k:02d}',
+                             'checkpoints')
+          if os.path.isdir(src):
+            # Weight inheritance THROUGH the checkpoint ladder: the
+            # loser's next restore_latest re-verifies the donor's
+            # content digests — a torn copy is refused, not loaded.
+            if os.path.isdir(dst):
+              shutil.rmtree(dst)
+            shutil.copytree(src, dst)
+          members[k]['hypers'] = dict(decision['hypers'])
+          pop_stats['exploits'] += 1.0
+          incidents.event(
+              'pbt_exploit', step=target, round=r, member=k,
+              donor=donor, suite=members[k]['suite'],
+              member_return=returns[k], donor_return=returns[donor],
+              hypers=decision['hypers'])
+          log.info('pbt round %d: member %d (return %.3f) exploits '
+                   'member %d (return %.3f), new hypers %s', r, k,
+                   returns[k], donor, returns[donor],
+                   decision['hypers'])
+          round_rec['decisions'].append(dict(decision, member=k))
+      writer.scalar('population/exploits_total', pop_stats['exploits'],
+                    target)
+      pbt_log['rounds'].append(round_rec)
+      _write_pbt_log()
+
+    if runs:
+      winner = max(runs, key=lambda k: returns[k])
+      pbt_log['winner'] = {
+          'member': winner, 'suite': members[winner]['suite'],
+          'return': returns[winner],
+          'hypers': dict(members[winner]['hypers']),
+          'logdir': os.path.join(config.logdir,
+                                 f'member_{winner:02d}')}
+      _write_pbt_log()
+      incidents.event('pbt_winner', member=winner,
+                      suite=members[winner]['suite'],
+                      final_return=returns[winner],
+                      hypers=members[winner]['hypers'])
+      log.info('pbt winner: member %d (%s) return %.3f hypers %s',
+               winner, members[winner]['suite'], returns[winner],
+               members[winner]['hypers'])
+      return runs[winner]
+    raise RuntimeError('population run trained no member (drained '
+                       'before the first member run?)')
+  finally:
+    for gauge in pop_gauges:
+      telemetry.registry().unregister(gauge.name, gauge)
+    writer.close()
+    incidents.close()
 
 
 def evaluate(config: Config,
@@ -2602,7 +2970,8 @@ def evaluate(config: Config,
                          validate_slo(config),
                          validate_controller(config),
                          validate_runtime(config),
-                         validate_serving(config)):
+                         validate_serving(config),
+                         validate_population(config)):
     for warning in group_warnings:
       log.warning('%s', warning)
   distributed.maybe_initialize(config)

@@ -54,9 +54,15 @@ def test_level_names(config: Config) -> List[str]:
 
 
 def make_env_spec(config: Config, level_name: str, seed: int,
-                  is_test: bool = False) -> EnvSpec:
-  """One environment spec for (backend, level, seed)."""
-  backend = config.env_backend
+                  is_test: bool = False,
+                  backend: Optional[str] = None) -> EnvSpec:
+  """One environment spec for (backend, level, seed).
+
+  `backend` overrides config.env_backend — the heterogeneous-fleet
+  seam (round 22): a mixed fleet builds each actor's spec for ITS
+  task's backend while every other knob (sizes, seeds, repeats) still
+  comes from the one config."""
+  backend = backend or config.env_backend
   if backend in ('fake', 'bandit', 'cue_memory'):
     from scalable_agent_tpu.envs import fake
     env_class = {'bandit': fake.ContextualBanditEnv,
@@ -84,6 +90,12 @@ def make_env_spec(config: Config, level_name: str, seed: int,
                   episode_length=config.episode_length,
                   seed=seed, level_name=level_name,
                   num_action_repeats=config.num_action_repeats)
+    if backend == 'procgen':
+      # The finite level-id space the curriculum drives (round 22) —
+      # host wrapper and Anakin core must agree on its size or the
+      # dual-registration parity story breaks.
+      kwargs.update(num_levels=config.procgen_num_levels,
+                    wall_density=config.procgen_wall_density)
     frame_shape = (config.height, config.width, 3)
   elif backend == 'dmlab':
     from scalable_agent_tpu.envs import dmlab

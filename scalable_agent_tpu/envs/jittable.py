@@ -161,7 +161,8 @@ class GridworldCore:
     info = StepOutputInfo(ep_return, ep_frames)  # emitted: incl. done
 
     rng, sub = jax.random.split(state.rng)
-    fresh_goal, fresh_extra = self._fresh_episode(sub, action.shape[0])
+    fresh_goal, fresh_extra = self._fresh_episode(sub, action.shape[0],
+                                                  state)
     new_state = self._replace_episode(
         state, rng=rng,
         agent_yx=jnp.where(done[:, None], jnp.zeros_like(agent), agent),
@@ -176,8 +177,11 @@ class GridworldCore:
                         observation=self._observation(new_state))
     return new_state, output
 
-  def _fresh_episode(self, rng, batch):
-    """New-episode draws: (goal, extra) — extra is subclass state."""
+  def _fresh_episode(self, rng, batch, state):
+    """New-episode draws: (goal, extra) — extra is subclass state.
+    `state` is the pre-step batched state (ProcgenCore's curriculum
+    sampler reads its per-level scores from it)."""
+    del state
     return self._sample_goal(rng, batch), None
 
   def _replace_episode(self, state, rng, agent_yx, goal_yx,
@@ -189,14 +193,20 @@ class GridworldCore:
 
 
 class ProcgenState(NamedTuple):
-  """GridworldState + the per-env level id the layout derives from."""
+  """GridworldState + the per-env level id the layout derives from,
+  plus the per-LEVEL curriculum accumulators (round 22). The two
+  [num_levels] leaves are NOT batch-leading: like `rng`, they are
+  replicated BY NAME under a mesh (anakin.init_env_carry — shape
+  sniffing would mis-shard them whenever num_levels == batch)."""
   rng: Any
   agent_yx: Any
   goal_yx: Any
   step_in_episode: Any
   episode_return: Any
   episode_frames: Any
-  level_id: Any  # i32 [B] — index into the finite level set
+  level_id: Any      # i32 [B] — index into the finite level set
+  level_scores: Any  # f32 [num_levels] — curriculum priority EMAs
+  level_visits: Any  # f32 [num_levels] — cumulative transition counts
 
 
 class ProcgenCore(GridworldCore):
@@ -211,16 +221,26 @@ class ProcgenCore(GridworldCore):
 
   def __init__(self, height=24, width=32, episode_length=16,
                num_action_repeats=1, num_actions=4, grid_size=5,
-               num_levels=8, wall_density=0.25, layout_seed=1234):
+               num_levels=8, wall_density=0.25, layout_seed=1234,
+               curriculum='uniform', curriculum_temperature=1.0,
+               curriculum_eps=0.1):
     super().__init__(height=height, width=width,
                      episode_length=episode_length,
                      num_action_repeats=num_action_repeats,
                      num_actions=num_actions, grid_size=grid_size)
     if num_levels < 1:
       raise ValueError(f'num_levels must be >= 1, got {num_levels}')
+    from scalable_agent_tpu import population
+    if curriculum not in population.CURRICULUM_MODES:
+      raise ValueError(
+          f'unknown curriculum {curriculum!r} '
+          f'(modes: {", ".join(population.CURRICULUM_MODES)})')
     self.num_levels = num_levels
     self.wall_density = wall_density
     self.layout_seed = layout_seed
+    self.curriculum = curriculum
+    self.curriculum_temperature = curriculum_temperature
+    self.curriculum_eps = curriculum_eps
 
   def _walls(self, level_id):
     """[B, G, G] bool wall mask, a pure function of the level id."""
@@ -261,7 +281,9 @@ class ProcgenCore(GridworldCore):
         episode_return=jnp.zeros((batch,), jnp.float32),
         episode_frames=jnp.zeros((batch,), jnp.int32),
         level_id=jax.random.randint(sub, (batch,), 0,
-                                    self.num_levels))
+                                    self.num_levels),
+        level_scores=jnp.zeros((self.num_levels,), jnp.float32),
+        level_visits=jnp.zeros((self.num_levels,), jnp.float32))
     output = StepOutput(
         reward=jnp.zeros((batch,), jnp.float32),
         info=StepOutputInfo(jnp.zeros((batch,), jnp.float32),
@@ -275,9 +297,20 @@ class ProcgenCore(GridworldCore):
     return walls[jnp.arange(proposed.shape[0]), proposed[:, 0],
                  proposed[:, 1]]
 
-  def _fresh_episode(self, rng, batch):
-    return (self._goal_corner(batch),
-            jax.random.randint(rng, (batch,), 0, self.num_levels))
+  def _fresh_episode(self, rng, batch, state):
+    """New-episode level draw: uniform, or the round-22 prioritized
+    curriculum sampler — one in-graph categorical over the per-level
+    score EMAs carried in `state` (population.sample_levels), so a
+    driven level distribution costs zero host round trips."""
+    if self.curriculum == 'uniform':
+      fresh = jax.random.randint(rng, (batch,), 0, self.num_levels)
+    else:
+      from scalable_agent_tpu import population
+      fresh = population.sample_levels(
+          rng, state.level_scores, batch,
+          self.curriculum_temperature,
+          self.curriculum_eps).astype(jnp.int32)
+    return self._goal_corner(batch), fresh
 
   def _replace_episode(self, state, rng, agent_yx, goal_yx,
                        step_in_episode, episode_return, episode_frames,
@@ -285,7 +318,9 @@ class ProcgenCore(GridworldCore):
     return ProcgenState(
         rng, agent_yx, goal_yx, step_in_episode, episode_return,
         episode_frames,
-        level_id=jnp.where(done, fresh_extra, state.level_id))
+        level_id=jnp.where(done, fresh_extra, state.level_id),
+        level_scores=state.level_scores,
+        level_visits=state.level_visits)
 
 
 # The jittable registry anakin.ENV_CORES extends — one name, two
@@ -314,13 +349,17 @@ class _JittableHostEnv(base.Environment):
   _CORE_NAME = None  # subclasses pin this (py_process pickles classes)
 
   def __init__(self, height, width, num_actions, episode_length,
-               seed=0, level_name='', num_action_repeats=1):
+               seed=0, level_name='', num_action_repeats=1,
+               num_levels=None, wall_density=None):
     del level_name  # identity rides the factory's level id stamping
     core_cls = JITTABLE_CORES[self._CORE_NAME]
+    extra = {} if num_levels is None else {'num_levels': num_levels}
+    if wall_density is not None:
+      extra['wall_density'] = wall_density
     self._core = core_cls(height=height, width=width,
                           episode_length=episode_length,
                           num_action_repeats=num_action_repeats,
-                          num_actions=num_actions)
+                          num_actions=num_actions, **extra)
     with jax.default_device(_host_cpu_device()):
       self._state, out = self._core.init(jax.random.PRNGKey(seed), 1)
     self._obs = self._host_obs(out)

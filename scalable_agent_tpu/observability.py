@@ -103,12 +103,18 @@ class EventLog(_JsonlAppender):
   # 'reshard' (round 20): a topology_resharded record marks a restore
   # whose layout was respecified for a NEW mesh — the provenance line
   # every later numerical question starts from.
+  # 'pbt' (round 22): a pbt_exploit record is the provenance of a
+  # member's weights (which donor it copied, at which round, with
+  # which explored hypers) — without it a population run's winner is
+  # unexplainable after the fact (RUNBOOK "which replica won and
+  # why").
   # The canonical marker list is contract-linted
   # (scripts/lint.py durable-markers) against the docs/OBSERVABILITY
   # .md "Durable incident markers" section AND against the kinds the
   # modules actually emit, both directions.
   _DURABLE_MARKERS = ('halt', 'rollback', 'sdc', 'quarantin', 'slo',
-                      'controller', 'lock_order', 'host_', 'reshard')
+                      'controller', 'lock_order', 'host_', 'reshard',
+                      'pbt')
 
   def __init__(self, logdir: str, filename: str = 'incidents.jsonl'):
     super().__init__(logdir, filename)

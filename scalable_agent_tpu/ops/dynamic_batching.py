@@ -382,3 +382,104 @@ def batch_fn_with_options(minimum_batch_size: int = 1,
 def batch_fn(f):
   """Decorator with default options (reference: dynamic_batching.batch_fn)."""
   return _BatchedFunction(f, 1, 1024, 100)
+
+
+def family_key(arrays: Sequence[np.ndarray]):
+  """The obs-spec FAMILY of a request: dtype + trailing shape per
+  tensor (the leading batch dim is what merging is free to vary).
+  Hashable — the FamilyBatcher's routing key."""
+  return tuple((np.asarray(a).dtype.str, np.asarray(a).shape[1:])
+               for a in arrays)
+
+
+class FamilyBatcher:
+  """Obs-spec FAMILY bucketing over the C++ batcher (round 22): one
+  logical batched function whose concurrent callers may carry
+  DIFFERENT tensor specs — e.g. a heterogeneous fleet mixing 16x16
+  cue_memory frames with 24x32 gridworld frames.
+
+  The single-queue Batcher fixes one tensor family at the first call
+  (a later 16x16 caller would either error or, in a pad-to-max
+  design, ship every frame at the fleet-wide max shape). Here each
+  family gets its OWN Batcher + computation thread, lazily on first
+  sight, so merges never cross families and a frame never pads beyond
+  its family's exact shape — the generalization of bucketed padding
+  from batch-dim buckets to obs-spec buckets. The cost is one
+  computation thread per family and merge opportunities that don't
+  cross families (mixed fleets want per-family minimum_batch_size
+  floors sized to the family's actor share, not the fleet).
+
+  `make_fn(key)` builds the per-family handler (called once per new
+  family; the key is `family_key` of the first request) — typically a
+  jitted policy step specialized to that family's shapes.
+
+  `padding_stats()` carries the measured perf claim: useful bytes
+  served per family vs the counterfactual naive max-shape cost over
+  the SAME request stream (every row padded to the widest family seen)
+  — the bench.py population stage's mixed-suite row."""
+
+  _families: guarded_by('_lock')
+  _rows: guarded_by('_lock')
+
+  def __init__(self, make_fn, minimum_batch_size: int = 1,
+               maximum_batch_size: int = 1024, timeout_ms: int = 100):
+    self._make_fn = make_fn
+    self._opts = (minimum_batch_size, maximum_batch_size, timeout_ms)
+    self._lock = make_lock('dynamic_batching.FamilyBatcher._lock')
+    self._families = {}  # family key -> _BatchedFunction
+    self._rows = {}      # family key -> rows served
+    self._closed = False
+
+  def _family(self, key):
+    with self._lock:
+      if self._closed:
+        raise BatcherCancelled('family batcher is closed')
+      fn = self._families.get(key)
+      if fn is None:
+        mn, mx, to = self._opts
+        fn = _BatchedFunction(self._make_fn(key), mn, mx, to)
+        fn.__name__ = f'family{len(self._families)}'
+        self._families[key] = fn
+        self._rows[key] = 0
+      return fn
+
+  def __call__(self, *arrays):
+    arrays = [np.asarray(a) for a in arrays]
+    key = family_key(arrays)
+    fn = self._family(key)
+    out = fn(*arrays)
+    with self._lock:
+      self._rows[key] += arrays[0].shape[0]
+    return out
+
+  @staticmethod
+  def _row_bytes(key) -> int:
+    total = 0
+    for dtype_str, trail in key:
+      total += int(np.prod(trail, dtype=np.int64)) * \
+          np.dtype(dtype_str).itemsize
+    return total
+
+  def padding_stats(self):
+    """Measured padded-bytes accounting over everything served so far:
+    {families, rows, useful_bytes, max_shape_bytes, waste_ratio, ...}
+    (population.padding_report's keys — bucketed == useful because
+    family merges pad zero extra bytes; max_shape_bytes is what the
+    same stream costs under naive pad-to-fleet-max)."""
+    from scalable_agent_tpu import population
+    with self._lock:
+      counts = {(self._row_bytes(key),): rows
+                for key, rows in self._rows.items() if rows}
+      families = len(self._families)
+      total_rows = float(sum(self._rows.values()))
+    report = population.padding_report(counts)
+    report['families'] = families
+    report['rows'] = total_rows
+    return report
+
+  def close(self):
+    with self._lock:
+      self._closed = True
+      families = list(self._families.values())
+    for fn in families:
+      fn.close()

@@ -57,6 +57,7 @@ import jax
 import jax.numpy as jnp
 
 from scalable_agent_tpu import learner
+from scalable_agent_tpu import population
 from scalable_agent_tpu.config import Config
 from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
 from scalable_agent_tpu.structs import (ActorOutput, AgentOutput,
@@ -255,6 +256,17 @@ def make_env_core(config: Config, num_actions: Optional[int] = None):
   kwargs = dict(height=config.height, width=config.width,
                 episode_length=config.episode_length,
                 num_action_repeats=config.num_action_repeats)
+  if config.env_backend == 'procgen':
+    # The level-set + curriculum knobs (round 22) are procgen-only:
+    # the finite level-id space is what the prioritized sampler
+    # drives. The hybrid filler reaches here through its own config
+    # copy, so a procgen filler runs the same curriculum.
+    kwargs.update(
+        num_levels=config.procgen_num_levels,
+        wall_density=config.procgen_wall_density,
+        curriculum=config.curriculum,
+        curriculum_temperature=config.curriculum_temperature,
+        curriculum_eps=config.curriculum_eps)
   width = num_actions if num_actions is not None else config.num_actions
   if width is not None:
     kwargs['num_actions'] = width
@@ -325,11 +337,17 @@ def init_env_carry(agent, env_core, config: Config, rng,
   # state protocol — every jittable core's state is a NamedTuple with
   # an `rng` field; gridworld/procgen ride the same rule). Captured
   # BEFORE the shape-sniffing placement, which would mis-shard the
-  # [2]u32 key whenever b == 2.
-  core_rng = env_state.rng
+  # [2]u32 key whenever b == 2. The procgen curriculum accumulators
+  # ([num_levels] leaves, round 22) are replicated by name for the
+  # same reason: num_levels == b would shape-sniff them onto the data
+  # axis, splitting the one global score table the sampler reads.
+  by_name = {'rng': env_state.rng}
+  for field in ('level_scores', 'level_visits'):
+    if hasattr(env_state, field):
+      by_name[field] = getattr(env_state, field)
   env_state = jax.tree_util.tree_map(place, env_state)
   env_state = env_state._replace(
-      rng=jax.device_put(core_rng, replicated))
+      **{k: jax.device_put(v, replicated) for k, v in by_name.items()})
   env_output, agent_output, core_state = jax.tree_util.tree_map(
       place, (env_output, agent_output, core_state))
   return EnvCarry(env_state, env_output, agent_output, core_state,
@@ -364,7 +382,8 @@ def init_carry(agent, env_core, config: Config, rng,
 def make_anakin_step(agent, env_core, config: Config,
                      return_batch: bool = False,
                      train_step_fn=None,
-                     advance_steps: bool = True):
+                     advance_steps: bool = True,
+                     mesh=None):
   """One fused device step: scan T acting steps, then the SGD update.
 
   Returns jitted `f(carry) -> (carry, metrics)` (donating the carry);
@@ -382,10 +401,25 @@ def make_anakin_step(agent, env_core, config: Config,
   the LR clock, or the checkpoint step numbering — every clock the
   run exposes stays on the fleet's fresh-frame count; IMPACT's
   staleness tolerance, arXiv 1912.00167, is why an off-cadence update
-  against the frozen clock is a legal move)."""
+  against the frozen clock is a legal move).
+
+  `mesh` (round 22): only consulted by the curriculum block — the
+  updated [num_levels] score table is constrained back to REPLICATED
+  so the carry's placement is a fixed point (without the constraint
+  the partitioner shards the segment-sum output over data, and the
+  sharding flip forces a second compile at step 2)."""
   if train_step_fn is None:
     train_step_fn = learner.make_train_step_fn(agent, config)
   t = config.unroll_length
+  # Python-level gate (round 22): the curriculum block only traces for
+  # cores with a finite level-id space (procgen). The sampler itself
+  # lives in the core's _fresh_episode; THIS side accumulates the
+  # per-level priority EMAs from the unroll's own TD errors — acting
+  # baselines are already in the batch (AgentOutput.baseline), so the
+  # whole loop (score → sample → act → score) is one XLA program with
+  # zero host round trips per level decision.
+  use_curriculum = (config.curriculum != 'uniform'
+                    and hasattr(env_core, 'num_levels'))
 
   def anakin_step(carry: AnakinCarry):
     initial_core_state = carry.core_state
@@ -403,9 +437,14 @@ def make_anakin_step(agent, env_core, config: Config,
       new_agent_output = jax.tree_util.tree_map(lambda x: x[0], out_t)
       new_env_state, new_env_output = env_core.step(
           env_state, new_agent_output.action)
+      # Pre-step level ids: the level each transition was PLAYED in
+      # (step resamples at done, so the post-step id may already be
+      # next episode's).
+      ys = (new_env_output, new_agent_output)
+      if use_curriculum:
+        ys = ys + (env_state.level_id,)
       return ((new_env_state, new_env_output, new_agent_output,
-               new_core, rng),
-              (new_env_output, new_agent_output))
+               new_core, rng), ys)
 
     (env_state, env_output, agent_output, core_state, rng), tail = (
         jax.lax.scan(
@@ -428,6 +467,39 @@ def make_anakin_step(agent, env_core, config: Config,
       new_train_state = new_train_state._replace(
           update_steps=carry.train_state.update_steps)
     metrics['mean_reward'] = jnp.mean(batch.env_outputs.reward[1:])
+    if use_curriculum:
+      # In-graph per-level score update from this unroll's own TD
+      # errors. Alignment (learner.py): baseline[i] = V(o_{i-1}),
+      # reward[i]/done[i] describe the o_{i-1} -> o_i transition, so
+      # delta_i = r[i] + gamma*(1-d[i])*V(o_i) - V(o_{i-1}) needs
+      # baseline[i+1] — the T-1 transitions i in [1, T). tail[2][j]
+      # is the PRE-step level of the transition that produced
+      # env_output j+1, so transition i maps to tail[2][i-1].
+      # unroll_length=1 yields an empty update (pure decay) —
+      # validate_population warns at spin-up.
+      v = batch.agent_outputs.baseline                  # [T+1, B]
+      r = batch.env_outputs.reward
+      d = batch.env_outputs.done.astype(jnp.float32)
+      delta = (r[1:t] + config.discounting * (1.0 - d[1:t]) * v[2:]
+               - v[1:t])                                # [T-1, B]
+      signal = population.score_signal(delta, config.curriculum)
+      scores, visits = population.update_scores(
+          env_state.level_scores, env_state.level_visits,
+          tail[2][:t - 1], signal, config.curriculum_alpha,
+          config.curriculum_decay)
+      if mesh is not None:
+        # Pin the table back to replicated (see the docstring): the
+        # carry's placement must be a fixed point of the step.
+        from scalable_agent_tpu.parallel import sharding as \
+            sharding_lib
+        rep = sharding_lib.replicated(mesh)
+        scores = jax.lax.with_sharding_constraint(scores, rep)
+        visits = jax.lax.with_sharding_constraint(visits, rep)
+      env_state = env_state._replace(
+          level_scores=scores, level_visits=visits)
+      metrics.update(population.curriculum_metrics(
+          scores, visits, config.curriculum_temperature,
+          config.curriculum_eps))
     if return_batch:
       metrics['batch'] = batch
     return (AnakinCarry(new_train_state, env_state, env_output,
@@ -450,7 +522,7 @@ def build_run(config: Config, mesh=None,
   # would make params/checkpoints incompatible between the runtimes.
   env_core = make_env_core(config)
   agent = driver.build_agent(config, env_core.num_actions)
-  step = make_anakin_step(agent, env_core, config)
+  step = make_anakin_step(agent, env_core, config, mesh=mesh)
   seed = config.seed if rng_seed is None else rng_seed
   carry = init_carry(agent, env_core, config, jax.random.PRNGKey(seed),
                      mesh=mesh)
@@ -556,11 +628,15 @@ def train(config: Config, max_steps: Optional[int] = None, mesh=None):
   return carry
 
 
-def run(config: Config, num_steps: int, rng_seed: int = 0,
+def run(config: Config, num_steps: int, rng_seed: Optional[int] = None,
         env_backend: Optional[str] = None, mesh=None):
   """Convenience runner: build agent + env core, run `num_steps` fused
   steps, return (carry, list-of-metrics, env_frames_per_sec). Pass
-  `mesh` to shard the env batch over the data axis (multi-chip)."""
+  `mesh` to shard the env batch over the data axis (multi-chip).
+
+  rng_seed=None (the default) honors config.seed, matching
+  build_run()/driver.train_anakin — it used to pin seed 0, which made
+  two configs differing only in `seed` produce identical runs."""
   import dataclasses
   import time
   if num_steps < 1:
@@ -682,7 +758,7 @@ class HybridFiller:
     train_fn = learner.make_train_step_fn(agent, config)
     self._step = make_anakin_step(agent, core, self._config,
                                   train_step_fn=train_fn,
-                                  advance_steps=False)
+                                  advance_steps=False, mesh=mesh)
     self._env = init_env_carry(
         agent, core, self._config,
         jax.random.PRNGKey(config.seed + 7777), mesh=mesh)

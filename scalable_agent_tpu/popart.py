@@ -19,6 +19,15 @@ al. 2016) as used by multi-task PopArt-IMPALA (Hessel et al. 2018):
 Everything is a pure function over `PopArtState` — it lives in the
 TrainState pytree, is checkpointed with it, and runs inside the one
 jitted learner step.
+
+Mixed heterogeneous fleets (round 22): with `--fleet_tasks` the task
+axis is the parsed suite order from
+`population.parse_fleet_tasks(config.fleet_tasks)` — the fleet
+builder stamps each actor slot's `level_name_id` with its suite
+index, so PopArt column i is suite i's running target scale. Nothing
+here changes: per-task normalization was already the contract; the
+fleet wiring just widened what "task" can mean from level-within-one-
+suite to suite-within-one-fleet.
 """
 
 from typing import Any, NamedTuple
@@ -95,6 +104,23 @@ def update_stats(state: PopArtState, targets, task_ids,
   new_nu = jnp.where(present, (1 - beta) * state.nu + beta * batch_nu,
                      state.nu)
   return state._replace(mu=new_mu, nu=new_nu)
+
+
+def stats_summary(state: PopArtState, task_names=None):
+  """Per-task normalization stats as plain Python (artifacts/logs).
+
+  Returns {'mu': [...], 'sigma': [...]} (floats, task order), plus
+  'tasks' when `task_names` is given. Round 22: in a `--fleet_tasks`
+  run, task order is the parse_fleet_tasks suite order, so this is a
+  free per-suite target-scale readout — a suite whose σ never moved
+  off 1.0 never contributed a batch.
+  """
+  mu = [float(x) for x in jax.device_get(state.mu)]
+  sig = [float(x) for x in jax.device_get(sigma(state))]
+  out = {'mu': mu, 'sigma': sig}
+  if task_names is not None:
+    out['tasks'] = list(task_names)
+  return out
 
 
 def preserve_outputs(kernel, bias, old: PopArtState, new: PopArtState):

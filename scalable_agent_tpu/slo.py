@@ -275,6 +275,17 @@ DEFAULT_OBJECTIVES = (
               severity='ticket',
               description='inference serve latency p99 (ms), local '
                           'and routed'),
+    # Population plane (round 22, driver.train_population): the WORST
+    # suite's best member return — a population whose laggard suite
+    # never crosses zero is spending its frame budget on one task.
+    # The gauge only exists inside a PBT run (registered after the
+    # first scoring round); every other run evaluates no_data, which
+    # never violates. Advisory: return scales are task-relative, so a
+    # default floor can only be the "learning at all" zero line.
+    Objective(name='per_task_return_floor',
+              metric='population/task_return_min',
+              comparison='>=', target=0.0, severity='info',
+              description='worst suite best-member return >= 0'),
 )
 
 

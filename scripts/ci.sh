@@ -316,4 +316,46 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
 BENCH_SMOKE=1 BENCH_ONLY=serving python bench.py
 CHAOS_SMOKE=1 CHAOS_STORM=routed python scripts/chaos.py
 
+echo '== population lane (round 22: the population engine — in-graph'
+echo '   curriculum sampler + mixed-fleet bucket-composition + PBT'
+echo '   exploit/explore units, the slow learning-curve gate and the'
+echo '   one-invocation two-suite population drills (no -m filter:'
+echo '   the slow-marked curves run HERE), then a tiny real'
+echo '   --runtime=anakin --curriculum=regret driver run asserting'
+echo '   verdict PASS + per-level telemetry in summaries +'
+echo '   CURRICULUM_LEVELS.json, and the BENCH_ONLY=population smoke'
+echo '   (curriculum fps gate + padding-waste row) — <600 s CPU) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_population.py -q \
+  -p no:cacheprovider
+JAX_PLATFORMS=cpu python - <<'POP_EOF'
+import json, logging, os, sys, tempfile
+logging.basicConfig(level=logging.WARNING)
+sys.path.insert(0, os.getcwd())
+from scalable_agent_tpu import driver, slo
+from scalable_agent_tpu.config import Config
+logdir = tempfile.mkdtemp(prefix='ci_pop_')
+cfg = Config(logdir=logdir, runtime='anakin', env_backend='procgen',
+             curriculum='regret', procgen_num_levels=4,
+             batch_size=4, unroll_length=5, num_action_repeats=1,
+             height=24, width=32, torso='shallow', use_py_process=False,
+             use_instruction=False, summary_secs=0, checkpoint_secs=0,
+             total_environment_frames=6 * 4 * 5, seed=7)
+run = driver.train(cfg)
+assert run.frames == 120, run.frames
+verdict = slo.read_verdict(logdir)
+assert verdict is not None and verdict['pass'], verdict
+tags = set()
+with open(os.path.join(logdir, 'summaries.jsonl')) as f:
+    for line in f:
+        tags.add(json.loads(line)['tag'])
+for tag in ('curriculum_entropy', 'curriculum_levels_visited'):
+    assert tag in tags, (tag, sorted(tags))
+levels = json.load(open(os.path.join(logdir, 'CURRICULUM_LEVELS.json')))
+assert levels['curriculum'] == 'regret'
+assert len(levels['visits']) == 4 and sum(levels['visits']) > 0, levels
+print('population lane OK: regret curriculum in-graph, verdict PASS, '
+      'per-level telemetry landed')
+POP_EOF
+BENCH_SMOKE=1 BENCH_ONLY=population python bench.py
+
 echo 'CI OK'
