@@ -2891,6 +2891,67 @@ def bench_population(smoke):
       'max_shape_bytes': stats['max_shape_bytes'],
       'waste_ratio': round(stats['waste_ratio'], 4),
   }
+
+  # --- Round 23: fused (vmapped) population vs serial round-robin.
+  # N single-device members at IDENTICAL per-member shapes. The
+  # serial side pays what the r22 population loop pays every round:
+  # a fresh make_anakin_step trace + spin-up per member, members
+  # stepped one after another. The fused side builds ONE vmapped
+  # program and advances all members in lockstep. Wall INCLUDES
+  # trace/compile on both sides — amortizing N traces into one IS
+  # the claim (docs/PERF.md r23; gate: >= 2x aggregate fps). ---
+  import dataclasses as _dc
+  import jax.numpy as jnp
+  from scalable_agent_tpu import driver as driver_lib
+
+  n_members = 4
+  psteps = 40 if not smoke else 3
+  pcfg = Config(env_backend='bandit',
+                batch_size=16 if not smoke else 4,
+                unroll_length=10 if not smoke else 5,
+                num_action_repeats=1, episode_length=5,
+                torso='shallow', use_instruction=False,
+                use_py_process=False, learning_rate=2e-3,
+                entropy_cost=3e-3, discounting=0.9,
+                total_environment_frames=10**9, seed=0)
+  member_frames = psteps * pcfg.frames_per_step
+
+  start = time.perf_counter()
+  for k in range(n_members):
+    anakin.run(_dc.replace(pcfg, seed=pcfg.seed + 101 * k + 1),
+               psteps)
+  serial_wall = time.perf_counter() - start
+  serial_fps = n_members * member_frames / max(serial_wall, 1e-9)
+
+  start = time.perf_counter()
+  env_core = anakin.make_env_core(pcfg)
+  agent = driver_lib.build_agent(pcfg, env_core.num_actions)
+  vstep = anakin.make_vectorized_anakin_step(agent, env_core, pcfg)
+  stacked = anakin.init_stacked_carry(
+      agent, env_core, pcfg,
+      [pcfg.seed + 101 * k + 1 for k in range(n_members)])
+  hyp = {'learning_rate': jnp.full((n_members,), pcfg.learning_rate,
+                                   jnp.float32),
+         'entropy_cost': jnp.full((n_members,), pcfg.entropy_cost,
+                                  jnp.float32)}
+  metrics = None
+  for _ in range(psteps):
+    stacked, metrics = vstep(stacked, hyp)
+  jax.block_until_ready(metrics['total_loss'])
+  fused_wall = time.perf_counter() - start
+  fused_fps = n_members * member_frames / max(fused_wall, 1e-9)
+  speedup = fused_fps / max(serial_fps, 1e-9)
+  out['fused_population'] = {
+      'members': n_members, 'steps_per_member': psteps,
+      'member_config': 'bandit, shallow, T=%d, B=%d'
+                       % (pcfg.unroll_length, pcfg.batch_size),
+      'serial_wall_secs': round(serial_wall, 3),
+      'serial_env_frames_per_sec': round(serial_fps, 1),
+      'fused_wall_secs': round(fused_wall, 3),
+      'fused_env_frames_per_sec': round(fused_fps, 1),
+      'speedup': round(speedup, 2),
+      'gate': {'threshold': 2.0, 'pass': bool(speedup >= 2.0)},
+  }
   return out
 
 
@@ -3384,7 +3445,11 @@ def _headline(out):
         'regret_fps': (pop.get('regret') or {}).get(
             'env_frames_per_sec'),
         'padding_waste_ratio': (pop.get('padding') or {}).get(
-            'waste_ratio')}
+            'waste_ratio'),
+        'fused_speedup': (pop.get('fused_population')
+                          or {}).get('speedup'),
+        'fused_gate_pass': ((pop.get('fused_population')
+                             or {}).get('gate') or {}).get('pass')}
   return head
 
 

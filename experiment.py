@@ -652,6 +652,21 @@ flags.DEFINE_float('pbt_quantile', _DEFAULTS.pbt_quantile,
 flags.DEFINE_float('pbt_perturb', _DEFAULTS.pbt_perturb,
                    'Explore step: each inherited hyper multiplies or '
                    'divides by this factor (fair coin).')
+flags.DEFINE_bool('pbt_vectorized', _DEFAULTS.pbt_vectorized,
+                  'Fuse the population: vmap the N members over a '
+                  'leading member axis so each round trains ONE '
+                  'compiled Anakin program (hypers become traced '
+                  'per-member scalars; exploit is an on-device '
+                  'stacked-slice copy). Single jittable suite only; '
+                  'a model-axis mesh falls back to the serial loop.')
+flags.DEFINE_string('compile_cache_dir', _DEFAULTS.compile_cache_dir,
+                    'Persistent XLA compilation cache, armed before '
+                    "backend spin-up. 'auto' = <logdir>/.jax_cache "
+                    'on accelerator hosts (skipped on CPU-pinned '
+                    'processes, where executable reload is '
+                    "unreliable); '' disables; else the cache dir "
+                    'itself (shareable across runs and processes, '
+                    'armed on any backend).')
 
 FLAGS = flags.FLAGS
 

@@ -671,6 +671,25 @@ class Config:
                                           # backends; '' = env_backend
   pbt_quantile: float = 0.25              # exploit bottom/top fraction
   pbt_perturb: float = 1.2                # explore factor (x or /)
+  # Fused population (round 23): vmap the N single-device members
+  # over a leading member axis so every round trains ONE compiled
+  # Anakin program instead of N serial spin-ups — (learning_rate,
+  # entropy_cost) become traced per-member scalars, exploit is an
+  # on-device stacked-slice copy, PBT decide/explore stays host-side
+  # between rounds. Requires a single jittable suite; a model-axis
+  # mesh degrades to the serial member loop with a warning.
+  pbt_vectorized: bool = False
+  # Persistent XLA compilation cache (round 23): armed in
+  # distributed.maybe_initialize BEFORE backend spin-up, so repeat
+  # spin-ups of identical programs (population rounds, elastic
+  # rejoin, serving flips, plain restarts) skip retrace+compile.
+  # 'auto' = <logdir>/.jax_cache, armed on accelerator hosts only
+  # (CPU-pinned processes skip auto-arming: jaxlib's XLA:CPU
+  # executable reload can kill the process at driver scale); ''
+  # disables; any other value is the cache dir itself, armed on any
+  # backend (shareable across runs/processes — entries are keyed,
+  # concurrent writers are safe).
+  compile_cache_dir: str = 'auto'
 
   @property
   def frames_per_step(self):
@@ -772,6 +791,16 @@ class Config:
     if self.pbt_round_frames > 0:
       return self.pbt_round_frames
     return max(self.total_environment_frames // 4, 1)
+
+  @property
+  def resolved_compile_cache_dir(self) -> str:
+    """The persistent-compilation-cache dir with the 'auto' rule
+    applied ('' = disabled). Resolved here so the driver, bench.py,
+    and distributed.maybe_initialize can never disagree on where a
+    run's cache lives."""
+    if self.compile_cache_dir == 'auto':
+      return self.logdir + '/.jax_cache'
+    return self.compile_cache_dir
 
 
 def validate_replay(config: Config) -> List[str]:
@@ -1392,6 +1421,10 @@ def validate_population(config: Config) -> List[str]:
     warnings.append(
         'pbt_population=1: a population of one has no donor to '
         'exploit — PBT is off (use >= 2, ideally >= 2 per suite)')
+  if config.pbt_vectorized and config.pbt_population < 2:
+    warnings.append(
+        'pbt_vectorized without pbt_population >= 2: there is no '
+        'population to vectorize — the flag is inert')
   if config.pbt_population >= 2:
     if config.runtime != 'anakin':
       raise ValueError(
@@ -1411,6 +1444,19 @@ def validate_population(config: Config) -> List[str]:
           'pbt_suites mixes cue_memory (fixed 3-action) with '
           'gridworld/procgen (>= 4 actions): members share one agent '
           'architecture, so their policy heads must be one width')
+    if config.pbt_vectorized:
+      if len(set(suites)) > 1:
+        raise ValueError(
+            'pbt_vectorized: one vmapped program trains ONE suite '
+            '(member programs must be structurally identical), but '
+            f'pbt_suites names {sorted(set(suites))} — drop '
+            '--pbt_vectorized or train a single-suite population')
+      if config.model_parallelism > 1:
+        warnings.append(
+            'pbt_vectorized with model_parallelism=%d: vectorized '
+            'members are single-device programs — train_population '
+            'degrades to the serial member loop' %
+            config.model_parallelism)
     if config.pbt_population < len(suites):
       raise ValueError(
           f'pbt_population={config.pbt_population} cannot cover '

@@ -2268,8 +2268,8 @@ def train(config: Config, max_steps: Optional[int] = None,
 
 def train_anakin(config: Config, max_steps: Optional[int] = None,
                  max_seconds: Optional[float] = None,
-                 drain_event: Optional[threading.Event] = None
-                 ) -> TrainRun:
+                 drain_event: Optional[threading.Event] = None,
+                 initial_state=None) -> TrainRun:
   """The Anakin runtime (round 16, ROADMAP item 3): act+learn fused
   into one jitted device step (parallel/anakin.py, Podracer
   arXiv:2104.06272), run as a PRODUCTION run — the full lifecycle the
@@ -2302,6 +2302,15 @@ def train_anakin(config: Config, max_steps: Optional[int] = None,
   planes evaluate no_data, which never violates. `drain_event`
   (SIGTERM via experiment.py) stops the loop at the next fused-step
   boundary — the finally's tail checkpoint + verdict are the drain.
+
+  `initial_state` (round 23): a TrainState to start from INSTEAD of
+  restore_latest — the population loop's on-device exploit seam. An
+  in-process PBT loser inherits the donor's weights as a device
+  pytree; round-tripping that copy through the filesystem (the old
+  rmtree+copytree) cost a serialize/deserialize per exploit and a
+  window where the loser's checkpoint ladder didn't exist at all.
+  The ladder still records the decision durably: the loop's next
+  periodic save lands the inherited state in the loser's own dir.
 
   Returns a TrainRun whose fleet/prefetcher/server/stats are None.
   """
@@ -2337,20 +2346,30 @@ def train_anakin(config: Config, max_steps: Optional[int] = None,
       verify_digests=config.ckpt_digests,
       registry=sharding_lib.from_config(config), mesh=mesh)
   restore_ok = False
-  try:
-    restored = checkpointer.restore_latest(carry.train_state)
+  if initial_state is not None:
+    # On-device inheritance: the caller hands the starting state
+    # directly (already the right structure — it came from a sibling
+    # member of the same population). No disk round trip; the ladder
+    # below saves it durably at the normal cadence.
+    carry = carry._replace(train_state=initial_state)
     restore_ok = True
-  except BaseException:
-    # A structure-mismatch raise must not leak the manager (its
-    # background threads survive a same-process retry) — and the
-    # finally below must NOT tail-save a fresh state into a logdir
-    # holding an incompatible checkpoint (restore_ok gates it).
-    checkpointer.close()
-    raise
-  if restored is not None:
-    carry = carry._replace(train_state=restored)
-    log.info('restored checkpoint at step %d',
-             int(jax.device_get(restored.update_steps)))
+    log.info('starting from caller-provided state at step %d',
+             int(jax.device_get(initial_state.update_steps)))
+  else:
+    try:
+      restored = checkpointer.restore_latest(carry.train_state)
+      restore_ok = True
+    except BaseException:
+      # A structure-mismatch raise must not leak the manager (its
+      # background threads survive a same-process retry) — and the
+      # finally below must NOT tail-save a fresh state into a logdir
+      # holding an incompatible checkpoint (restore_ok gates it).
+      checkpointer.close()
+      raise
+    if restored is not None:
+      carry = carry._replace(train_state=restored)
+      log.info('restored checkpoint at step %d',
+               int(jax.device_get(restored.update_steps)))
   _initial_steps = int(jax.device_get(carry.train_state.update_steps))
 
   writer = None
@@ -2694,6 +2713,416 @@ def _member_return(member_dir: str, tag: str = 'mean_reward',
   return float(np.mean([v for _, v in vals[-tail:]]))
 
 
+def _inherit_member_dir(donor_dir: str, loser_dir: str) -> None:
+  """Cross-process PBT weight inheritance: the loser's checkpoint
+  ladder becomes a copy of the donor's — via copy-then-swap, so a
+  failed copy NEVER deletes the loser's own ladder (the r22 code did
+  rmtree-then-copytree, which left the loser with no restorable
+  checkpoint at all if the copy died mid-way). The loser's next
+  restore re-verifies the donor's content digests through the PR 2
+  ladder — a torn copy is refused, not trained on.
+
+  This is the cross-process fallback only: in-process exploits hand
+  the donor's state over as a device pytree (train_anakin's
+  initial_state seam) and never touch the filesystem."""
+  tmp = loser_dir + '.inherit_tmp'
+  old = loser_dir + '.inherit_old'
+  for leftover in (tmp, old):
+    if os.path.isdir(leftover):
+      shutil.rmtree(leftover)
+  try:
+    shutil.copytree(donor_dir, tmp)
+  except BaseException:
+    # The loser's ladder was never touched; only the partial copy
+    # goes.
+    shutil.rmtree(tmp, ignore_errors=True)
+    raise
+  if os.path.isdir(loser_dir):
+    os.rename(loser_dir, old)
+  os.rename(tmp, loser_dir)
+  shutil.rmtree(old, ignore_errors=True)
+
+
+def _train_population_fused(config: Config,
+                            max_steps: Optional[int] = None,
+                            max_seconds: Optional[float] = None,
+                            drain_event: Optional[threading.Event] = None
+                            ) -> TrainRun:
+  """The vectorized population (round 23, --pbt_vectorized): all N
+  members advance in ONE compiled program per round.
+
+  The serial loop (train_population below) spins train_anakin up N
+  times per round — N jit traces the first round, N spin-up/teardown
+  walls every round, and a device left idle while the host replays
+  lifecycle code between members. Here the member axis is a vmap
+  axis instead: one stacked carry, one fused act+learn step vmapped
+  over members, one dispatch per lockstep step. The PBT hypers
+  (learning_rate, entropy_cost) enter the program as TRACED
+  per-member scalars, so explore perturbations between rounds NEVER
+  retrigger compilation — round 2 reuses round 1's executable.
+
+  What stays host-side, by design: the decide/explore logic runs
+  BETWEEN rounds (pbt_decide on the members' summary returns), and
+  weight inheritance is a device-to-device stacked-index copy
+  (`train_state.at[loser].set(train_state[donor])`) — no rmtree, no
+  copytree, no serialize round trip. Each member still owns a real
+  checkpoint ladder: its slice is force-saved at every round
+  boundary AFTER exploits land, so the decision history is durable
+  and any member dir resumes (fused or serial) across processes.
+
+  Single-suite, single-device members: one vmapped program can only
+  train structurally identical members (validate_population rejects
+  multi-suite vectorized populations and degrades model-axis meshes
+  to the serial loop). Artifacts match the serial path:
+  population_summaries.jsonl, PBT_LOG.json (vectorized=true),
+  pbt_exploit/pbt_winner incidents, per-member summaries.jsonl and
+  checkpoints/, and the parent-logdir SLO verdict."""
+  from scalable_agent_tpu.parallel import anakin as anakin_lib
+  suite_list = list(config.resolved_pbt_suites)
+  suite = suite_list[0]
+  n = config.pbt_population
+  round_frames = config.resolved_pbt_round_frames
+  num_rounds = max(
+      1, -(-config.total_environment_frames // round_frames))
+  os.makedirs(config.logdir, exist_ok=True)
+  rng = np.random.default_rng(config.seed)
+
+  # Same hyper-init recipe as the serial loop (member 0 is the
+  # unperturbed control arm) — the two paths must be comparable.
+  members = []
+  for k in range(n):
+    hypers = {'learning_rate': config.learning_rate,
+              'entropy_cost': config.entropy_cost}
+    if k:
+      hypers = population_lib.pbt_explore(hypers, rng,
+                                          config.pbt_perturb)
+    members.append({'member': k, 'suite': suite, 'hypers': hypers})
+
+  base_config = dataclasses.replace(
+      config, env_backend=suite, pbt_population=0, fleet_tasks='',
+      pbt_vectorized=False)
+  env_core = anakin_lib.make_env_core(base_config)
+  agent = build_agent(base_config, env_core.num_actions)
+  vstep = anakin_lib.make_vectorized_anakin_step(agent, env_core,
+                                                 base_config)
+
+  member_dirs = []
+  member_configs = []
+  checkpointers = []
+  member_writers = []
+  writer = None
+  incidents = None
+  slo_engine = None
+  try:
+    for k in range(n):
+      member_dir = os.path.join(config.logdir, f'member_{k:02d}')
+      os.makedirs(member_dir, exist_ok=True)
+      member_config = dataclasses.replace(
+          base_config, logdir=member_dir,
+          seed=config.seed + 101 * k + 1,
+          learning_rate=members[k]['hypers']['learning_rate'],
+          entropy_cost=members[k]['hypers']['entropy_cost'])
+      with open(os.path.join(member_dir, 'config.json'), 'w') as f:
+        json.dump(dataclasses.asdict(member_config), f, indent=2,
+                  sort_keys=True)
+      member_dirs.append(member_dir)
+      member_configs.append(member_config)
+      checkpointers.append(checkpoint_lib.Checkpointer(
+          os.path.join(member_dir, 'checkpoints'),
+          save_interval_secs=config.checkpoint_secs,
+          verify_digests=config.ckpt_digests,
+          registry=sharding_lib.from_config(member_config)))
+      member_writers.append(observability.SummaryWriter(member_dir))
+
+    # Per-member init (each member's own PRNG stream — same seed
+    # recipe as the serial member spin-up), per-member restore
+    # through its own ladder, then ONE stacked carry.
+    carries = []
+    for k in range(n):
+      carry_k = anakin_lib.init_carry(
+          agent, env_core, base_config,
+          jax.random.PRNGKey(member_configs[k].seed))
+      restored = checkpointers[k].restore_latest(carry_k.train_state)
+      if restored is not None:
+        carry_k = carry_k._replace(train_state=restored)
+        log.info('member %d: restored checkpoint at step %d', k,
+                 int(jax.device_get(restored.update_steps)))
+      carries.append(carry_k)
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *carries)
+    del carries
+
+    writer = observability.SummaryWriter(config.logdir)
+    incidents = observability.EventLog(config.logdir)
+    lock_check.set_incident_sink(incidents.event)
+    with open(os.path.join(config.logdir, 'config.json'), 'w') as f:
+      json.dump(dataclasses.asdict(config), f, indent=2,
+                sort_keys=True)
+    fps_meter = observability.FpsMeter()
+    if config.slo_engine:
+      slo_objectives = slo_lib.load_objectives(
+          config.slo_spec,
+          fast_window_secs=config.slo_fast_window_secs,
+          slow_window_secs=config.slo_slow_window_secs)
+      slo_interval = (config.slo_interval_secs
+                      if config.slo_interval_secs > 0 else
+                      min(max(float(config.summary_secs), 1.0), 30.0,
+                          config.slo_fast_window_secs / 4.0))
+      slo_engine = slo_lib.SloEngine(
+          slo_objectives, config.logdir, writer=writer,
+          incidents=incidents, flight=None, health=None,
+          capture=config.slo_capture, interval_secs=slo_interval,
+          baseline=slo_lib.load_baseline(config.slo_fps_baseline))
+      slo_engine.start()
+  except BaseException:
+    for w in member_writers:
+      w.close()
+    for c in checkpointers:
+      c.close()
+    if slo_engine is not None:
+      slo_engine.stop()
+    if writer is not None:
+      writer.close()
+    if incidents is not None:
+      lock_check.set_incident_sink(None)
+      incidents.close()
+    raise
+
+  pop_path = os.path.join(config.logdir, 'population_summaries.jsonl')
+  pop_stats: Dict[str, float] = {'exploits': 0.0}
+  pop_gauges: List = []
+
+  def _ensure_gauges():
+    if pop_gauges:
+      return
+    pop_gauges.extend([
+        telemetry.gauge(
+            'population/task_return_min',
+            fn=lambda: pop_stats.get('task_return_min', 0.0)),
+        telemetry.gauge(
+            'population/best_return',
+            fn=lambda: pop_stats.get('best_return', 0.0)),
+        telemetry.gauge(
+            'population/exploits_total',
+            fn=lambda: pop_stats.get('exploits', 0.0)),
+    ])
+
+  pbt_log = {'population': n, 'suites': suite_list,
+             'round_frames': round_frames, 'num_rounds': num_rounds,
+             'quantile': config.pbt_quantile,
+             'perturb': config.pbt_perturb, 'vectorized': True,
+             'rounds': [], 'winner': None}
+
+  def _write_pbt_log():
+    path = os.path.join(config.logdir, 'PBT_LOG.json')
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+      json.dump(pbt_log, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+  _initial_steps = int(np.max(np.asarray(
+      jax.device_get(stacked.train_state.update_steps))))
+  steps_done = 0
+  frames_per_step = config.frames_per_step
+  _loop_gauges = [
+      telemetry.gauge('driver/update_steps',
+                      fn=lambda: steps_done + _initial_steps),
+      telemetry.gauge('driver/env_frames',
+                      fn=lambda: (steps_done + _initial_steps) *
+                      frames_per_step * n),
+      telemetry.gauge('driver/env_plane_utilization', fn=lambda: 1.0),
+      telemetry.gauge('driver/learner_plane_utilization',
+                      fn=lambda: 1.0),
+  ]
+
+  def _hyp_arrays():
+    return {
+        'learning_rate': jnp.asarray(
+            [m['hypers']['learning_rate'] for m in members],
+            jnp.float32),
+        'entropy_cost': jnp.asarray(
+            [m['hypers']['entropy_cost'] for m in members],
+            jnp.float32),
+    }
+
+  def _flush_members(pending):
+    step_f, (keys, stacked_vals) = pending
+    vals = np.asarray(jax.device_get(stacked_vals))  # [keys, N]
+    for k in range(n):
+      member_writers[k].scalars(
+          {key: float(vals[i, k]) for i, key in enumerate(keys)},
+          step_f)
+
+  returns = [0.0] * n
+  scored = False
+  pending_metrics = None
+  prev_metrics = None
+  loop_start = time.monotonic()
+  last_summary = loop_start
+  try:
+    for r in range(num_rounds):
+      if drain_event is not None and drain_event.is_set():
+        break
+      target = min((r + 1) * round_frames,
+                   config.total_environment_frames)
+      hyp = _hyp_arrays()
+      round_steps = 0
+      while True:
+        if drain_event is not None and drain_event.is_set():
+          incidents.event('anakin_stop_requested',
+                          step=_initial_steps + steps_done, round=r)
+          break
+        if (_initial_steps + steps_done) * frames_per_step >= target:
+          break
+        if max_steps is not None and round_steps >= max_steps:
+          break
+        if (max_seconds is not None and
+            time.monotonic() - loop_start > max_seconds):
+          break
+        stacked, metrics = vstep(stacked, hyp)
+        steps_done += 1
+        round_steps += 1
+        step_now = _initial_steps + steps_done
+        fps_meter.update(frames_per_step * n)
+        prev_metrics = pending_metrics
+        pending_metrics = (step_now,
+                           observability.stack_metrics(metrics))
+        now = time.monotonic()
+        if now - last_summary >= config.summary_secs:
+          last_summary = now
+          _flush_members(prev_metrics if prev_metrics is not None
+                         else pending_metrics)
+          writer.scalar('env_frames_per_sec', fps_meter.fps(),
+                        step_now)
+          if slo_engine is not None:
+            slo_engine.observe()
+      # Round boundary: flush the freshest metrics so the scoring
+      # pass below reads THIS round's tail, then score/decide.
+      if pending_metrics is not None:
+        _flush_members(pending_metrics)
+      for k in range(n):
+        returns[k] = _member_return(member_dirs[k])
+        row = {'wall_time': round(time.time(), 3), 'round': r,
+               'member': k, 'suite': suite, 'frames': target,
+               'mean_return': returns[k]}
+        row.update({f'hyper_{h}': float(v)
+                    for h, v in sorted(members[k]['hypers'].items())})
+        with open(pop_path, 'a') as f:
+          f.write(json.dumps(row, sort_keys=True) + '\n')
+      scored = True
+      pop_stats['task_return_min'] = min(returns)
+      pop_stats['best_return'] = max(returns)
+      _ensure_gauges()
+      writer.scalar('population/task_return_min',
+                    pop_stats['task_return_min'], target)
+      writer.scalar('population/best_return',
+                    pop_stats['best_return'], target)
+
+      round_rec = {'round': r, 'target_frames': target,
+                   'returns': list(returns),
+                   'suites': [suite] * n,
+                   'hypers': [dict(m['hypers']) for m in members],
+                   'decisions': []}
+      final_round = (r == num_rounds - 1 or
+                     (drain_event is not None and
+                      drain_event.is_set()))
+      if not final_round:
+        decisions = population_lib.pbt_decide(
+            returns, [suite] * n, rng,
+            quantile=config.pbt_quantile,
+            perturb=config.pbt_perturb,
+            hypers=[m['hypers'] for m in members])
+        for k, decision in enumerate(decisions):
+          if decision is None:
+            continue
+          donor = decision['donor']
+          # On-device weight inheritance: a stacked-index copy of
+          # the donor's train-state slice over the loser's — the
+          # r22 rmtree+copytree became one device op. (Only the
+          # train state transfers; the loser keeps its own env
+          # stream, exactly like the serial path, where inheritance
+          # never touched env state either.)
+          stacked = stacked._replace(
+              train_state=jax.tree_util.tree_map(
+                  lambda x: x.at[k].set(x[donor]),
+                  stacked.train_state))
+          members[k]['hypers'] = dict(decision['hypers'])
+          pop_stats['exploits'] += 1.0
+          incidents.event(
+              'pbt_exploit', step=target, round=r, member=k,
+              donor=donor, suite=suite,
+              member_return=returns[k], donor_return=returns[donor],
+              hypers=decision['hypers'])
+          log.info('pbt round %d: member %d (return %.3f) exploits '
+                   'member %d (return %.3f), new hypers %s '
+                   '[on-device]', r, k, returns[k], donor,
+                   returns[donor], decision['hypers'])
+          round_rec['decisions'].append(dict(decision, member=k))
+      writer.scalar('population/exploits_total',
+                    pop_stats['exploits'], target)
+      # Durable decision record: every member's slice lands in its
+      # OWN ladder after exploits, so the round's outcome (inherited
+      # weights included) survives this process — any member dir
+      # resumes, fused or serial.
+      for k in range(n):
+        checkpointers[k].save(
+            jax.tree_util.tree_map(lambda x: x[k],
+                                   stacked.train_state),
+            force=True)
+      pbt_log['rounds'].append(round_rec)
+      _write_pbt_log()
+
+    if scored:
+      winner = int(np.argmax(returns))
+      pbt_log['winner'] = {
+          'member': winner, 'suite': suite,
+          'return': returns[winner],
+          'hypers': dict(members[winner]['hypers']),
+          'logdir': member_dirs[winner]}
+      _write_pbt_log()
+      incidents.event('pbt_winner', member=winner, suite=suite,
+                      final_return=returns[winner],
+                      hypers=members[winner]['hypers'])
+      log.info('pbt winner: member %d (%s) return %.3f hypers %s '
+               '[vectorized]', winner, suite, returns[winner],
+               members[winner]['hypers'])
+      return TrainRun(
+          member_configs[winner], agent,
+          jax.tree_util.tree_map(lambda x: x[winner],
+                                 stacked.train_state),
+          None, None, None, checkpointers[winner],
+          member_writers[winner], None, fps_meter)
+    raise RuntimeError('population run trained no member (drained '
+                       'before the first round scored?)')
+  finally:
+    exiting_clean = sys.exc_info()[0] is None
+    if slo_engine is not None:
+      try:
+        slo_engine.stop()
+        verdict = slo_engine.finalize(
+            os.path.join(config.logdir, 'SLO_VERDICT.json'),
+            extra={'clean_exit': exiting_clean,
+                   'update_steps': _initial_steps + steps_done,
+                   'runtime': 'anakin', 'vectorized': True,
+                   'population': n})
+        (log.info if verdict['pass'] else log.warning)(
+            'SLO verdict: %s (%d objective(s), violations: %s)',
+            'PASS' if verdict['pass'] else 'FAIL',
+            len(verdict['objectives']),
+            verdict['violations'] or 'none')
+      except Exception:
+        log.exception('SLO verdict write failed')
+    for gauge in _loop_gauges + pop_gauges:
+      telemetry.registry().unregister(gauge.name, gauge)
+    for c in checkpointers:
+      c.close()
+    for w in member_writers:
+      w.close()
+    writer.close()
+    lock_check.set_incident_sink(None)
+    incidents.close()
+
+
 def train_population(config: Config, max_steps: Optional[int] = None,
                      max_seconds: Optional[float] = None,
                      drain_event: Optional[threading.Event] = None
@@ -2736,6 +3165,18 @@ def train_population(config: Config, max_steps: Optional[int] = None,
   if config.pbt_population < 2:
     raise ValueError(f'train_population needs pbt_population >= 2, '
                      f'got {config.pbt_population}')
+  if config.pbt_vectorized:
+    # Round 23: the fused path — one vmapped program advances every
+    # member in lockstep. Single-device members only: a model-axis
+    # mesh degrades to the serial loop (validate_population already
+    # warned).
+    if config.model_parallelism <= 1:
+      return _train_population_fused(config, max_steps=max_steps,
+                                     max_seconds=max_seconds,
+                                     drain_event=drain_event)
+    log.warning('pbt_vectorized ignored (model_parallelism=%d): '
+                'running the serial member loop',
+                config.model_parallelism)
   suite_list = list(config.resolved_pbt_suites)
   n = config.pbt_population
   round_frames = config.resolved_pbt_round_frames
@@ -2787,8 +3228,8 @@ def train_population(config: Config, max_steps: Optional[int] = None,
   pbt_log = {'population': n, 'suites': suite_list,
              'round_frames': round_frames, 'num_rounds': num_rounds,
              'quantile': config.pbt_quantile,
-             'perturb': config.pbt_perturb, 'rounds': [],
-             'winner': None}
+             'perturb': config.pbt_perturb, 'vectorized': False,
+             'rounds': [], 'winner': None}
 
   def _write_pbt_log():
     path = os.path.join(config.logdir, 'PBT_LOG.json')
@@ -2798,6 +3239,11 @@ def train_population(config: Config, max_steps: Optional[int] = None,
     os.replace(tmp, path)
 
   runs: Dict[int, TrainRun] = {}
+  # Round 23: in-process weight inheritance. An exploited loser's
+  # next spin-up starts from this device pytree instead of its own
+  # checkpoint — no filesystem round trip, no window where its
+  # ladder is gone.
+  inherit: Dict[int, object] = {}
   returns = [0.0] * n
   try:
     for r in range(num_rounds):
@@ -2826,7 +3272,8 @@ def train_population(config: Config, max_steps: Optional[int] = None,
             fleet_tasks='')
         runs[k] = train_anakin(member_config, max_steps=max_steps,
                                max_seconds=max_seconds,
-                               drain_event=drain_event)
+                               drain_event=drain_event,
+                               initial_state=inherit.pop(k, None))
         returns[k] = _member_return(member_dir)
         row = {'wall_time': round(time.time(), 3), 'round': r,
                'member': k, 'suite': m['suite'], 'frames': target,
@@ -2870,17 +3317,30 @@ def train_population(config: Config, max_steps: Optional[int] = None,
           if decision is None:
             continue
           donor = decision['donor']
-          src = os.path.join(config.logdir, f'member_{donor:02d}',
-                             'checkpoints')
-          dst = os.path.join(config.logdir, f'member_{k:02d}',
-                             'checkpoints')
-          if os.path.isdir(src):
-            # Weight inheritance THROUGH the checkpoint ladder: the
-            # loser's next restore_latest re-verifies the donor's
-            # content digests — a torn copy is refused, not loaded.
-            if os.path.isdir(dst):
-              shutil.rmtree(dst)
-            shutil.copytree(src, dst)
+          if donor in runs:
+            # On-device inheritance (round 23): the donor trained in
+            # THIS process, so its final state is already a device
+            # pytree — deep-copy it (the loser's fused step donates
+            # its carry; an aliased buffer would invalidate the
+            # donor's state and any sibling inheriting it too) and
+            # hand it to the loser's next spin-up. The loser's own
+            # ladder then records the inherited-and-trained state at
+            # the normal save cadence — durable, without a
+            # serialize/deserialize round trip per exploit.
+            inherit[k] = jax.tree_util.tree_map(
+                lambda x: jnp.array(x, copy=True), runs[donor].state)
+          else:
+            # Cross-process fallback: inherit through the checkpoint
+            # ladder — the loser's next restore_latest re-verifies
+            # the donor's content digests (a torn copy is refused,
+            # not loaded), and the copy-then-swap helper never
+            # leaves the loser without a ladder.
+            src = os.path.join(config.logdir, f'member_{donor:02d}',
+                               'checkpoints')
+            dst = os.path.join(config.logdir, f'member_{k:02d}',
+                               'checkpoints')
+            if os.path.isdir(src):
+              _inherit_member_dir(src, dst)
           members[k]['hypers'] = dict(decision['hypers'])
           pop_stats['exploits'] += 1.0
           incidents.event(

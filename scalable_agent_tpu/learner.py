@@ -20,6 +20,7 @@ Trajectory layout reminder (time-major [T+1, B]):
 so rewards[1:] pair with values[:-1] and the bootstrap is V(o_T).
 """
 
+import dataclasses
 import functools
 from typing import Any, NamedTuple
 
@@ -110,7 +111,7 @@ def align_batch(env_outputs, agent_outputs, learner_outputs, config):
 
 def loss_fn(params, agent, batch: ActorOutput, config: Config,
             popart_state=None, mesh=None, target_params=None,
-            target_popart=None):
+            target_popart=None, entropy_cost=None):
   """Total IMPALA loss for one batch; returns (loss, (metrics, aux)).
 
   `mesh` is the sharded step's mesh (train_parallel passes it; None on
@@ -136,7 +137,13 @@ def loss_fn(params, agent, batch: ActorOutput, config: Config,
   instead of -log pi * A. Behavior-vs-target staleness is therefore
   handled per the paper: mu may lag arbitrarily (V-trace corrects it
   against the anchor), and theta may run ahead of the anchor only as
-  far as the clip band allows."""
+  far as the clip band allows.
+
+  `entropy_cost` (round 23, the vectorized population): an optional
+  TRACED override of config.entropy_cost — vmapping PBT members over
+  one program needs the per-member hypers as array inputs, not baked
+  constants. None (every non-population caller) keeps the config's
+  compile-time constant, bit-identical to before."""
   task_ids = jnp.asarray(batch.level_name).astype(jnp.int32)
   use_pc = config.pixel_control_cost > 0
   if use_pc:
@@ -226,8 +233,9 @@ def loss_fn(params, agent, batch: ActorOutput, config: Config,
         vtrace_returns.vs - inputs.values)
   entropy_loss = losses_lib.compute_entropy_loss(inputs.target_logits)
 
+  ec = config.entropy_cost if entropy_cost is None else entropy_cost
   total_loss = (pg_loss + config.baseline_cost * baseline_loss +
-                config.entropy_cost * entropy_loss)
+                ec * entropy_loss)
   metrics = {
       'total_loss': total_loss,
       'pg_loss': pg_loss,
@@ -361,29 +369,57 @@ def make_train_state(params, config: Config,
           if target is not None and popart is not None else None))
 
 
-def make_train_step_fn(agent, config: Config, mesh=None):
+def make_train_step_fn(agent, config: Config, mesh=None,
+                       traced_hypers: bool = False):
   """The raw (unjitted) train step: (TrainState, batch) → (state,
   metrics). Single source of truth — jitted plain here and with explicit
   shardings in parallel/train_parallel.py (which passes its mesh so the
-  Pallas V-trace can shard_map over the data axis)."""
+  Pallas V-trace can shard_map over the data axis).
+
+  `traced_hypers` (round 23, the vectorized population): the step
+  becomes (state, batch, hypers) with hypers a dict of traced scalars
+  {'learning_rate', 'entropy_cost'} — what lets jax.vmap carry N PBT
+  members through ONE compiled program with per-member hypers as
+  array inputs. The optimizer is built at unit learning rate (the
+  schedule keeps its shape, so opt_state structure — and therefore
+  checkpoints — interchange exactly with the baked-constant step) and
+  the traced lr post-scales the updates. Exact for the config default
+  momentum=0, and for any constant-lr run (optax.trace is linear);
+  with momentum AND mid-round decay the lr applies one multiply later
+  than the baked form — same first-order update, not bit-identical."""
   # Unified-registry telemetry (round 13): each build corresponds to
   # one XLA (re)compile of the step — a climbing count mid-run means
   # shape churn recompiling the hot path; frames_per_step is the
   # constant trace_report's throughput arithmetic divides by.
   _STEP_FN_BUILDS.inc()
   _FRAMES_PER_STEP.set(frames_per_step(config))
-  optimizer = make_optimizer(config)
-  schedule = make_schedule(config)
+  if traced_hypers:
+    # Unit-lr optimizer/schedule: schedule(count) is the pure decay
+    # fraction; the member's traced lr multiplies it back in.
+    unit_config = dataclasses.replace(config, learning_rate=1.0)
+    optimizer = make_optimizer(unit_config)
+    schedule = make_schedule(unit_config)
+  else:
+    optimizer = make_optimizer(config)
+    schedule = make_schedule(config)
 
-  def train_step(state: TrainState, batch: ActorOutput):
+  def train_step(state: TrainState, batch: ActorOutput, hypers=None):
+    if traced_hypers:
+      lr = jnp.asarray(hypers['learning_rate'], jnp.float32)
+      ec = jnp.asarray(hypers['entropy_cost'], jnp.float32)
+    else:
+      lr = None
+      ec = None
     (total_loss, (metrics, aux)), grads = jax.value_and_grad(
         loss_fn, has_aux=True)(state.params, agent, batch, config,
                                state.popart, mesh, state.target_params,
-                               state.target_popart)
+                               state.target_popart, ec)
     # Pre-clip norm: explosions must stay visible even with clipping on.
     metrics['grad_norm'] = optax.global_norm(grads)
     updates, new_opt_state = optimizer.update(
         grads, state.opt_state, state.params)
+    if traced_hypers:
+      updates = jax.tree_util.tree_map(lambda u: lr * u, updates)
     new_params = optax.apply_updates(state.params, updates)
     new_popart = state.popart
     if state.popart is not None:
@@ -444,7 +480,9 @@ def make_train_step_fn(agent, config: Config, mesh=None):
     new_state = TrainState(new_params, new_opt_state,
                            state.update_steps + 1, new_popart,
                            new_target, new_target_popart)
-    metrics['learning_rate'] = schedule(state.update_steps)
+    metrics['learning_rate'] = (
+        lr * schedule(state.update_steps) if traced_hypers
+        else schedule(state.update_steps))
     return new_state, metrics
 
   return train_step

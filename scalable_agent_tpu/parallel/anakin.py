@@ -383,7 +383,9 @@ def make_anakin_step(agent, env_core, config: Config,
                      return_batch: bool = False,
                      train_step_fn=None,
                      advance_steps: bool = True,
-                     mesh=None):
+                     mesh=None,
+                     traced_hypers: bool = False,
+                     jit: bool = True):
   """One fused device step: scan T acting steps, then the SGD update.
 
   Returns jitted `f(carry) -> (carry, metrics)` (donating the carry);
@@ -407,9 +409,17 @@ def make_anakin_step(agent, env_core, config: Config,
   updated [num_levels] score table is constrained back to REPLICATED
   so the carry's placement is a fixed point (without the constraint
   the partitioner shards the segment-sum output over data, and the
-  sharding flip forces a second compile at step 2)."""
+  sharding flip forces a second compile at step 2).
+
+  `traced_hypers` / `jit` (round 23, the vectorized population): with
+  traced_hypers the step becomes f(carry, hypers) — hypers a dict of
+  traced {'learning_rate', 'entropy_cost'} scalars threaded into the
+  learner's traced-hypers train step. jit=False returns the RAW
+  function instead of jitting it, so make_vectorized_anakin_step can
+  jax.vmap it over a leading member axis before the one jit."""
   if train_step_fn is None:
-    train_step_fn = learner.make_train_step_fn(agent, config)
+    train_step_fn = learner.make_train_step_fn(
+        agent, config, traced_hypers=traced_hypers)
   t = config.unroll_length
   # Python-level gate (round 22): the curriculum block only traces for
   # cores with a finite level-id space (procgen). The sampler itself
@@ -421,7 +431,7 @@ def make_anakin_step(agent, env_core, config: Config,
   use_curriculum = (config.curriculum != 'uniform'
                     and hasattr(env_core, 'num_levels'))
 
-  def anakin_step(carry: AnakinCarry):
+  def anakin_step(carry: AnakinCarry, hypers=None):
     initial_core_state = carry.core_state
     params = carry.train_state.params  # pre-update: behaviour == target
 
@@ -462,7 +472,12 @@ def make_anakin_step(agent, env_core, config: Config,
         agent_outputs=jax.tree_util.tree_map(
             lambda first, rest: jnp.concatenate([first[None], rest]),
             carry.agent_output, tail[1]))
-    new_train_state, metrics = train_step_fn(carry.train_state, batch)
+    if traced_hypers:
+      new_train_state, metrics = train_step_fn(carry.train_state,
+                                               batch, hypers)
+    else:
+      new_train_state, metrics = train_step_fn(carry.train_state,
+                                               batch)
     if not advance_steps:
       new_train_state = new_train_state._replace(
           update_steps=carry.train_state.update_steps)
@@ -506,7 +521,41 @@ def make_anakin_step(agent, env_core, config: Config,
                         agent_output, core_state, rng),
             metrics)
 
+  if not jit:
+    return anakin_step
   return jax.jit(anakin_step, donate_argnums=(0,))
+
+
+def make_vectorized_anakin_step(agent, env_core, config: Config):
+  """One compiled program that advances N PBT members in lockstep.
+
+  vmaps the *raw* (unjitted) fused act+learn step over a leading
+  member axis of both the carry and the per-member hyper dict, then
+  jits the vmapped function once with the stacked carry donated.
+  Member programs must be structurally identical (same suite, same
+  shapes) — only (learning_rate, entropy_cost) vary, and those enter
+  as traced scalars so PBT explore never retriggers compilation.
+
+  Returns a function `step(stacked_carry, hypers) -> (stacked_carry,
+  stacked_metrics)` where `hypers` is a dict of f32[N] arrays with
+  keys 'learning_rate' and 'entropy_cost', and every metric leaf
+  gains a leading member axis.
+  """
+  raw_step = make_anakin_step(agent, env_core, config,
+                              traced_hypers=True, jit=False)
+  return jax.jit(jax.vmap(raw_step), donate_argnums=(0,))
+
+
+def init_stacked_carry(agent, env_core, config: Config, seeds):
+  """Stacks per-member initial carries along a leading member axis.
+
+  Each member gets its own PRNG stream (and therefore its own env
+  reset and weight init) from its entry in `seeds`; the results are
+  tree-stacked so a single vmapped step advances all members.
+  """
+  carries = [init_carry(agent, env_core, config, jax.random.PRNGKey(s))
+             for s in seeds]
+  return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *carries)
 
 
 def build_run(config: Config, mesh=None,

@@ -20,6 +20,7 @@ On a single host this module is a no-op; the driver works unchanged.
 """
 
 import logging
+import os
 from typing import Optional
 
 import jax
@@ -129,6 +130,70 @@ def initialize(coordinator_address: str, num_processes: int,
            jax.device_count())
 
 
+def _cpu_pinned_platform() -> bool:
+  """True when this process is explicitly pinned to XLA:CPU.
+
+  Checked WITHOUT touching `jax.devices()` — arming must never be
+  what spins up the backend (that would break the
+  distributed-init-before-backend ordering above). The config value
+  is authoritative (the sandbox's sitecustomize and tests/conftest.py
+  both pin through it); the env var covers plain
+  `JAX_PLATFORMS=cpu python ...` launches."""
+  plats = (getattr(jax.config, 'jax_platforms', None)
+           or os.environ.get('JAX_PLATFORMS', '') or '')
+  return plats.strip().lower() == 'cpu'
+
+
+def _arm_compile_cache(config) -> None:
+  """Point jax's persistent compilation cache at the config's dir.
+
+  Must run BEFORE backend spin-up so the very first jit lowers
+  through the cache — armed after the fact, the cold compile of the
+  fused step (the expensive one) is never written. First writer
+  wins: if something already armed a cache dir this process (a
+  launcher, a test fixture, an earlier member in the same process),
+  we leave it — one shared dir is the point, and members of a
+  population deliberately converge on the parent logdir's cache.
+  Resolved-empty disables cleanly. Failures only cost the warm-start
+  optimization, never the run, so everything is best-effort.
+
+  'auto' declines to arm on a CPU-pinned process: jaxlib's XLA:CPU
+  executable deserialization is unreliable at driver scale (observed
+  SIGSEGV/SIGABRT reloading ~1 MB train-step executables on jaxlib
+  0.4.36 — one of two near-identical cache entries loads fine, the
+  other kills the process), so a cache that silently turns itself on
+  for every CPU test/tool run is a process-crash lottery, not an
+  optimization. An EXPLICIT --compile_cache_dir still arms anywhere:
+  opting in by hand is the caller saying their programs are small
+  enough to reload safely (the anakin/bandit programs are — measured
+  in docs/PERF.md)."""
+  try:
+    d = config.resolved_compile_cache_dir
+    if not d:
+      return
+    if config.compile_cache_dir == 'auto' and _cpu_pinned_platform():
+      log.info('persistent compilation cache: auto-arm skipped on '
+               'CPU-pinned process (XLA:CPU executable reload is '
+               'unreliable; pass --compile_cache_dir explicitly to '
+               'override)')
+      return
+    if getattr(jax.config, 'jax_compilation_cache_dir', None):
+      return  # first writer wins — an armed cache stays armed.
+    os.makedirs(d, exist_ok=True)
+    jax.config.update('jax_compilation_cache_dir', d)
+    try:
+      # Drop any cache backend built against the previous (None)
+      # config value so the new dir actually takes effect.
+      from jax._src import compilation_cache
+      compilation_cache.reset_cache()
+    except Exception:
+      pass
+    log.info('persistent compilation cache armed: %s', d)
+  except Exception:
+    log.warning('could not arm persistent compilation cache',
+                exc_info=True)
+
+
 def maybe_initialize(config) -> bool:
   """driver.train's spin-up seam (round 17): join the runtime the
   config names, exactly once.
@@ -136,7 +201,13 @@ def maybe_initialize(config) -> bool:
   Returns True when this call initialized. No-ops (False) when the
   config names no coordinator, or when the process already joined —
   the launcher/test-harness path, where jax.distributed was
-  initialized before driver.train was called."""
+  initialized before driver.train was called.
+
+  Also arms the persistent compilation cache (round 23) — here
+  rather than in train() because the cache config must be set before
+  the backend exists, and this is the one seam every entry path
+  (train, train_population members, evaluate) crosses first."""
+  _arm_compile_cache(config)
   if not config.coordinator_address:
     return False
   if is_initialized():

@@ -358,4 +358,49 @@ print('population lane OK: regret curriculum in-graph, verdict PASS, '
 POP_EOF
 BENCH_SMOKE=1 BENCH_ONLY=population python bench.py
 
+echo '== fused population + compile cache lane (round 23: vmapped PBT'
+echo '   members in ONE Anakin program, on-device weight inheritance,'
+echo '   persistent compilation cache — the compile-cache unit tests,'
+echo '   a tiny N=2 fused driver run asserting PBT_LOG.json records'
+echo '   vectorized=true + verdict PASS + per-member ladders, and a'
+echo '   two-process cache smoke: process A compiles into a shared'
+echo '   dir, process B proves a cache HIT via the jax monitoring'
+echo '   events — <300 s CPU) =='
+JAX_PLATFORMS=cpu python -m pytest tests/test_compile_cache.py -q \
+  -p no:cacheprovider
+JAX_PLATFORMS=cpu python - <<'FUSED_EOF'
+import json, logging, os, sys, tempfile
+logging.basicConfig(level=logging.WARNING)
+sys.path.insert(0, os.getcwd())
+from scalable_agent_tpu import driver, slo
+from scalable_agent_tpu.config import Config
+logdir = tempfile.mkdtemp(prefix='ci_fused_pop_')
+cfg = Config(logdir=logdir, runtime='anakin', env_backend='gridworld',
+             pbt_population=2, pbt_vectorized=True,
+             pbt_suites='gridworld', pbt_round_frames=80,
+             pbt_quantile=0.5, batch_size=4, unroll_length=4,
+             num_action_repeats=1, height=24, width=32,
+             torso='shallow', use_py_process=False,
+             use_instruction=False, summary_secs=0, checkpoint_secs=0,
+             total_environment_frames=160, seed=7)
+run = driver.train(cfg)
+log = json.load(open(os.path.join(logdir, 'PBT_LOG.json')))
+assert log['vectorized'] is True, log
+assert len(log['rounds']) == 2 and log['winner'] is not None, log
+verdict = slo.read_verdict(logdir)
+assert verdict is not None and verdict['pass'], verdict
+for k in range(2):
+    member = os.path.join(logdir, 'member_%02d' % k)
+    assert os.listdir(os.path.join(member, 'checkpoints')), member
+    assert os.path.exists(os.path.join(member, 'summaries.jsonl'))
+print('fused population OK: one program, %d round(s), winner member '
+      '%d, verdict PASS' % (len(log['rounds']),
+                            log['winner']['member']))
+FUSED_EOF
+CACHE_DIR=$(mktemp -d)/ci_jax_cache
+JAX_PLATFORMS=cpu CI_CACHE_DIR="$CACHE_DIR" CI_CACHE_PHASE=fill \
+  python scripts/_compile_cache_smoke.py
+JAX_PLATFORMS=cpu CI_CACHE_DIR="$CACHE_DIR" CI_CACHE_PHASE=hit \
+  python scripts/_compile_cache_smoke.py
+
 echo 'CI OK'

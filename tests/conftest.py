@@ -47,6 +47,39 @@ def pytest_configure(config):
       'exercises at least one injected fault per layer')
 
 
+# --- Tier-1 wall sentinel (round 23): the tier-1 lane runs under a
+# hard `timeout` in the verify command; a run that creeps past the
+# budget gets KILLED with no attribution. Accumulate per-item wall
+# here and, when the suite total crosses the soft threshold, print
+# the slowest items so the offender is named BEFORE the hard timeout
+# starts eating the suite. Threshold sits under the 870 s hard
+# budget on purpose — it fires while the run still finishes. ---
+
+_WALL_BUDGET_SOFT_SECS = 800.0
+_item_walls = {}
+
+
+def pytest_runtest_logreport(report):
+  if report.duration:
+    _item_walls[report.nodeid] = (
+        _item_walls.get(report.nodeid, 0.0) + report.duration)
+
+
+def pytest_terminal_summary(terminalreporter):
+  total = sum(_item_walls.values())
+  if total <= _WALL_BUDGET_SOFT_SECS:
+    return
+  terminalreporter.write_sep(
+      '=', 'WALL SENTINEL: suite used %.0f s (> %.0f s soft budget)'
+      % (total, _WALL_BUDGET_SOFT_SECS))
+  terminalreporter.write_line(
+      'slowest 10 items (setup+call+teardown) — mark the worst '
+      'offenders @pytest.mark.slow or shrink their shapes:')
+  worst = sorted(_item_walls.items(), key=lambda kv: -kv[1])[:10]
+  for nodeid, wall in worst:
+    terminalreporter.write_line('  %8.2f s  %s' % (wall, nodeid))
+
+
 @pytest.fixture
 def batcher_options_spy(monkeypatch):
   """Intercept dynamic_batching.Batcher construction and record each

@@ -151,6 +151,7 @@ class TestVtraceFormsInLearner:
     np.testing.assert_allclose(losses[0], losses[1], rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_grad_clip_norm_bounds_update():
   """config.grad_clip_norm wires optax.clip_by_global_norm into the
   update chain: a near-zero clip must shrink the first-step param

@@ -173,6 +173,7 @@ def test_full_feature_sharded_matches_single_device(model_parallelism):
                                rtol=5e-4, atol=5e-6)
 
 
+@pytest.mark.slow
 def test_pallas_vtrace_sharded_step_matches_single_device():
   """Round 8 acceptance: the fused Pallas V-trace inside the FULL
   sharded train step (shard_map over the data axis — the driver's

@@ -341,11 +341,13 @@ def test_pbt_decide_equal_returns_keep():
 
 def test_pbt_exploit_inherits_weights_through_checkpoint_ladder(
     tmp_path):
-  """The exploit move IS a checkpoint-directory copy: the loser's
-  next restore_latest loads the donor's verified state (digests
-  re-checked on the copied files), exactly what
-  driver.train_population does between rounds."""
-  import shutil
+  """The CROSS-PROCESS exploit fallback IS a checkpoint-directory
+  copy: the loser's next restore_latest loads the donor's verified
+  state (digests re-checked on the copied files). Round 23 moved the
+  in-process exploit on device (driver hands the donor's live
+  TrainState to the loser's next run); this copy-then-swap helper
+  remains the path for populations whose members span processes."""
+  from scalable_agent_tpu import driver
   from scalable_agent_tpu import learner as learner_lib
   from scalable_agent_tpu.checkpoint import Checkpointer
   from scalable_agent_tpu.models import ImpalaAgent, init_params
@@ -373,9 +375,10 @@ def test_pbt_exploit_inherits_weights_through_checkpoint_ladder(
   loser.wait_until_finished()
   loser.close()
 
-  # The exploit: donor's ladder replaces the loser's wholesale.
-  shutil.rmtree(loser_dir)
-  shutil.copytree(donor_dir, loser_dir)
+  # The exploit: donor's ladder replaces the loser's wholesale —
+  # through the hardened helper (a failed copy never deletes the
+  # loser's ladder; see the regression test below).
+  driver._inherit_member_dir(donor_dir, loser_dir)
 
   fresh = Checkpointer(loser_dir, save_interval_secs=0)
   restored = fresh.restore_latest(loser_state)
@@ -524,3 +527,275 @@ def test_population_two_suites_per_task_curves(tmp_path):
   with open(tmp_path / 'member_01' / 'CURRICULUM_LEVELS.json') as f:
     levels = json.load(f)
   assert len(levels['visits']) == 4 and sum(levels['visits']) > 0
+
+
+# --- Round 23: fused (vmapped) population, on-device inheritance. ---
+
+
+def test_vectorized_anakin_member0_matches_serial_step():
+  """The parity contract behind --pbt_vectorized: member 0 of the
+  vmapped N=2 program, fed the config's own hypers as traced scalars,
+  reproduces the plain (baked-constant) fused step from the same seed
+  — same params, same metrics — while a second member with
+  learning_rate=0 proves the traced scalars are real per-member
+  inputs (its params stay frozen at init)."""
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu.parallel import anakin
+
+  cfg = Config(env_backend='bandit', batch_size=4, unroll_length=5,
+               num_action_repeats=1, episode_length=5, height=24,
+               width=32, torso='shallow', use_instruction=False,
+               learning_rate=2e-3, entropy_cost=3e-3,
+               discounting=0.9, total_environment_frames=10**9,
+               seed=0)
+  env_core = anakin.make_env_core(cfg)
+  agent = driver.build_agent(cfg, env_core.num_actions)
+
+  serial_step = anakin.make_anakin_step(agent, env_core, cfg)
+  serial = anakin.init_carry(agent, env_core, cfg,
+                             jax.random.PRNGKey(11))
+
+  vstep = anakin.make_vectorized_anakin_step(agent, env_core, cfg)
+  stacked = anakin.init_stacked_carry(agent, env_core, cfg, (11, 12))
+  frozen_init = jax.tree_util.tree_map(
+      lambda x: np.asarray(x[1]), stacked.train_state.params)
+  hypers = {
+      'learning_rate': jnp.asarray([cfg.learning_rate, 0.0],
+                                   jnp.float32),
+      'entropy_cost': jnp.asarray([cfg.entropy_cost, cfg.entropy_cost],
+                                  jnp.float32)}
+  for _ in range(3):
+    serial, m_serial = serial_step(serial)
+    stacked, m_vec = vstep(stacked, hypers)
+
+  assert np.asarray(m_vec['mean_reward']).shape == (2,)
+  np.testing.assert_allclose(float(np.asarray(m_vec['mean_reward'])[0]),
+                             float(m_serial['mean_reward']),
+                             rtol=1e-5, atol=1e-6)
+  np.testing.assert_allclose(
+      float(np.asarray(m_vec['learning_rate'])[0]),
+      float(m_serial['learning_rate']), rtol=1e-5)
+  assert float(np.asarray(m_vec['learning_rate'])[1]) == 0.0
+  for got, want in zip(
+      jax.tree_util.tree_leaves(stacked.train_state.params),
+      jax.tree_util.tree_leaves(serial.train_state.params)):
+    np.testing.assert_allclose(np.asarray(got)[0], np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+  # lr=0 member: three updates applied nothing.
+  for got, want in zip(
+      jax.tree_util.tree_leaves(stacked.train_state.params),
+      jax.tree_util.tree_leaves(frozen_init)):
+    np.testing.assert_array_equal(np.asarray(got)[1], want)
+  assert int(np.asarray(stacked.train_state.update_steps)[1]) == 3
+
+
+def test_inherit_member_dir_failed_copy_preserves_loser_ladder(
+    tmp_path, monkeypatch):
+  """ISSUE r23 satellite: an exploit whose filesystem copy FAILS must
+  not have deleted the loser's checkpoint dir first. The fallback is
+  copy-then-swap — the donor lands in a sibling tmp dir and only a
+  complete copy replaces the loser."""
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu.checkpoint import Checkpointer
+  from scalable_agent_tpu import learner as learner_lib
+  from scalable_agent_tpu.models import ImpalaAgent, init_params
+  from scalable_agent_tpu.models.instruction import MAX_INSTRUCTION_LEN
+
+  cfg = Config(batch_size=2, unroll_length=3, torso='shallow',
+               total_environment_frames=10**6)
+  agent = ImpalaAgent(num_actions=4, torso='shallow')
+  obs_spec = {'frame': (24, 32, 3), 'instr_len': MAX_INSTRUCTION_LEN}
+  donor_state = learner_lib.make_train_state(
+      init_params(agent, jax.random.PRNGKey(0), obs_spec), cfg)
+  loser_state = learner_lib.make_train_state(
+      init_params(agent, jax.random.PRNGKey(1), obs_spec), cfg)
+  loser_state = loser_state._replace(
+      update_steps=jnp.asarray(5, jnp.int32))
+
+  donor_dir = str(tmp_path / 'member_00' / 'checkpoints')
+  loser_dir = str(tmp_path / 'member_01' / 'checkpoints')
+  for d, state in ((donor_dir, donor_state), (loser_dir, loser_state)):
+    ckpt = Checkpointer(d, save_interval_secs=0)
+    ckpt.save(state, force=True)
+    ckpt.wait_until_finished()
+    ckpt.close()
+
+  import shutil as shutil_lib
+
+  def boom(src, dst, *args, **kwargs):
+    raise OSError('disk full mid-copy')
+
+  monkeypatch.setattr(driver.shutil, 'copytree', boom)
+  with pytest.raises(OSError):
+    driver._inherit_member_dir(donor_dir, loser_dir)
+  monkeypatch.undo()
+
+  # No half-copied tmp dir left behind, and the loser's OWN ladder is
+  # intact and restorable.
+  assert not os.path.exists(loser_dir + '.inherit_tmp')
+  fresh = Checkpointer(loser_dir, save_interval_secs=0)
+  restored = fresh.restore_latest(loser_state)
+  fresh.close()
+  assert restored is not None
+  assert int(restored.update_steps) == 5
+  for got, want in zip(jax.tree_util.tree_leaves(restored.params),
+                       jax.tree_util.tree_leaves(loser_state.params)):
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+  del shutil_lib
+
+
+def test_validate_population_vectorized_rules():
+  base = dict(runtime='anakin', env_backend='gridworld',
+              pbt_population=2, pbt_round_frames=400,
+              total_environment_frames=800)
+  # One vmapped program trains ONE suite: a multi-suite population
+  # cannot vectorize (member programs would differ structurally).
+  with pytest.raises(ValueError, match='vectorized'):
+    validate_population(Config(pbt_vectorized=True,
+                               pbt_suites='gridworld,procgen', **base))
+  # A model-axis mesh degrades to the serial member loop with a
+  # warning, not an error (members are single-device programs).
+  warnings = validate_population(
+      Config(pbt_vectorized=True, pbt_suites='gridworld',
+             model_parallelism=2, **base))
+  assert any('serial' in w for w in warnings)
+  # Vectorized without a population is inert, flagged.
+  warnings = validate_population(
+      Config(runtime='anakin', env_backend='gridworld',
+             pbt_vectorized=True))
+  assert any('pbt_vectorized' in w for w in warnings)
+  # The happy path is silent about vectorization.
+  assert validate_population(
+      Config(pbt_vectorized=True, pbt_suites='gridworld',
+             **base)) == []
+
+
+@pytest.mark.slow
+def test_population_fused_one_program_two_members(tmp_path,
+                                                 monkeypatch):
+  """ONE driver.train call with --pbt_vectorized: both members train
+  inside one vmapped Anakin program per round, exploit is the
+  on-device stacked-slice copy (no member checkpoint dir is ever
+  rmtree'd), and the artifact contract matches the serial engine —
+  PBT_LOG.json (now with vectorized=true), population_summaries
+  rows, pbt_exploit/pbt_winner incidents, per-member summaries and
+  checkpoint ladders, and a parent-logdir SLO verdict."""
+  import shutil as shutil_lib
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu import slo as slo_lib
+
+  monkeypatch.setattr(
+      driver, '_member_return',
+      lambda member_dir, tag='mean_reward', tail=5:
+          1.0 if 'member_01' in member_dir else 0.0)
+  removed = []
+  real_rmtree = shutil_lib.rmtree
+
+  def spy_rmtree(path, *args, **kwargs):
+    removed.append(str(path))
+    return real_rmtree(path, *args, **kwargs)
+
+  monkeypatch.setattr(driver.shutil, 'rmtree', spy_rmtree)
+
+  cfg = Config(env_backend='gridworld', runtime='anakin',
+               batch_size=4, unroll_length=5, num_action_repeats=1,
+               episode_length=8, height=24, width=32, torso='shallow',
+               use_instruction=False, use_py_process=False,
+               learning_rate=2e-3, entropy_cost=3e-3,
+               discounting=0.9, total_environment_frames=800,
+               seed=0, pbt_population=2, pbt_vectorized=True,
+               pbt_suites='gridworld', pbt_round_frames=400,
+               pbt_quantile=0.5, summary_secs=0, checkpoint_secs=0,
+               logdir=str(tmp_path))
+  run = driver.train(cfg, max_steps=10)
+  assert run is not None
+
+  with open(tmp_path / 'PBT_LOG.json') as f:
+    log = json.load(f)
+  assert log['vectorized'] is True
+  assert len(log['rounds']) == 2
+  assert log['winner']['member'] == 1
+  exploits = [d for r in log['rounds'] for d in r['decisions']]
+  assert exploits and exploits[0]['member'] == 0
+  assert exploits[0]['donor'] == 1
+
+  rows = [json.loads(line)
+          for line in open(tmp_path / 'population_summaries.jsonl')]
+  assert {(r['round'], r['member']) for r in rows} == {
+      (0, 0), (0, 1), (1, 0), (1, 1)}
+  assert all('hyper_learning_rate' in r for r in rows)
+
+  incidents = [json.loads(line)
+               for line in open(tmp_path / 'incidents.jsonl')]
+  kinds = [i['kind'] for i in incidents]
+  assert 'pbt_exploit' in kinds and 'pbt_winner' in kinds
+
+  # On-device inheritance: the exploit never deleted a member ladder.
+  assert not [p for p in removed
+              if 'member_' in p and p.rstrip('/').endswith('checkpoints')]
+  # Durable per-member ladders exist anyway (round-boundary saves).
+  for k in range(2):
+    member_ckpts = tmp_path / f'member_{k:02d}' / 'checkpoints'
+    assert member_ckpts.is_dir() and any(member_ckpts.iterdir())
+    assert (tmp_path / f'member_{k:02d}' / 'summaries.jsonl').exists()
+  verdict = slo_lib.read_verdict(str(tmp_path))
+  assert verdict is not None and verdict['pass']
+
+
+@pytest.mark.slow
+def test_fused_member0_learning_curve_matches_serial(tmp_path):
+  """The r23 parity slow gate: member 0 of a fused N=4 bandit
+  population (member 0 carries the unperturbed control hypers;
+  members 1-3 explored, exactly the train_population recipe) learns
+  like a plain serial anakin run from the same seed. The comparison
+  is outcome-level (windowed mean reward), not bitwise — the gate is
+  that vmapping members changes THROUGHPUT, not what any member
+  learns."""
+  from scalable_agent_tpu import driver
+  from scalable_agent_tpu.parallel import anakin
+
+  STEPS, WINDOW = 120, 30
+  base = dict(env_backend='bandit', batch_size=8, unroll_length=5,
+              num_action_repeats=1, episode_length=5, height=24,
+              width=32, torso='shallow', use_instruction=False,
+              learning_rate=2e-3, entropy_cost=3e-3, discounting=0.9,
+              total_environment_frames=10**9)
+
+  # Serial reference: the plain fused loop at member 0's seed (the
+  # population assigns member k seed = config.seed + 101*k + 1).
+  serial_cfg = Config(seed=0 + 101 * 0 + 1, **base)
+  _, history, _ = anakin.run(serial_cfg, STEPS)
+  serial_tail = float(np.mean(
+      [float(h['mean_reward']) for h in history][-WINDOW:]))
+
+  # Fused N=4, same per-member shapes, member 0 unperturbed.
+  cfg = Config(seed=0, **base)
+  env_core = anakin.make_env_core(cfg)
+  agent = driver.build_agent(cfg, env_core.num_actions)
+  vstep = anakin.make_vectorized_anakin_step(agent, env_core, cfg)
+  seeds = [cfg.seed + 101 * k + 1 for k in range(4)]
+  stacked = anakin.init_stacked_carry(agent, env_core, cfg, seeds)
+  rng = np.random.default_rng(cfg.seed)
+  lrs, ecs = [], []
+  for k in range(4):
+    h = {'learning_rate': cfg.learning_rate,
+         'entropy_cost': cfg.entropy_cost}
+    if k:
+      h = population.pbt_explore(h, rng, 1.2)
+    lrs.append(h['learning_rate'])
+    ecs.append(h['entropy_cost'])
+  hypers = {'learning_rate': jnp.asarray(lrs, jnp.float32),
+            'entropy_cost': jnp.asarray(ecs, jnp.float32)}
+  fused_rewards = []
+  for _ in range(STEPS):
+    stacked, metrics = vstep(stacked, hypers)
+    fused_rewards.append(float(np.asarray(
+        jax.device_get(metrics['mean_reward']))[0]))
+  fused_tail = float(np.mean(fused_rewards[-WINDOW:]))
+
+  # Bandit mean reward lives in [0, 1]; both runs must have learned
+  # (chance is 1/3) and member 0 must track the serial curve.
+  assert serial_tail > 0.5, serial_tail
+  assert fused_tail > 0.5, fused_tail
+  assert abs(fused_tail - serial_tail) < 0.15, (fused_tail,
+                                                serial_tail)
